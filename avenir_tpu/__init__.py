@@ -1,0 +1,34 @@
+"""avenir_tpu: a TPU-native classical-ML / data-mining framework.
+
+A ground-up JAX/XLA re-design of the capabilities of the avenir toolkit
+(reference: Hadoop MapReduce + Spark + Storm jobs). Instead of one JVM job
+per pipeline stage with HDFS files in between, every algorithm here is a
+set of jitted, shardable array programs:
+
+- per-record "mapper" logic      -> jax.vmap row kernels
+- keyed shuffle + reducers       -> dense-key segment_sum + lax.psum over a Mesh
+- secondary sort (ranked values) -> lax.top_k within shards
+- iterative driver shell loops   -> host Python loops around jitted steps,
+                                    model state stays on device
+- Storm streaming bolts          -> async host loop feeding a jitted kernel
+
+Compatibility surfaces kept from the reference: FeatureSchema JSON metadata
+(resource/churn.json style), flat .properties config files with per-job key
+prefixes, CSV record IO, and file-based model formats (DecisionPathList JSON,
+CSV distribution models).
+"""
+
+__version__ = "0.1.0"
+
+from avenir_tpu.core.schema import FeatureSchema, FeatureField
+from avenir_tpu.core.config import JobConfig, load_properties
+from avenir_tpu.core.dataset import Dataset
+
+__all__ = [
+    "FeatureSchema",
+    "FeatureField",
+    "JobConfig",
+    "load_properties",
+    "Dataset",
+    "__version__",
+]

@@ -1,0 +1,6 @@
+"""Core layer: schema metadata, config, columnar ingest, metrics.
+
+Replaces the role of the reference's external `chombo` library
+(FeatureSchema/FeatureField, Utility.setConfiguration, Tuple writables)
+with columnar, device-friendly equivalents.
+"""

@@ -1,0 +1,265 @@
+"""FeatureSchema: dataset metadata compatible with the reference JSON format.
+
+The reference consumes per-dataset JSON schemas (e.g. resource/churn.json,
+resource/call_hangup.json) through chombo's FeatureSchema/FeatureField; every
+job resolves column ordinals, types, roles (id / feature / class attribute),
+categorical cardinalities and numeric binning hints from it (reference:
+bayesian/BayesianDistribution.java:117-123, tree/SplitManager.java:284-291).
+
+This module parses the *same* JSON files unchanged, and adds what a TPU
+pipeline needs on top: stable integer encodings for categorical values
+(value -> index within the declared cardinality), bucketizers for numeric
+fields, and flat views (feature ordinals, class ordinal) used by the
+columnar ingest in avenir_tpu.core.dataset.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Sequence
+
+
+DATA_TYPE_STRING = "string"
+DATA_TYPE_CATEGORICAL = "categorical"
+DATA_TYPE_INT = "int"
+DATA_TYPE_DOUBLE = "double"
+DATA_TYPE_TEXT = "text"
+
+NUMERIC_TYPES = (DATA_TYPE_INT, DATA_TYPE_DOUBLE)
+
+
+@dataclass
+class FeatureField:
+    """One column of the dataset.
+
+    Mirrors the attributes of the reference schema JSON: name, ordinal,
+    dataType, and the role flags / hints used by the jobs.
+    """
+
+    name: str
+    ordinal: int
+    data_type: str = DATA_TYPE_STRING
+    # role flags
+    id_field: bool = False
+    feature: bool = False
+    class_attr: bool = False
+    # categorical metadata
+    cardinality: List[str] = field(default_factory=list)
+    # numeric metadata (binning / split hints)
+    min: Optional[float] = None
+    max: Optional[float] = None
+    bucket_width: Optional[float] = None
+    max_split: Optional[int] = None
+    split_scan_interval: Optional[float] = None
+    # misc passthrough of unrecognized keys (kept for round-tripping)
+    extra: Dict[str, Any] = field(default_factory=dict)
+
+    # ------------------------------------------------------------------ roles
+    @property
+    def is_categorical(self) -> bool:
+        return self.data_type == DATA_TYPE_CATEGORICAL
+
+    @property
+    def is_numeric(self) -> bool:
+        return self.data_type in NUMERIC_TYPES
+
+    @property
+    def is_text(self) -> bool:
+        return self.data_type == DATA_TYPE_TEXT
+
+    # --------------------------------------------------------------- encoding
+    def cardinality_index(self) -> Dict[str, int]:
+        """Stable mapping categorical value -> int code (order of declaration)."""
+        return {v: i for i, v in enumerate(self.cardinality)}
+
+    def num_bins(self) -> int:
+        """Number of discrete states this field takes after encoding.
+
+        Categorical: declared cardinality. Numeric with bucketWidth: number of
+        buckets across [min, max] (the reference bins continuous features the
+        same way when building count-based distributions). Other: 0 (not
+        encodable to a dense state).
+        """
+        if self.is_categorical:
+            return len(self.cardinality)
+        if self.is_numeric and self.bucket_width:
+            lo = self.min if self.min is not None else 0.0
+            hi = self.max
+            if hi is None:
+                raise ValueError(
+                    f"field {self.name!r}: bucketWidth set but no max bound"
+                )
+            return int(math.floor((hi - lo) / self.bucket_width)) + 1
+        return 0
+
+    def encode_value(self, raw: str) -> int:
+        """Encode one raw CSV token to its dense integer state."""
+        if self.is_categorical:
+            return self.cardinality_index()[raw]
+        if self.is_numeric and self.bucket_width:
+            lo = self.min if self.min is not None else 0.0
+            return int((float(raw) - lo) // self.bucket_width)
+        raise ValueError(f"field {self.name!r} is not dense-encodable")
+
+    def decode_value(self, code: int) -> str:
+        if self.is_categorical:
+            return self.cardinality[code]
+        raise ValueError(f"field {self.name!r} is not categorical")
+
+    # ------------------------------------------------------------------- json
+    @classmethod
+    def from_json(cls, obj: Dict[str, Any]) -> "FeatureField":
+        known = {
+            "name",
+            "ordinal",
+            "dataType",
+            "id",
+            "feature",
+            "classAttribute",
+            "cardinality",
+            "min",
+            "max",
+            "bucketWidth",
+            "maxSplit",
+            "splitScanInterval",
+        }
+        return cls(
+            name=obj.get("name", f"field{obj.get('ordinal')}"),
+            ordinal=int(obj["ordinal"]),
+            data_type=obj.get("dataType", DATA_TYPE_STRING),
+            id_field=bool(obj.get("id", False)),
+            feature=bool(obj.get("feature", False)),
+            class_attr=bool(obj.get("classAttribute", False)),
+            cardinality=[str(v) for v in obj.get("cardinality", [])],
+            min=obj.get("min"),
+            max=obj.get("max"),
+            bucket_width=obj.get("bucketWidth"),
+            max_split=obj.get("maxSplit"),
+            split_scan_interval=obj.get("splitScanInterval"),
+            extra={k: v for k, v in obj.items() if k not in known},
+        )
+
+    def to_json(self) -> Dict[str, Any]:
+        obj: Dict[str, Any] = {"name": self.name, "ordinal": self.ordinal}
+        obj["dataType"] = self.data_type
+        if self.id_field:
+            obj["id"] = True
+        if self.feature:
+            obj["feature"] = True
+        if self.class_attr:
+            obj["classAttribute"] = True
+        if self.cardinality:
+            obj["cardinality"] = list(self.cardinality)
+        for key, val in (
+            ("min", self.min),
+            ("max", self.max),
+            ("bucketWidth", self.bucket_width),
+            ("maxSplit", self.max_split),
+            ("splitScanInterval", self.split_scan_interval),
+        ):
+            if val is not None:
+                obj[key] = val
+        obj.update(self.extra)
+        return obj
+
+
+class FeatureSchema:
+    """The full dataset schema: an ordered list of FeatureFields.
+
+    Convention kept from the reference: when no field carries an explicit
+    `classAttribute` flag, the *last* non-feature, non-id categorical field is
+    the class attribute (this is how churn.json's `status` field is used by
+    the Bayesian jobs even though it carries no explicit role flag).
+    """
+
+    def __init__(self, fields: Sequence[FeatureField]):
+        self.fields: List[FeatureField] = sorted(fields, key=lambda f: f.ordinal)
+        self._by_ordinal = {f.ordinal: f for f in self.fields}
+        self._by_name = {f.name: f for f in self.fields}
+
+    # --------------------------------------------------------------- loading
+    @classmethod
+    def from_json(cls, obj: Dict[str, Any]) -> "FeatureSchema":
+        return cls([FeatureField.from_json(f) for f in obj["fields"]])
+
+    @classmethod
+    def from_file(cls, path: str) -> "FeatureSchema":
+        with open(path, "r") as fh:
+            return cls.from_json(json.load(fh))
+
+    @classmethod
+    def from_string(cls, text: str) -> "FeatureSchema":
+        return cls.from_json(json.loads(text))
+
+    def to_json(self) -> Dict[str, Any]:
+        return {"fields": [f.to_json() for f in self.fields]}
+
+    def save(self, path: str) -> None:
+        with open(path, "w") as fh:
+            json.dump(self.to_json(), fh, indent=2)
+
+    # --------------------------------------------------------------- lookups
+    def field_by_ordinal(self, ordinal: int) -> FeatureField:
+        return self._by_ordinal[ordinal]
+
+    def field_by_name(self, name: str) -> FeatureField:
+        return self._by_name[name]
+
+    @property
+    def id_field(self) -> Optional[FeatureField]:
+        for f in self.fields:
+            if f.id_field:
+                return f
+        return None
+
+    @property
+    def feature_fields(self) -> List[FeatureField]:
+        return [f for f in self.fields if f.feature]
+
+    @property
+    def feature_ordinals(self) -> List[int]:
+        return [f.ordinal for f in self.feature_fields]
+
+    @property
+    def class_field(self) -> Optional[FeatureField]:
+        explicit = [f for f in self.fields if f.class_attr]
+        if explicit:
+            return explicit[-1]
+        # reference convention: trailing categorical non-feature non-id field
+        for f in reversed(self.fields):
+            if f.is_categorical and not f.feature and not f.id_field:
+                return f
+        return None
+
+    @property
+    def class_ordinal(self) -> int:
+        cf = self.class_field
+        if cf is None:
+            raise ValueError("schema has no class attribute")
+        return cf.ordinal
+
+    def num_classes(self) -> int:
+        cf = self.class_field
+        return len(cf.cardinality) if cf is not None else 0
+
+    def class_values(self) -> List[str]:
+        cf = self.class_field
+        return list(cf.cardinality) if cf is not None else []
+
+    # per-feature dense state counts (0 for non-encodable e.g. unbinned double)
+    def feature_bins(self) -> List[int]:
+        return [f.num_bins() for f in self.feature_fields]
+
+    def max_ordinal(self) -> int:
+        return self.fields[-1].ordinal if self.fields else -1
+
+    def __len__(self) -> int:
+        return len(self.fields)
+
+    def __iter__(self):
+        return iter(self.fields)
+
+    def __repr__(self) -> str:
+        return f"FeatureSchema({[f.name for f in self.fields]})"

@@ -1,0 +1,10 @@
+"""Synthetic data generators — the reference's resource/*.py generator scripts
+(telecom_churn.py, elearn.py, call_hangup.py, price_opt.py) re-built as
+seedable numpy generators that return Datasets directly."""
+
+from avenir_tpu.data.generators import (
+    churn_schema,
+    generate_churn,
+    elearn_schema,
+    generate_elearn,
+)

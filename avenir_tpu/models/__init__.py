@@ -1,0 +1,1 @@
+"""Algorithm layer: the org.avenir.* job families re-built as jitted array programs."""

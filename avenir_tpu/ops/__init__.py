@@ -1,0 +1,13 @@
+"""Compute core: the TPU-native replacement for Hadoop shuffle semantics.
+
+Primitive vocabulary (SURVEY §2.12 mapping):
+- rowmap          : vmap'd per-record kernel        (parallel mappers)
+- keyed_reduce    : segment_sum over dense keys      (shuffle + combiner + reducer)
+- topk_by_group   : per-group ranked selection       (secondary sort)
+- allpairs_distance: blocked pairwise distances      (sifarish SameTypeSimilarity)
+- infotheory      : entropy / gini / MI algebra      (InfoContentStat et al.)
+"""
+
+from avenir_tpu.ops.reduce import keyed_reduce, combine_codes, one_hot_count
+from avenir_tpu.ops.distance import pairwise_distance, blocked_topk_neighbors
+from avenir_tpu.ops.infotheory import entropy, gini, bits_entropy

@@ -1,0 +1,112 @@
+"""Keyed reductions: the Hadoop shuffle/combiner/reducer collapsed to one op.
+
+Every counting job in the reference (Bayesian distributions, mutual
+information, Markov transition counts, Apriori supports, correlation
+contingency tables) is "emit (key tuple) -> 1 or (1, x, x^2); shuffle; sum".
+With schema-declared cardinalities every key is a dense integer, so the whole
+shuffle collapses to `jax.ops.segment_sum` on device — and to a `lax.psum`
+over the mesh's data axis when row shards live on different chips
+(see avenir_tpu.parallel.mesh.sharded_sum).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+
+def keyed_reduce(
+    keys: jax.Array,
+    values: Optional[jax.Array],
+    num_keys: int,
+    weights: Optional[jax.Array] = None,
+) -> jax.Array:
+    """Sum `values` (or 1s) per integer key.
+
+    keys: int array [n]; values: [n] or [n, d] or None (count mode);
+    weights: optional [n] multiplier (e.g. record validity mask).
+    Returns [num_keys] or [num_keys, d].
+    """
+    if values is None:
+        values = jnp.ones(keys.shape[0], dtype=jnp.float32)
+    if weights is not None:
+        values = values * (weights if values.ndim == 1 else weights[:, None])
+    return jax.ops.segment_sum(values, keys, num_segments=num_keys)
+
+
+def combine_codes(codes: Sequence[jax.Array], bins: Sequence[int]) -> jax.Array:
+    """Flatten a tuple of dense codes into one mixed-radix key.
+
+    The reference shuffles on composite Tuple keys (classVal, featureOrd,
+    bin); with static cardinalities the same composite key is
+    `((c0 * b1) + c1) * b2 + c2 ...` — a single int32 keyspace of size
+    prod(bins) that segment_sum can index directly.
+    """
+    assert len(codes) == len(bins) and len(codes) >= 1
+    key = codes[0].astype(jnp.int32)
+    for c, b in zip(codes[1:], bins[1:]):
+        key = key * b + c.astype(jnp.int32)
+    return key
+
+
+def one_hot_count(
+    codes: jax.Array,
+    num_bins: int,
+    weights: Optional[jax.Array] = None,
+    dtype=jnp.float32,
+) -> jax.Array:
+    """Histogram via one-hot matmul — MXU-friendly for wide batch counting.
+
+    codes: [n] or [n, F] int; returns [num_bins] or [F, num_bins].
+    For [n, F] inputs this is a single (F x n) @ (n x bins) style contraction
+    realized as one_hot + sum, which XLA lowers to an MXU matmul — the fast
+    path for counting many features at once (vs. F separate segment_sums).
+    """
+    oh = jax.nn.one_hot(codes, num_bins, dtype=dtype)   # [..., num_bins]
+    if weights is not None:
+        oh = oh * (weights[:, None] if codes.ndim == 1 else weights[:, None, None])
+    return jnp.sum(oh, axis=0)          # [num_bins] or [F, num_bins]
+
+
+def cross_count(
+    row_codes: jax.Array,
+    col_codes: jax.Array,
+    num_rows: int,
+    num_cols: int,
+    weights: Optional[jax.Array] = None,
+    dtype=jnp.float32,
+) -> jax.Array:
+    """Contingency table count[i, j] = #(row_codes==i & col_codes==j).
+
+    Realized as one_hot(rows).T @ one_hot(cols) — a dense matmul on the MXU.
+    This is the workhorse for class-conditional feature distributions,
+    Cramér correlation, mutual information and Markov bigram counting.
+    """
+    r = jax.nn.one_hot(row_codes, num_rows, dtype=dtype)    # [n, R]
+    c = jax.nn.one_hot(col_codes, num_cols, dtype=dtype)    # [n, C]
+    if weights is not None:
+        r = r * weights[:, None]
+    return r.T @ c
+
+
+def moment_reduce(
+    keys: jax.Array,
+    x: jax.Array,
+    num_keys: int,
+    weights: Optional[jax.Array] = None,
+) -> jax.Array:
+    """Per-key (count, sum, sum-of-squares) — the continuous-feature triple
+    the reference emits for Gaussian stats (BayesianDistribution mapper emits
+    (1, x, x^2) per record). Returns [num_keys, 3]."""
+    ones = jnp.ones_like(x)
+    trip = jnp.stack([ones, x, x * x], axis=-1)             # [n, 3]
+    if weights is not None:
+        trip = trip * weights[:, None]
+    return jax.ops.segment_sum(trip, keys, num_segments=num_keys)
+
+
+def rowmap(fn, *arrays):
+    """vmap over the leading (row) axis — the per-record mapper."""
+    return jax.vmap(fn)(*arrays)

@@ -1,0 +1,17 @@
+"""Parallel layer: device meshes + collectives.
+
+Replaces the reference's distribution substrate (Hadoop shuffle/HDFS, Spark
+RDD shuffle, Storm workers — SURVEY §2.12) with jax.sharding over an ICI
+mesh: row batches shard over a 'data' axis, small model tensors replicate,
+and aggregation is lax.psum instead of a shuffle.
+"""
+
+from avenir_tpu.parallel.mesh import (
+    DATA_AXIS,
+    MODEL_AXIS,
+    data_mesh,
+    shard_rows,
+    row_mask,
+    replicated,
+    sharded_keyed_count,
+)

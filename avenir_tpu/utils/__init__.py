@@ -1,0 +1,1 @@
+"""Utility layer: metrics, counters, model math shared across algorithms."""

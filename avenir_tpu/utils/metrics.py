@@ -1,0 +1,129 @@
+"""Validation metrics: confusion matrix + counter groups.
+
+The reference validates classifiers in-job by pushing TP/FN/TN/FP, accuracy,
+recall and precision into Hadoop counters under a "Validation" group
+(util/ConfusionMatrix.java, used at bayesian/BayesianPredictor.java:170-180
+and knn/NearestNeighbor.java:300-312). Here the confusion matrix is computed
+on device in one vectorized pass and surfaced as a plain dict of counters.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+
+class ConfusionMatrix:
+    """Multi-class confusion matrix with the reference's binary counter names.
+
+    `pos_class` marks which class index plays the "positive" role for the
+    TP/FP/TN/FN counters (the reference takes the configured positive class
+    value, e.g. bap.positive.class.value).
+    """
+
+    def __init__(self, class_values: Sequence[str], pos_class: int = 0):
+        self.class_values = list(class_values)
+        self.k = len(self.class_values)
+        self.pos_class = pos_class
+        self.matrix = np.zeros((self.k, self.k), dtype=np.int64)  # [actual, predicted]
+
+    def add(self, actual: np.ndarray, predicted: np.ndarray) -> None:
+        a = np.asarray(actual).astype(np.int64).ravel()
+        p = np.asarray(predicted).astype(np.int64).ravel()
+        np.add.at(self.matrix, (a, p), 1)
+
+    # ------------------------------------------------------------- counters
+    @property
+    def total(self) -> int:
+        return int(self.matrix.sum())
+
+    @property
+    def true_pos(self) -> int:
+        c = self.pos_class
+        return int(self.matrix[c, c])
+
+    @property
+    def false_neg(self) -> int:
+        c = self.pos_class
+        return int(self.matrix[c, :].sum() - self.matrix[c, c])
+
+    @property
+    def false_pos(self) -> int:
+        c = self.pos_class
+        return int(self.matrix[:, c].sum() - self.matrix[c, c])
+
+    @property
+    def true_neg(self) -> int:
+        return self.total - self.true_pos - self.false_neg - self.false_pos
+
+    def accuracy(self) -> float:
+        t = self.total
+        return float(np.trace(self.matrix)) / t if t else 0.0
+
+    def recall(self) -> float:
+        denom = self.true_pos + self.false_neg
+        return self.true_pos / denom if denom else 0.0
+
+    def precision(self) -> float:
+        denom = self.true_pos + self.false_pos
+        return self.true_pos / denom if denom else 0.0
+
+    def counters(self) -> Dict[str, float]:
+        """The reference's "Validation" counter group, percent-scaled like
+        Hadoop counters (accuracy/recall/precision as int percent)."""
+        return {
+            "Validation:TruePositive": self.true_pos,
+            "Validation:FalseNegative": self.false_neg,
+            "Validation:TrueNegative": self.true_neg,
+            "Validation:FalsePositive": self.false_pos,
+            "Validation:Accuracy": int(100 * self.accuracy()),
+            "Validation:Recall": int(100 * self.recall()),
+            "Validation:Precision": int(100 * self.precision()),
+        }
+
+    def __repr__(self) -> str:
+        return f"ConfusionMatrix(k={self.k}, total={self.total})"
+
+
+class CostBasedArbitrator:
+    """Misclassification-cost decision between two classes.
+
+    Reference: util/CostBasedArbitrator.java, used by BayesianPredictor
+    (:342-391) and NearestNeighbor. Given per-class probabilities (scaled to
+    int percent in the reference) and per-class misclassification costs,
+    choose positive iff prob_pos * cost_fn >= prob_neg * cost_fp (expected
+    cost comparison)."""
+
+    def __init__(self, neg_class: str, pos_class: str,
+                 cost_neg: float, cost_pos: float):
+        self.neg_class = neg_class
+        self.pos_class = pos_class
+        self.cost_neg = cost_neg  # cost of misclassifying a true negative
+        self.cost_pos = cost_pos  # cost of misclassifying a true positive
+
+    def arbitrate(self, prob_neg: np.ndarray, prob_pos: np.ndarray) -> np.ndarray:
+        """Vectorized: returns bool array, True -> positive class."""
+        return np.asarray(prob_pos) * self.cost_pos >= np.asarray(prob_neg) * self.cost_neg
+
+
+class Counters:
+    """A flat stand-in for Hadoop counter groups: "Group:Name" -> value."""
+
+    def __init__(self) -> None:
+        self.values: Dict[str, float] = {}
+
+    def incr(self, key: str, amount: float = 1) -> None:
+        self.values[key] = self.values.get(key, 0) + amount
+
+    def set(self, key: str, value: float) -> None:
+        self.values[key] = value
+
+    def update(self, other: Dict[str, float]) -> None:
+        self.values.update(other)
+
+    def get(self, key: str, default: float = 0) -> float:
+        return self.values.get(key, default)
+
+    def __repr__(self) -> str:
+        return f"Counters({self.values})"

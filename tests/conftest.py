@@ -1,0 +1,33 @@
+"""Test harness: run everything on a virtual 8-device CPU mesh.
+
+The TPU analog of "multi-node without a real cluster" (SURVEY §4): tests
+assert that mesh-sharded results equal single-device results on 8 virtual
+CPU devices. Must configure the platform before any JAX backend init.
+"""
+
+import os
+
+# 8 virtual CPU devices; must be in place before the CPU client is created.
+_flag = "--xla_force_host_platform_device_count=8"
+if _flag not in os.environ.get("XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "") + " " + _flag).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def mesh8():
+    from avenir_tpu.parallel import data_mesh
+
+    assert len(jax.devices()) == 8, "expected 8 virtual CPU devices"
+    return data_mesh()
+
+
+@pytest.fixture(scope="session")
+def rng():
+    return np.random.default_rng(42)

@@ -1,0 +1,195 @@
+"""Core layer tests: schema parsing, properties config, columnar ingest."""
+
+import json
+import textwrap
+
+import numpy as np
+import pytest
+
+from avenir_tpu.core.config import (
+    JobConfig,
+    MissingConfigError,
+    parse_properties_string,
+)
+from avenir_tpu.core.dataset import Dataset
+from avenir_tpu.core.schema import FeatureSchema
+
+CHURN_SCHEMA = {
+    "fields": [
+        {"name": "id", "ordinal": 0, "id": True, "dataType": "string"},
+        {
+            "name": "minUsed",
+            "ordinal": 1,
+            "dataType": "categorical",
+            "cardinality": ["low", "med", "high", "overage"],
+            "feature": True,
+        },
+        {
+            "name": "holdTime",
+            "ordinal": 2,
+            "dataType": "int",
+            "feature": True,
+            "min": 0,
+            "max": 600,
+            "bucketWidth": 60,
+        },
+        {
+            "name": "income",
+            "ordinal": 3,
+            "dataType": "double",
+            "feature": True,
+        },
+        {
+            "name": "status",
+            "ordinal": 4,
+            "dataType": "categorical",
+            "cardinality": ["open", "closed"],
+        },
+    ]
+}
+
+CSV = textwrap.dedent(
+    """\
+    a1,low,30,55.5,open
+    a2,high,120,80.0,closed
+    a3,overage,599,21.0,closed
+    a4,med,0,44.2,open
+    """
+)
+
+
+@pytest.fixture
+def schema():
+    return FeatureSchema.from_json(CHURN_SCHEMA)
+
+
+@pytest.fixture
+def ds(schema):
+    return Dataset.from_csv(CSV, schema)
+
+
+class TestSchema:
+    def test_roles(self, schema):
+        assert schema.id_field.name == "id"
+        assert [f.name for f in schema.feature_fields] == [
+            "minUsed",
+            "holdTime",
+            "income",
+        ]
+        # implicit class attribute: trailing non-feature categorical
+        assert schema.class_field.name == "status"
+        assert schema.num_classes() == 2
+        assert schema.class_values() == ["open", "closed"]
+
+    def test_bins(self, schema):
+        f = schema.field_by_name("minUsed")
+        assert f.num_bins() == 4
+        assert f.encode_value("overage") == 3
+        assert f.decode_value(1) == "med"
+        h = schema.field_by_name("holdTime")
+        assert h.num_bins() == 11  # 600/60 + 1
+        assert h.encode_value("0") == 0
+        assert h.encode_value("119") == 1
+        # unbinned double has no dense state
+        assert schema.field_by_name("income").num_bins() == 0
+
+    def test_roundtrip(self, schema, tmp_path):
+        p = tmp_path / "s.json"
+        schema.save(str(p))
+        again = FeatureSchema.from_file(str(p))
+        assert json.dumps(again.to_json(), sort_keys=True) == json.dumps(
+            schema.to_json(), sort_keys=True
+        )
+
+    def test_explicit_class_attr(self):
+        obj = {
+            "fields": [
+                {
+                    "name": "y",
+                    "ordinal": 0,
+                    "dataType": "categorical",
+                    "cardinality": ["a", "b"],
+                    "classAttribute": True,
+                },
+                {
+                    "name": "x",
+                    "ordinal": 1,
+                    "dataType": "categorical",
+                    "cardinality": ["p", "q"],
+                    "feature": True,
+                },
+            ]
+        }
+        s = FeatureSchema.from_json(obj)
+        assert s.class_field.name == "y"
+
+
+class TestConfig:
+    PROPS = textwrap.dedent(
+        """\
+        # shared
+        field.delim.regex=,
+        debug.on=true
+        num.reducer=1
+        nen.top.match.count=5
+        nen.kernel.function=none
+        nen.class.condtion.weighted=true
+        dtb.max.depth.limit=2
+        dtb.min.info.gain.limit=
+        costs=2,5.5
+        """
+    )
+
+    def test_prefix_resolution(self):
+        cfg = JobConfig(parse_properties_string(self.PROPS), prefix="nen")
+        assert cfg.get_int("top.match.count") == 5
+        assert cfg.get("kernel.function") == "none"
+        assert cfg.get_bool("class.condtion.weighted") is True
+        # falls back to shared unprefixed key
+        assert cfg.get_int("num.reducer") == 1
+        assert cfg.debug_on is True
+
+    def test_empty_value_is_missing(self):
+        cfg = JobConfig(parse_properties_string(self.PROPS), prefix="dtb")
+        assert cfg.get_float("min.info.gain.limit") is None
+        assert cfg.get_int("max.depth.limit") == 2
+
+    def test_assert_raises(self):
+        cfg = JobConfig(parse_properties_string(self.PROPS), prefix="nen")
+        with pytest.raises(MissingConfigError):
+            cfg.assert_int("nonexistent.key")
+
+    def test_lists(self):
+        cfg = JobConfig(parse_properties_string(self.PROPS))
+        assert cfg.get_float_list("costs") == [2.0, 5.5]
+
+    def test_scoped(self):
+        cfg = JobConfig(parse_properties_string(self.PROPS), prefix="nen")
+        assert cfg.scoped("dtb").get_int("max.depth.limit") == 2
+
+
+class TestDataset:
+    def test_columns(self, ds):
+        assert len(ds) == 4
+        assert list(ds.ids()) == ["a1", "a2", "a3", "a4"]
+        np.testing.assert_array_equal(ds.labels(), [0, 1, 1, 0])
+
+    def test_feature_codes(self, ds):
+        codes, bins = ds.feature_codes()
+        assert bins == [4, 11]
+        np.testing.assert_array_equal(codes[:, 0], [0, 2, 3, 1])  # minUsed
+        np.testing.assert_array_equal(codes[:, 1], [0, 2, 9, 0])  # holdTime buckets
+
+    def test_feature_matrix(self, ds):
+        m = ds.feature_matrix()
+        assert m.shape == (4, 2)  # holdTime + income
+        np.testing.assert_allclose(m[:, 1], [55.5, 80.0, 21.0, 44.2], rtol=1e-6)
+
+    def test_unknown_categorical_raises(self, schema):
+        with pytest.raises(ValueError, match="cardinality"):
+            Dataset.from_csv("a1,BOGUS,30,55.5,open\n", schema)
+
+    def test_take(self, ds):
+        sub = ds.take(np.array([2, 0]))
+        assert list(sub.ids()) == ["a3", "a1"]
+        np.testing.assert_array_equal(sub.labels(), [1, 0])

@@ -1,0 +1,135 @@
+"""Naive Bayes vs an independent NumPy oracle + model-file round trip."""
+
+import numpy as np
+import pytest
+
+from avenir_tpu.data import generate_churn, churn_schema
+from avenir_tpu.models.naive_bayes import NaiveBayesModel, NaiveBayesPredictor
+from avenir_tpu.utils.metrics import CostBasedArbitrator
+
+
+@pytest.fixture(scope="module")
+def churn():
+    return generate_churn(2000, seed=3)
+
+
+@pytest.fixture(scope="module")
+def model(churn):
+    return NaiveBayesModel.fit(churn)
+
+
+def _oracle_posteriors(ds):
+    """Independent NumPy NB: P(C|F) = prod_f P(bin_f|C) * P(C) / prod_f P(bin_f)."""
+    codes, bins = ds.feature_codes()
+    y = ds.labels()
+    n, F = codes.shape
+    K = ds.schema.num_classes()
+    post = []
+    prior = []
+    for f in range(F):
+        pf = np.zeros((K, bins[f]))
+        for k in range(K):
+            pf[k] = np.bincount(codes[y == k, f], minlength=bins[f])
+        post.append(pf / np.maximum(pf.sum(1, keepdims=True), 1e-30))
+        tot = pf.sum(0)
+        prior.append(tot / tot.sum())
+    pc = np.bincount(y, minlength=K) / n
+    out = np.zeros((n, K))
+    for i in range(n):
+        fprior = np.prod([prior[f][codes[i, f]] for f in range(F)])
+        for k in range(K):
+            fpost = np.prod([post[f][k, codes[i, f]] for f in range(F)])
+            out[i, k] = fpost * pc[k] / max(fprior, 1e-30)
+    return out
+
+
+class TestTrain:
+    def test_counts_match_bincount(self, churn, model):
+        codes, bins = churn.feature_codes()
+        y = churn.labels()
+        for f in range(len(bins)):
+            for k in range(2):
+                expect = np.bincount(codes[y == k, f], minlength=bins[f])
+                np.testing.assert_allclose(
+                    model.post_counts[f, k, : bins[f]], expect
+                )
+        np.testing.assert_allclose(model.class_counts, np.bincount(y, minlength=2))
+
+    def test_streaming_accumulate_equals_single_pass(self, churn, model):
+        m2 = NaiveBayesModel.empty(churn.schema)
+        half = len(churn) // 2
+        for part in (churn.take(np.arange(half)), churn.take(np.arange(half, len(churn)))):
+            codes, _ = part.feature_codes(m2.binned_fields)
+            m2.accumulate(codes, part.labels(), part.feature_matrix(m2.cont_fields))
+        np.testing.assert_allclose(m2.post_counts, model.post_counts)
+
+
+class TestPredict:
+    def test_matches_numpy_oracle(self, churn, model):
+        pred, prob = NaiveBayesPredictor(model).predict(churn)
+        oracle = _oracle_posteriors(churn)
+        # int-percent scaling like the reference (floor(prob*100))
+        oracle_pct = np.floor(np.clip(oracle, 0, None) * 100).astype(np.int32)
+        np.testing.assert_array_equal(prob, oracle_pct)
+        # argmax over the same int-percent space (ties break to first class,
+        # as in the reference's > comparison loop)
+        np.testing.assert_array_equal(pred, oracle_pct.argmax(axis=1))
+
+    def test_learns_signal(self, churn, model):
+        cm = NaiveBayesPredictor(model).validate(churn, pos_class=1)
+        assert cm.accuracy() > 0.8
+        counters = cm.counters()
+        assert counters["Validation:Accuracy"] > 80
+
+    def test_cost_arbitration_shifts_decisions(self, churn, model):
+        arb = CostBasedArbitrator("open", "closed", cost_neg=1.0, cost_pos=10.0)
+        pred_arb, _ = NaiveBayesPredictor(model, arbitrator=arb).predict(churn)
+        pred_def, _ = NaiveBayesPredictor(model).predict(churn)
+        # heavy positive-miss cost -> at least as many positive predictions
+        assert (pred_arb == 1).sum() >= (pred_def == 1).sum()
+
+
+class TestModelFile:
+    def test_csv_roundtrip(self, churn, model, tmp_path):
+        p = tmp_path / "model.csv"
+        model.save(str(p))
+        again = NaiveBayesModel.load(str(p), churn.schema)
+        pred1, prob1 = NaiveBayesPredictor(model).predict(churn)
+        pred2, prob2 = NaiveBayesPredictor(again).predict(churn)
+        np.testing.assert_array_equal(pred1, pred2)
+        np.testing.assert_array_equal(prob1, prob2)
+
+    def test_csv_format_rows(self, model):
+        lines = model.to_csv().strip().split("\n")
+        # posterior rows: classVal,ord,bin,count
+        post = [l for l in lines if l.split(",")[0] != "" and l.split(",")[1] != ""]
+        assert post, "no posterior rows"
+        cv, o, b, c = post[0].split(",")
+        assert cv in ("open", "closed") and int(o) >= 1 and int(c) > 0
+        # class prior rows: classVal,,,count
+        priors = [l for l in lines if l.split(",")[1] == "" and l.split(",")[0] != ""]
+        assert priors and priors[0].split(",")[2] == ""
+
+
+class TestSharded:
+    def test_mesh_counts_equal_host(self, churn, model, mesh8):
+        from avenir_tpu.parallel import shard_rows, sharded_keyed_count, row_mask
+        import jax.numpy as jnp
+
+        codes, bins = churn.feature_codes()
+        y = churn.labels()
+        k, bmax = 2, max(bins)
+
+        def count(codes, labels, w):
+            import jax
+            oh_k = jax.nn.one_hot(labels, k, dtype=jnp.float32) * w[:, None]
+            oh_b = jax.nn.one_hot(codes, bmax, dtype=jnp.float32)
+            return jnp.einsum("nk,nfb->fkb", oh_k, oh_b)
+
+        fn = sharded_keyed_count(mesh8, count)
+        n = len(churn)
+        cs = shard_rows(mesh8, codes)
+        ys = shard_rows(mesh8, y)
+        ws = row_mask(mesh8, n, cs.shape[0])
+        out = np.asarray(fn(cs, ys, ws))
+        np.testing.assert_allclose(out, model.post_counts, rtol=1e-5)

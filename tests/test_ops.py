@@ -1,0 +1,144 @@
+"""Ops layer tests: keyed reductions, info theory, distances, mesh sharding."""
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+from avenir_tpu.ops.reduce import (
+    combine_codes,
+    cross_count,
+    keyed_reduce,
+    moment_reduce,
+    one_hot_count,
+)
+from avenir_tpu.ops.infotheory import (
+    bits_entropy,
+    entropy,
+    gini,
+    mutual_information,
+    weighted_split_score,
+)
+from avenir_tpu.ops.distance import blocked_topk_neighbors, pairwise_distance
+from avenir_tpu.parallel import shard_rows, sharded_keyed_count
+
+
+class TestKeyedReduce:
+    def test_count_mode(self):
+        keys = jnp.array([0, 1, 1, 2, 4, 4, 4, 0])
+        out = keyed_reduce(keys, None, 5)
+        np.testing.assert_array_equal(out, [2, 2, 1, 0, 3])
+
+    def test_values_and_weights(self):
+        keys = jnp.array([0, 0, 1])
+        vals = jnp.array([1.0, 2.0, 3.0])
+        w = jnp.array([1.0, 0.0, 1.0])
+        out = keyed_reduce(keys, vals, 2, weights=w)
+        np.testing.assert_allclose(out, [1.0, 3.0])
+
+    def test_combine_codes(self):
+        a = jnp.array([0, 1, 2])
+        b = jnp.array([1, 0, 2])
+        key = combine_codes([a, b], [3, 3])
+        np.testing.assert_array_equal(key, [1, 3, 8])
+
+    def test_one_hot_count_2d(self):
+        codes = jnp.array([[0, 1], [0, 2], [1, 1]])
+        out = one_hot_count(codes, 3)
+        np.testing.assert_array_equal(out, [[2, 1, 0], [0, 2, 1]])
+
+    def test_cross_count(self):
+        r = jnp.array([0, 0, 1, 1])
+        c = jnp.array([0, 1, 1, 1])
+        out = cross_count(r, c, 2, 2)
+        np.testing.assert_array_equal(out, [[1, 1], [0, 2]])
+
+    def test_moment_reduce(self):
+        keys = jnp.array([0, 0, 1])
+        x = jnp.array([2.0, 4.0, 3.0])
+        out = moment_reduce(keys, x, 2)
+        np.testing.assert_allclose(out, [[2, 6, 20], [1, 3, 9]])
+
+
+class TestInfoTheory:
+    def test_entropy_uniform(self):
+        np.testing.assert_allclose(
+            bits_entropy(jnp.array([5.0, 5.0])), 1.0, atol=1e-6
+        )
+        np.testing.assert_allclose(entropy(jnp.array([7.0, 0.0])), 0.0, atol=1e-6)
+
+    def test_gini(self):
+        np.testing.assert_allclose(gini(jnp.array([5.0, 5.0])), 0.5, atol=1e-6)
+        np.testing.assert_allclose(gini(jnp.array([9.0, 0.0])), 0.0, atol=1e-6)
+
+    def test_weighted_split_score_prefers_pure(self):
+        pure = jnp.array([[[8.0, 0.0], [0.0, 8.0]]])    # perfectly separating
+        mixed = jnp.array([[[4.0, 4.0], [4.0, 4.0]]])
+        assert weighted_split_score(pure)[0] < weighted_split_score(mixed)[0]
+
+    def test_mutual_information_oracle(self, rng):
+        # independent -> ~0; identical -> H(X)
+        joint_ind = jnp.array([[25.0, 25.0], [25.0, 25.0]])
+        np.testing.assert_allclose(mutual_information(joint_ind), 0.0, atol=1e-6)
+        joint_dep = jnp.array([[50.0, 0.0], [0.0, 50.0]])
+        np.testing.assert_allclose(
+            mutual_information(joint_dep), np.log(2), atol=1e-6
+        )
+
+
+class TestDistance:
+    def test_numeric_manhattan(self):
+        q = jnp.array([[0.0, 0.0]])
+        t = jnp.array([[1.0, 1.0], [0.5, 0.0]])
+        d = pairwise_distance(q, t)
+        np.testing.assert_allclose(d, [[1.0, 0.25]], atol=1e-6)
+
+    def test_categorical_mismatch(self):
+        qc = jnp.array([[0, 1]])
+        tc = jnp.array([[0, 1], [0, 2], [1, 2]])
+        d = pairwise_distance(
+            jnp.zeros((1, 0)), jnp.zeros((3, 0)), qc, tc, cat_bins=(2, 3)
+        )
+        np.testing.assert_allclose(d, [[0.0, 0.5, 1.0]], atol=1e-6)
+
+    def test_euclidean_matches_numpy(self, rng):
+        q = rng.normal(size=(5, 3)).astype(np.float32)
+        t = rng.normal(size=(7, 3)).astype(np.float32)
+        d = pairwise_distance(jnp.array(q), jnp.array(t), metric="euclidean")
+        oracle = np.sqrt(
+            ((q[:, None, :] - t[None, :, :]) ** 2).sum(-1) / 3.0
+        )
+        np.testing.assert_allclose(d, oracle, atol=1e-5)
+
+    def test_blocked_topk_equals_full_sort(self, rng):
+        q = rng.normal(size=(6, 4)).astype(np.float32)
+        t = rng.normal(size=(64, 4)).astype(np.float32)
+        dist, idx = blocked_topk_neighbors(
+            jnp.array(q), jnp.array(t), k=5, block=16
+        )
+        full = np.abs(q[:, None, :] - t[None, :, :]).sum(-1) / 4.0
+        oracle_idx = np.argsort(full, axis=1, kind="stable")[:, :5]
+        oracle_d = np.take_along_axis(full, oracle_idx, axis=1)
+        np.testing.assert_allclose(np.sort(dist, axis=1), oracle_d, atol=1e-5)
+        # sets of neighbor indices must agree
+        for r in range(6):
+            assert set(np.array(idx[r])) == set(oracle_idx[r])
+
+
+class TestMeshSharding:
+    def test_sharded_count_matches_local(self, mesh8):
+        keys = np.random.default_rng(0).integers(0, 10, size=128).astype(np.int32)
+        fn = sharded_keyed_count(
+            mesh8,
+            lambda k: jax.ops.segment_sum(
+                jnp.ones_like(k, dtype=jnp.float32), k, num_segments=10
+            ),
+        )
+        out = fn(shard_rows(mesh8, keys))
+        np.testing.assert_array_equal(np.array(out), np.bincount(keys, minlength=10))
+
+    def test_shard_rows_pads(self, mesh8):
+        x = np.arange(13, dtype=np.int32)
+        xs = shard_rows(mesh8, x)
+        assert xs.shape[0] == 16
+        np.testing.assert_array_equal(np.array(xs)[:13], x)
