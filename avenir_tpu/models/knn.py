@@ -1,0 +1,300 @@
+"""K-nearest-neighbor classifier/regressor: the 5-job pipeline fused.
+
+Reference flow (resource/knn.sh:44-132, SURVEY §3.3): (1) external sifarish
+SameTypeSimilarity computes all-pairs train-test distances; (2-3) Bayesian
+jobs compute per-train-entity feature posterior probabilities; (4) a join MR
+attaches them to the distance file; (5) NearestNeighbor re-keys with
+secondary sort so the reducer sees distance-ranked neighbors and votes
+(knn/NearestNeighbor.java, knn/Neighborhood.java).
+
+Here all five jobs are one device program per test batch: blocked streaming
+top-k over the train set (ops.distance), kernel scores, and a one-hot
+matmul vote — with the class-conditional weighting computed directly from a
+NaiveBayesModel instead of a file join.
+
+Kernel semantics follow Neighborhood.processClassDitribution
+(Neighborhood.java:150-218) with KERNEL_SCALE=100 and int-floored scores;
+distances are mapped to the reference's int scale (0..100) first:
+  none                 score = 1
+  linearMultiplicative score = d==0 ? 200 : floor(100/d)
+  linearAdditive       score = 100 - d
+  gaussian             score = floor(100 * exp(-0.5 (d/param)^2))
+Class-conditional weighting multiplies each neighbor's score by its feature
+posterior prob (Neighbor.setScore, :393-404), optionally by 1/d (inverse
+distance). Classification = arg-max class score, or decision-threshold
+pos/neg ratio test (classify(), :272-312). Regression = average / median /
+per-query simple linear regression over the neighbors (doRegression(),
+:223-250).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional, Tuple
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from avenir_tpu.core.dataset import Dataset
+from avenir_tpu.core.schema import FeatureSchema
+from avenir_tpu.models.naive_bayes import NaiveBayesModel
+from avenir_tpu.ops.distance import blocked_topk_neighbors, pad_train
+from avenir_tpu.utils.metrics import ConfusionMatrix
+
+KERNEL_SCALE = 100
+
+KERNELS = ("none", "linearMultiplicative", "linearAdditive", "gaussian")
+
+
+def _extract(ds: Dataset):
+    """Split a dataset into (numeric matrix, ranges, categorical codes, bins)."""
+    num_fields = [f for f in ds.schema.feature_fields if f.is_numeric]
+    cat_fields = [f for f in ds.schema.feature_fields if f.is_categorical]
+    x_num = ds.feature_matrix(num_fields)
+    ranges = np.array(
+        [
+            (f.max - f.min) if (f.max is not None and f.min is not None) else 1.0
+            for f in num_fields
+        ],
+        dtype=np.float32,
+    )
+    if cat_fields:
+        cat_cols = [ds.column(f.ordinal).astype(np.int32) for f in cat_fields]
+        x_cat = np.stack(cat_cols, axis=1)
+        bins = tuple(len(f.cardinality) for f in cat_fields)
+    else:
+        x_cat, bins = None, None
+    return x_num, ranges, x_cat, bins
+
+
+@partial(jax.jit, static_argnames=("kernel", "num_classes", "class_cond",
+                                   "inverse_weighted"))
+def _vote(
+    dist: jnp.ndarray,            # [nq, k] raw distances in [0, ~1]
+    neigh_labels: jnp.ndarray,    # [nq, k] int class codes
+    neigh_post: jnp.ndarray,      # [nq, k] feature posterior probs (or ones)
+    kernel: str,
+    kernel_param: float,
+    num_classes: int,
+    class_cond: bool,
+    inverse_weighted: bool,
+):
+    d = jnp.floor(dist * KERNEL_SCALE)          # reference's int distance scale
+    if kernel == "none":
+        score = jnp.ones_like(d)
+    elif kernel == "linearMultiplicative":
+        score = jnp.where(d == 0, 2.0 * KERNEL_SCALE, jnp.floor(KERNEL_SCALE / jnp.maximum(d, 1.0)))
+    elif kernel == "linearAdditive":
+        score = KERNEL_SCALE - d
+    elif kernel == "gaussian":
+        t = d / kernel_param
+        score = jnp.floor(KERNEL_SCALE * jnp.exp(-0.5 * t * t))
+    else:
+        raise ValueError(f"unknown kernel {kernel}")
+
+    if class_cond:
+        w = jnp.where(neigh_post > 0, score * neigh_post, score)
+        if inverse_weighted:
+            w = w / jnp.maximum(d, 1.0)
+        score = w
+
+    # unfilled neighbor slots (dist=inf, idx=-1 sentinel) contribute nothing
+    score = jnp.where(jnp.isfinite(dist), score, 0.0)
+    oh = jax.nn.one_hot(neigh_labels, num_classes, dtype=jnp.float32)
+    class_scores = jnp.einsum("qk,qkc->qc", score.astype(jnp.float32), oh)
+    return class_scores
+
+
+class NeighborIndex:
+    """Streaming nearest-neighbor search over a train Dataset — the part of
+    the pipeline that replaces sifarish. Label-free: usable for regression
+    and clustering datasets whose schema has no class attribute."""
+
+    def __init__(
+        self,
+        train: Dataset,
+        k: int = 5,
+        metric: str = "manhattan",
+        block: int = 4096,
+        approx: bool = False,
+    ):
+        self.schema = train.schema
+        # the reference takes "the first topMatchCount values" — a train set
+        # smaller than k just yields all of it
+        self.k = max(1, min(k, len(train)))
+        self.metric = metric
+        self.approx = approx
+        self.block = min(block, max(len(train), 1))
+
+        x_num, ranges, x_cat, bins = _extract(train)
+        t_num, t_cat, n_valid = pad_train(x_num, x_cat, self.block)
+        self.t_num = jnp.asarray(t_num) if t_num is not None else None
+        self.t_cat = jnp.asarray(t_cat) if t_cat is not None else None
+        self.cat_bins = bins
+        self.ranges = jnp.asarray(ranges) if ranges.size else None
+        self.n_valid = n_valid
+        self.n_padded = (
+            self.t_num.shape[0] if self.t_num is not None else self.t_cat.shape[0]
+        )
+
+    def neighbors(self, test: Dataset) -> Tuple[jnp.ndarray, jnp.ndarray]:
+        """(dist [nq,k], train index [nq,k]); unfillable slots are (+inf, -1)."""
+        q_num, _, q_cat, _ = _extract(test)
+        return blocked_topk_neighbors(
+            jnp.asarray(q_num) if self.t_num is not None else None,
+            self.t_num,
+            jnp.asarray(q_cat) if self.t_cat is not None else None,
+            self.t_cat,
+            cat_bins=self.cat_bins,
+            num_ranges=self.ranges,
+            k=self.k,
+            block=self.block,
+            metric=self.metric,
+            n_valid=self.n_valid,
+            approx=self.approx,
+        )
+
+
+class NearestNeighborClassifier:
+    """nen.* job equivalent. Parameters mirror the knn.properties keys."""
+
+    def __init__(
+        self,
+        train: Dataset,
+        top_match_count: int = 5,
+        kernel_function: str = "none",
+        kernel_param: float = 1.0,
+        class_cond_weighted: bool = False,
+        inverse_distance_weighted: bool = False,
+        decision_threshold: float = -1.0,
+        positive_class: Optional[str] = None,
+        metric: str = "manhattan",
+        block: int = 4096,
+        nb_model: Optional[NaiveBayesModel] = None,
+        approx: bool = False,
+    ):
+        self.index = NeighborIndex(train, k=top_match_count, metric=metric,
+                                   block=block, approx=approx)
+        self.schema = train.schema
+        self.k = self.index.k
+        self.kernel = kernel_function
+        self.kernel_param = kernel_param
+        self.class_cond = class_cond_weighted
+        self.inverse_weighted = inverse_distance_weighted
+        self.decision_threshold = decision_threshold
+        self.class_values = train.schema.class_values()
+        self.positive_class = (
+            self.class_values.index(positive_class) if positive_class else 1
+        )
+        pad = self.index.n_padded
+        n_valid = self.index.n_valid
+        labels = np.zeros((pad,), np.int32)
+        labels[:n_valid] = train.labels()
+        self.train_labels = jnp.asarray(labels)
+        self.train_ids = train.ids()
+
+        # class-conditional weighting: P(features_i | class_i) per train row,
+        # the quantity jobs (2)-(4) of the reference pipeline compute + join
+        # (BayesianPredictor bap.output.feature.prob.only=true mode)
+        post = np.ones((pad,), np.float32)
+        if class_cond_weighted:
+            model = nb_model if nb_model is not None else NaiveBayesModel.fit(train)
+            tables = model.finish()
+            codes, _ = train.feature_codes(model.binned_fields)
+            if codes.shape[1]:
+                lp = np.asarray(tables["log_post"])       # [F, K, B]
+                y = train.labels()
+                logp = np.zeros(len(train), np.float64)
+                for f in range(codes.shape[1]):
+                    logp += lp[f, y, codes[:, f]]
+                post[: len(train)] = np.exp(logp).astype(np.float32)
+        self.train_post = jnp.asarray(post)
+
+    # ------------------------------------------------------------- neighbors
+    def neighbors(self, test: Dataset) -> Tuple[np.ndarray, np.ndarray]:
+        """(dist [nq,k], train index [nq,k]) over the real train rows."""
+        return self.index.neighbors(test)
+
+    # --------------------------------------------------------------- predict
+    def predict(self, test: Dataset) -> Tuple[np.ndarray, np.ndarray]:
+        """Returns (predicted class codes [nq], class scores [nq, K])."""
+        dist, idx = self.neighbors(test)
+        neigh_labels = self.train_labels[idx]
+        neigh_post = self.train_post[idx]
+        scores = _vote(
+            dist, neigh_labels, neigh_post,
+            self.kernel, self.kernel_param, len(self.class_values),
+            self.class_cond, self.inverse_weighted,
+        )
+        scores = np.asarray(scores)
+        # the reference's threshold branch exists only in non-class-cond mode
+        # (Neighborhood.classify(), :272-312: weighted path pure-argmaxes)
+        if (self.decision_threshold > 0 and len(self.class_values) == 2
+                and not self.class_cond):
+            pos = self.positive_class
+            neg = 1 - pos
+            ratio = scores[:, pos] / np.maximum(scores[:, neg], 1e-9)
+            pred = np.where(ratio > self.decision_threshold, pos, neg).astype(np.int32)
+        else:
+            pred = scores.argmax(axis=1).astype(np.int32)
+        return pred, scores
+
+    def validate(self, test: Dataset, pos_class: Optional[int] = None) -> ConfusionMatrix:
+        pred, _ = self.predict(test)
+        cm = ConfusionMatrix(
+            self.class_values,
+            pos_class=self.positive_class if pos_class is None else pos_class,
+        )
+        cm.add(test.labels(), pred)
+        return cm
+
+
+class NearestNeighborRegressor:
+    """Regression modes of Neighborhood.doRegression: average / median /
+    per-query simple linear regression (commons-math3 SimpleRegression
+    equivalent via closed-form least squares, vmap'd over queries)."""
+
+    def __init__(
+        self,
+        train: Dataset,
+        target: np.ndarray,
+        top_match_count: int = 5,
+        method: str = "average",
+        regr_input: Optional[np.ndarray] = None,
+        metric: str = "manhattan",
+        block: int = 4096,
+    ):
+        self.index = NeighborIndex(train, k=top_match_count, metric=metric,
+                                   block=block)
+        pad = self.index.n_padded
+        t = np.zeros((pad,), np.float32)
+        t[: len(target)] = np.asarray(target, np.float32)
+        self.target = jnp.asarray(t)
+        self.method = method
+        if regr_input is not None:
+            ri = np.zeros((pad,), np.float32)
+            ri[: len(regr_input)] = np.asarray(regr_input, np.float32)
+            self.regr_input = jnp.asarray(ri)
+        else:
+            self.regr_input = None
+
+    def predict(self, test: Dataset,
+                query_input: Optional[np.ndarray] = None) -> np.ndarray:
+        dist, idx = self.index.neighbors(test)
+        y = self.target[idx]                                    # [nq, k]
+        if self.method == "average":
+            return np.asarray(y.mean(axis=1))
+        if self.method == "median":
+            return np.asarray(jnp.median(y, axis=1))
+        if self.method == "linearRegression":
+            assert self.regr_input is not None and query_input is not None
+            x = self.regr_input[idx]                            # [nq, k]
+            xm = x.mean(axis=1, keepdims=True)
+            ym = y.mean(axis=1, keepdims=True)
+            cov = ((x - xm) * (y - ym)).sum(axis=1)
+            var = ((x - xm) ** 2).sum(axis=1)
+            slope = cov / jnp.maximum(var, 1e-9)
+            intercept = ym[:, 0] - slope * xm[:, 0]
+            return np.asarray(intercept + slope * jnp.asarray(query_input))
+        raise ValueError(f"unknown regression method {self.method}")

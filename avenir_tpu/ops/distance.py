@@ -90,14 +90,6 @@ def pairwise_distance(
     return d_total / n_attr
 
 
-def _merge_topk(best_d, best_i, new_d, new_i, k):
-    """Merge running top-k (smallest distance) with a new candidate block."""
-    cat_d = jnp.concatenate([best_d, new_d], axis=1)
-    cat_i = jnp.concatenate([best_i, new_i], axis=1)
-    neg, pos = lax.top_k(-cat_d, k)           # top_k keeps largest -> negate
-    return -neg, jnp.take_along_axis(cat_i, pos, axis=1)
-
-
 def pad_train(
     t_num: Optional[np.ndarray],
     t_cat: Optional[np.ndarray],
@@ -124,7 +116,7 @@ def pad_train(
     return t_num, t_cat, n
 
 
-@partial(jax.jit, static_argnames=("k", "block", "metric", "cat_bins"))
+@partial(jax.jit, static_argnames=("k", "block", "metric", "cat_bins", "approx"))
 def blocked_topk_neighbors(
     q_num: jnp.ndarray,
     t_num: jnp.ndarray,
@@ -133,37 +125,53 @@ def blocked_topk_neighbors(
     cat_bins: Optional[Tuple[int, ...]] = None,
     num_ranges: Optional[jnp.ndarray] = None,
     k: int = 8,
-    block: int = 4096,
+    block: int = 32768,
     metric: str = "manhattan",
     n_valid: Optional[int] = None,
+    approx: bool = False,
 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """Streaming k-nearest-neighbor search: scan train set in tiles.
 
     Returns (dist [nq, k], index [nq, k]) of the k nearest train rows per
     query row, without materializing the full [nq, nt] matrix. Train rows
-    are processed `block` at a time under lax.scan; the running top-k is the
-    carry. `n_valid` (default: all rows) masks divisibility padding — rows at
-    index >= n_valid get +inf distance and can never enter the top-k; use
-    `pad_train` to pad the arrays."""
+    are processed `block` at a time under lax.scan; each block reduces to k
+    candidates (so the merge works on nblocks*k, not nt). Large blocks
+    amortize the top_k cost — the per-block distance tile [nq, block] is the
+    peak memory. `n_valid` (default: all rows) masks divisibility padding —
+    rows at index >= n_valid get +inf distance and can never enter the
+    top-k; use `pad_train` to pad the arrays. `approx=True` uses the
+    TPU-optimized lax.approx_min_k per block (recall ~0.95+) — exact
+    semantics only off."""
     nt = t_num.shape[0] if t_num is not None else t_cat.shape[0]
     assert nt % block == 0, "pad train rows to a multiple of block (pad_train)"
+    assert k <= block, f"k ({k}) must be <= block ({block})"
     nq = q_num.shape[0] if q_num is not None else q_cat.shape[0]
     nblocks = nt // block
     n_valid_arr = jnp.int32(nt if n_valid is None else n_valid)
 
-    def body(carry, b):
-        best_d, best_i = carry
+    def body(_, b):
         start = b * block
         tn = lax.dynamic_slice_in_dim(t_num, start, block, 0) if t_num is not None else None
         tc = lax.dynamic_slice_in_dim(t_cat, start, block, 0) if t_cat is not None else None
         d = pairwise_distance(q_num, tn, q_cat, tc, cat_bins, num_ranges, metric)
-        idx = start + jnp.arange(block, dtype=jnp.int32)[None, :].repeat(nq, 0)
+        idx = start + jnp.arange(block, dtype=jnp.int32)[None, :]
         d = jnp.where(idx < n_valid_arr, d, jnp.inf)
-        return _merge_topk(best_d, best_i, d, idx, k), None
+        if approx:
+            bd, bpos = lax.approx_min_k(d, k)
+        else:
+            neg, bpos = lax.top_k(-d, k)
+            bd = -neg
+        return 0, (bd, start + bpos.astype(jnp.int32))
 
-    init = (
-        jnp.full((nq, k), jnp.inf, dtype=jnp.float32),
-        jnp.full((nq, k), -1, dtype=jnp.int32),
-    )
-    (dist, idx), _ = lax.scan(body, init, jnp.arange(nblocks))
+    if nblocks == 1:
+        _, (dist, idx) = body(0, jnp.int32(0))
+    else:
+        _, (ds, idxs) = lax.scan(body, 0, jnp.arange(nblocks))
+        # [nblocks, nq, k] -> [nq, nblocks*k] candidate merge
+        ds = jnp.moveaxis(ds, 0, 1).reshape(nq, nblocks * k)
+        idxs = jnp.moveaxis(idxs, 0, 1).reshape(nq, nblocks * k)
+        neg, pos = lax.top_k(-ds, k)
+        dist, idx = -neg, jnp.take_along_axis(idxs, pos, axis=1)
+    # unfillable slots (n_valid < k): -1 sentinel instead of phantom rows
+    idx = jnp.where(jnp.isinf(dist), -1, idx)
     return dist, idx

@@ -1,0 +1,149 @@
+"""Benchmark: Naive Bayes + KNN throughput on the local chip.
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+
+Workloads (the BASELINE.json north-star configs #1/#2):
+- Naive Bayes churn: sufficient-stat training pass + posterior predict pass
+  over encoded rows (one-hot einsum contractions on the MXU).
+- KNN elearn: blocked streaming top-k (euclidean = matmul path) queries
+  against a train corpus, kernel vote included.
+
+value = harmonic mean of NB rows/sec and KNN query rows/sec — the rate of a
+pipeline that runs every row through both model families, per chip.
+
+vs_baseline: the reference publishes no numbers (BASELINE.md); the
+north-star target is >=50x a 32-node Hadoop cluster on NB+KNN. The two
+workloads have very different per-row cost, so vs_baseline is the geometric
+mean of per-workload speedups against documented per-workload estimates of
+the 32-node Hadoop reference:
+- NB scan: 1.0e6 rows/sec (32 nodes x ~31k rows/sec/node; generous for
+  MR with an HDFS round trip per job).
+- KNN: sifarish SameTypeSimilarity computes all pair distances in JVM text
+  records; assume 1e6 pair-distances/sec/node = 3.2e7 pairs/sec for 32
+  nodes; at this bench's corpus size (KNN_TRAIN) that is
+  3.2e7 / KNN_TRAIN queries/sec (~244 q/s).
+"""
+
+import json
+import sys
+import time
+
+import numpy as np
+
+HADOOP_NB_ROWS_PER_SEC = 1.0e6
+HADOOP_PAIR_DIST_PER_SEC = 3.2e7
+
+NB_ROWS = 1_000_000
+NB_ITERS = 5
+KNN_QUERIES = 8_192
+KNN_TRAIN = 131_072
+KNN_ITERS = 3
+KNN_K = 5
+KNN_BLOCK = 32_768
+KNN_DIM = 8
+
+
+def bench_naive_bayes():
+    import jax
+    import jax.numpy as jnp
+    from avenir_tpu.data import generate_churn
+    from avenir_tpu.models.naive_bayes import (
+        NaiveBayesModel,
+        NaiveBayesPredictor,
+        _count_batch_kernel,
+    )
+
+    base = generate_churn(100_000, seed=1)
+    model = NaiveBayesModel.fit(base)
+    codes_small, bins = base.feature_codes(model.binned_fields)
+    reps = NB_ROWS // len(base)
+    codes = np.tile(codes_small, (reps, 1))
+    labels = np.tile(base.labels(), reps)
+    n = codes.shape[0]
+    k, bmax = 2, max(bins)
+
+    codes_d = jnp.asarray(codes)
+    labels_d = jnp.asarray(labels)
+    w = jnp.ones((n,), jnp.float32)
+    x_cont = jnp.zeros((n, 0), jnp.float32)
+
+    # train pass
+    out = _count_batch_kernel(codes_d, labels_d, x_cont, w, k, bmax)
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for _ in range(NB_ITERS):
+        out = _count_batch_kernel(codes_d, labels_d, x_cont, w, k, bmax)
+    jax.block_until_ready(out)
+    train_rps = n * NB_ITERS / (time.perf_counter() - t0)
+
+    # predict pass
+    pred = NaiveBayesPredictor(model)
+    out = pred._predict(codes_d, x_cont, pred.tables)
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for _ in range(NB_ITERS):
+        out = pred._predict(codes_d, x_cont, pred.tables)
+    jax.block_until_ready(out)
+    predict_rps = n * NB_ITERS / (time.perf_counter() - t0)
+
+    # a "row processed" = trained on + predicted once
+    rps = 1.0 / (1.0 / train_rps + 1.0 / predict_rps)
+    return train_rps, predict_rps, rps
+
+
+def bench_knn():
+    import jax
+    import jax.numpy as jnp
+    from avenir_tpu.models.knn import _vote
+    from avenir_tpu.ops.distance import blocked_topk_neighbors
+
+    rng = np.random.default_rng(2)
+    q = jnp.asarray(rng.normal(size=(KNN_QUERIES, KNN_DIM)).astype(np.float32))
+    t = jnp.asarray(rng.normal(size=(KNN_TRAIN, KNN_DIM)).astype(np.float32))
+    t_labels = jnp.asarray(rng.integers(0, 2, KNN_TRAIN).astype(np.int32))
+
+    def step():
+        dist, idx = blocked_topk_neighbors(
+            q, t, k=KNN_K, block=KNN_BLOCK, metric="euclidean"
+        )
+        scores = _vote(dist, t_labels[idx], jnp.ones_like(dist),
+                       "gaussian", 30.0, 2, False, False)
+        return scores
+
+    out = step()
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for _ in range(KNN_ITERS):
+        out = step()
+    jax.block_until_ready(out)
+    qps = KNN_QUERIES * KNN_ITERS / (time.perf_counter() - t0)
+    return qps
+
+
+def main():
+    import jax
+
+    dev = jax.devices()[0]
+    train_rps, predict_rps, nb_rps = bench_naive_bayes()
+    knn_qps = bench_knn()
+    combined = 2.0 / (1.0 / nb_rps + 1.0 / knn_qps)
+    nb_speedup = nb_rps / HADOOP_NB_ROWS_PER_SEC
+    knn_speedup = knn_qps / (HADOOP_PAIR_DIST_PER_SEC / KNN_TRAIN)
+    vs_baseline = float(np.sqrt(nb_speedup * knn_speedup))
+    print(
+        f"# device={dev.device_kind} nb_train={train_rps:.3e} "
+        f"nb_predict={predict_rps:.3e} nb={nb_rps:.3e} knn={knn_qps:.3e} rows/s "
+        f"nb_speedup={nb_speedup:.1f}x knn_speedup={knn_speedup:.1f}x",
+        file=sys.stderr,
+    )
+    print(json.dumps({
+        "metric": "nb_knn_rows_per_sec_per_chip",
+        "value": round(combined, 1),
+        "unit": "rows/sec",
+        "vs_baseline": round(vs_baseline, 2),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,130 @@
+"""KNN vs NumPy oracle: neighbor sets, kernel votes, regression modes."""
+
+import numpy as np
+import pytest
+
+from avenir_tpu.data import generate_elearn, generate_churn
+from avenir_tpu.models.knn import (
+    KERNEL_SCALE,
+    NearestNeighborClassifier,
+    NearestNeighborRegressor,
+)
+
+
+@pytest.fixture(scope="module")
+def elearn_train():
+    return generate_elearn(800, seed=1)
+
+
+@pytest.fixture(scope="module")
+def elearn_test():
+    return generate_elearn(100, seed=2)
+
+
+def _oracle_knn(train, test, k):
+    """Manhattan avg-per-attribute distance + top-k (numpy)."""
+    xt = train.feature_matrix()
+    xq = test.feature_matrix()
+    rng = np.array([100.0] * xt.shape[1], dtype=np.float32)
+    d = np.abs(xq[:, None, :] / rng - xt[None, :, :] / rng).sum(-1) / xt.shape[1]
+    idx = np.argsort(d, axis=1, kind="stable")[:, :k]
+    return np.take_along_axis(d, idx, axis=1), idx
+
+
+class TestClassification:
+    def test_neighbor_sets_match_oracle(self, elearn_train, elearn_test):
+        clf = NearestNeighborClassifier(elearn_train, top_match_count=5, block=128)
+        dist, idx = clf.neighbors(elearn_test)
+        od, oidx = _oracle_knn(elearn_train, elearn_test, 5)
+        np.testing.assert_allclose(np.sort(dist, 1), od, atol=1e-5)
+        for r in range(len(elearn_test)):
+            assert set(np.asarray(idx[r])) == set(oidx[r])
+
+    def test_majority_vote_accuracy(self, elearn_train, elearn_test):
+        clf = NearestNeighborClassifier(elearn_train, top_match_count=5, block=128)
+        cm = clf.validate(elearn_test)
+        assert cm.accuracy() > 0.9  # well-separated clusters
+
+    @pytest.mark.parametrize(
+        "kernel", ["none", "linearMultiplicative", "linearAdditive", "gaussian"]
+    )
+    def test_kernels_match_reference_formulas(self, kernel, elearn_train, elearn_test):
+        clf = NearestNeighborClassifier(
+            elearn_train, top_match_count=5, kernel_function=kernel,
+            kernel_param=30.0, block=128,
+        )
+        dist, idx = clf.neighbors(elearn_test)
+        y = np.asarray(clf.train_labels)[np.asarray(idx)]
+        d = np.floor(np.asarray(dist) * KERNEL_SCALE)
+        if kernel == "none":
+            s = np.ones_like(d)
+        elif kernel == "linearMultiplicative":
+            s = np.where(d == 0, 200.0, np.floor(KERNEL_SCALE / np.maximum(d, 1)))
+        elif kernel == "linearAdditive":
+            s = KERNEL_SCALE - d
+        else:
+            s = np.floor(KERNEL_SCALE * np.exp(-0.5 * (d / 30.0) ** 2))
+        expect = np.zeros((len(elearn_test), 2))
+        for q in range(len(elearn_test)):
+            for j in range(5):
+                expect[q, y[q, j]] += s[q, j]
+        _, scores = clf.predict(elearn_test)
+        np.testing.assert_allclose(scores, expect, rtol=1e-5)
+
+    def test_mixed_categorical_numeric(self):
+        train = generate_churn(400, seed=8)
+        test = generate_churn(80, seed=9)
+        clf = NearestNeighborClassifier(train, top_match_count=7, block=64)
+        cm = clf.validate(test, pos_class=1)
+        assert cm.accuracy() > 0.7
+
+    def test_class_cond_weighting_runs(self, elearn_train, elearn_test):
+        train = generate_churn(400, seed=8)
+        test = generate_churn(80, seed=9)
+        clf = NearestNeighborClassifier(
+            train, top_match_count=7, class_cond_weighted=True, block=64
+        )
+        pred, scores = clf.predict(test)
+        assert scores.shape == (80, 2) and (scores >= 0).all()
+
+    def test_decision_threshold(self):
+        train = generate_churn(400, seed=8)
+        test = generate_churn(80, seed=9)
+        lo = NearestNeighborClassifier(
+            train, top_match_count=7, decision_threshold=0.1,
+            positive_class="closed", block=64,
+        ).predict(test)[0]
+        hi = NearestNeighborClassifier(
+            train, top_match_count=7, decision_threshold=10.0,
+            positive_class="closed", block=64,
+        ).predict(test)[0]
+        # low threshold -> more positives than high threshold
+        assert (lo == 1).sum() > (hi == 1).sum()
+
+
+class TestRegression:
+    def test_average_and_median(self, elearn_train, elearn_test):
+        target = elearn_train.feature_matrix()[:, 0] * 2.0
+        reg = NearestNeighborRegressor(
+            elearn_train, target, top_match_count=5, method="average", block=128
+        )
+        pred = reg.predict(elearn_test)
+        # neighbors are nearby in feature space -> prediction tracks 2*act0
+        true = elearn_test.feature_matrix()[:, 0] * 2.0
+        assert np.corrcoef(pred, true)[0, 1] > 0.95
+
+        med = NearestNeighborRegressor(
+            elearn_train, target, top_match_count=5, method="median", block=128
+        ).predict(elearn_test)
+        assert np.corrcoef(med, true)[0, 1] > 0.95
+
+    def test_linear_regression_mode(self, elearn_train, elearn_test):
+        x_in = elearn_train.feature_matrix()[:, 0]
+        target = 3.0 * x_in + 1.0          # exact linear relation
+        reg = NearestNeighborRegressor(
+            elearn_train, target, top_match_count=5,
+            method="linearRegression", regr_input=x_in, block=128,
+        )
+        q = elearn_test.feature_matrix()[:, 0]
+        pred = reg.predict(elearn_test, query_input=q)
+        np.testing.assert_allclose(pred, 3.0 * q + 1.0, rtol=1e-3, atol=1e-2)

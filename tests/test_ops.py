@@ -142,3 +142,49 @@ class TestMeshSharding:
         xs = shard_rows(mesh8, x)
         assert xs.shape[0] == 16
         np.testing.assert_array_equal(np.array(xs)[:13], x)
+
+
+class TestTopkEdgeCases:
+    def test_single_block_path(self, rng):
+        q = jnp.asarray(rng.normal(size=(4, 3)).astype(np.float32))
+        t = jnp.asarray(rng.normal(size=(16, 3)).astype(np.float32))
+        d1, i1 = blocked_topk_neighbors(q, t, k=3, block=16)       # nblocks==1
+        d2, i2 = blocked_topk_neighbors(q, t, k=3, block=8)        # nblocks==2
+        np.testing.assert_allclose(np.sort(d1, 1), np.sort(d2, 1), atol=1e-6)
+        for r in range(4):
+            assert set(np.asarray(i1[r])) == set(np.asarray(i2[r]))
+
+    def test_approx_path_sorted_and_high_recall(self, rng):
+        q = jnp.asarray(rng.normal(size=(32, 4)).astype(np.float32))
+        t = jnp.asarray(rng.normal(size=(2048, 4)).astype(np.float32))
+        de, ie = blocked_topk_neighbors(q, t, k=5, block=512, metric="euclidean")
+        da, ia = blocked_topk_neighbors(
+            q, t, k=5, block=512, metric="euclidean", approx=True
+        )
+        assert (np.diff(np.asarray(da), axis=1) >= -1e-6).all()
+        recall = np.mean([
+            len(set(np.asarray(ie[r])) & set(np.asarray(ia[r]))) / 5
+            for r in range(32)
+        ])
+        assert recall > 0.9
+
+    def test_unfillable_slots_get_sentinel(self, rng):
+        from avenir_tpu.ops.distance import pad_train
+
+        t = rng.normal(size=(3, 2)).astype(np.float32)
+        tn, _, n_valid = pad_train(t, None, block=8)
+        q = jnp.asarray(rng.normal(size=(2, 2)).astype(np.float32))
+        d, i = blocked_topk_neighbors(
+            q, jnp.asarray(tn), k=6, block=8, n_valid=n_valid
+        )
+        i = np.asarray(i)
+        d = np.asarray(d)
+        assert (i[:, :3] >= 0).all() and (i[:, :3] < 3).all()
+        assert (i[:, 3:] == -1).all()
+        assert np.isinf(d[:, 3:]).all()
+
+    def test_k_above_block_asserts(self, rng):
+        q = jnp.asarray(rng.normal(size=(2, 2)).astype(np.float32))
+        t = jnp.asarray(rng.normal(size=(8, 2)).astype(np.float32))
+        with pytest.raises(AssertionError, match="block"):
+            blocked_topk_neighbors(q, t, k=16, block=8)
