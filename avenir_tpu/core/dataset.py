@@ -201,3 +201,30 @@ class Dataset:
 def pad_rows(n: int, multiple: int) -> int:
     """Rows padded up to a multiple (device shard divisibility)."""
     return ((n + multiple - 1) // multiple) * multiple
+
+
+def extract_mixed_features(ds: "Dataset"):
+    """Split a dataset into distance-ready arrays: (x_num float32 [n, Dn],
+    ranges float32 [Dn], x_cat int32 [n, Dc] | None, cat_bins tuple | None).
+
+    Ranges come from the schema's declared min/max (1.0 fallback) — the
+    normalization the mixed-attribute distance metric uses. Shared by KNN,
+    clustering and Relief so the convention lives in one place."""
+    num_fields = [f for f in ds.schema.feature_fields if f.is_numeric]
+    cat_fields = [f for f in ds.schema.feature_fields if f.is_categorical]
+    x_num = ds.feature_matrix(num_fields)
+    ranges = np.array(
+        [
+            (f.max - f.min) if (f.max is not None and f.min is not None) else 1.0
+            for f in num_fields
+        ],
+        dtype=np.float32,
+    )
+    if cat_fields:
+        x_cat = np.stack(
+            [ds.column(f.ordinal).astype(np.int32) for f in cat_fields], axis=1
+        )
+        bins = tuple(len(f.cardinality) for f in cat_fields)
+    else:
+        x_cat, bins = None, None
+    return x_num, ranges, x_cat, bins

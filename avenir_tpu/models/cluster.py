@@ -1,0 +1,223 @@
+"""Clustering: agglomerative (graph distance based), k-means, DBSCAN.
+
+Reference surface:
+- cluster/AgglomerativeGraphical.java:43 — greedy agglomerative clustering
+  over precomputed pairwise distances (EntityDistanceMapFileAccessor);
+  cluster membership by average edge weight (EdgeWeightedCluster.java:32).
+- python/unsupv/cluster.py — scikit KMeans / AgglomerativeClustering /
+  DBSCAN with model selection by cohesion + inter-cluster distance.
+
+TPU design: k-means is the device-native one — Lloyd iterations are one
+distance matmul + segment_sum per step under jit. Agglomerative and DBSCAN
+operate on a (device-computed) distance matrix with host merge loops, like
+the reference's file-of-distances design.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from avenir_tpu.core.dataset import Dataset
+from avenir_tpu.ops.distance import pairwise_distance
+
+_EPS = 1e-9
+
+
+# ---------------------------------------------------------------------------
+# k-means (Lloyd under jit)
+# ---------------------------------------------------------------------------
+
+
+@partial(jax.jit, static_argnames=("k",))
+def _kmeans_step(x, centers, k: int):
+    d2 = (
+        jnp.sum(x * x, axis=1)[:, None]
+        + jnp.sum(centers * centers, axis=1)[None, :]
+        - 2.0 * x @ centers.T
+    )
+    assign = jnp.argmin(d2, axis=1)
+    sums = jax.ops.segment_sum(x, assign, num_segments=k)
+    cnts = jax.ops.segment_sum(jnp.ones(x.shape[0]), assign, num_segments=k)
+    new_centers = sums / jnp.maximum(cnts[:, None], 1.0)
+    # keep empty clusters where they were
+    new_centers = jnp.where(cnts[:, None] > 0, new_centers, centers)
+    inertia = jnp.sum(jnp.min(d2, axis=1))
+    return new_centers, assign, inertia
+
+
+class KMeans:
+    def __init__(self, k: int, iters: int = 50, seed: int = 0, tol: float = 1e-5):
+        self.k = k
+        self.iters = iters
+        self.seed = seed
+        self.tol = tol
+
+    def fit(self, x: np.ndarray) -> "KMeans":
+        rng = np.random.default_rng(self.seed)
+        x = np.asarray(x, np.float32)
+        init = x[rng.choice(len(x), self.k, replace=False)]
+        centers = jnp.asarray(init)
+        xd = jnp.asarray(x)
+        prev_inertia = np.inf
+        for _ in range(self.iters):
+            centers, assign, inertia = _kmeans_step(xd, centers, self.k)
+            if abs(prev_inertia - float(inertia)) < self.tol * max(float(inertia), 1.0):
+                break
+            prev_inertia = float(inertia)
+        self.centers = np.asarray(centers)
+        self.labels_ = np.asarray(assign)
+        self.inertia_ = float(inertia)
+        return self
+
+    def predict(self, x: np.ndarray) -> np.ndarray:
+        d2 = ((np.asarray(x)[:, None, :] - self.centers[None]) ** 2).sum(-1)
+        return d2.argmin(axis=1)
+
+
+# ---------------------------------------------------------------------------
+# agglomerative (average linkage over a distance matrix)
+# ---------------------------------------------------------------------------
+
+
+class AgglomerativeGraphical:
+    """Greedy agglomerative merging over pairwise distances with an
+    average-edge-weight membership criterion (AgglomerativeGraphical.java:43,
+    EdgeWeightedCluster.java:32): merge the closest pair of clusters while
+    the resulting cluster's average intra-edge distance stays below
+    `max_avg_distance`, up to `num_clusters`."""
+
+    def __init__(self, num_clusters: int = 2,
+                 max_avg_distance: Optional[float] = None):
+        self.num_clusters = num_clusters
+        self.max_avg_distance = max_avg_distance
+
+    def fit(self, dist: np.ndarray) -> "AgglomerativeGraphical":
+        n = dist.shape[0]
+        clusters: Dict[int, List[int]] = {i: [i] for i in range(n)}
+        d = dist.astype(np.float64).copy()
+        np.fill_diagonal(d, np.inf)
+        cd = {(i, j): d[i, j] for i in range(n) for j in range(i + 1, n)}
+
+        def avg_intra(members: List[int]) -> float:
+            if len(members) < 2:
+                return 0.0
+            s = cnt = 0
+            for a in range(len(members)):
+                for b in range(a + 1, len(members)):
+                    s += dist[members[a], members[b]]
+                    cnt += 1
+            return s / cnt
+
+        while len(clusters) > self.num_clusters:
+            (i, j), _ = min(
+                ((pair, val) for pair, val in cd.items()
+                 if pair[0] in clusters and pair[1] in clusters),
+                key=lambda kv: kv[1],
+            )
+            merged = clusters[i] + clusters[j]
+            if (self.max_avg_distance is not None
+                    and avg_intra(merged) > self.max_avg_distance):
+                break
+            del clusters[j]
+            clusters[i] = merged
+            # average linkage update
+            for k in list(clusters):
+                if k == i:
+                    continue
+                a, b = min(i, k), max(i, k)
+                pairs = [(x, y) for x in clusters[i] for y in clusters[k]]
+                cd[(a, b)] = float(np.mean([dist[x, y] for x, y in pairs]))
+
+        self.labels_ = np.zeros(n, np.int32)
+        for li, members in enumerate(clusters.values()):
+            for m in members:
+                self.labels_[m] = li
+        return self
+
+
+# ---------------------------------------------------------------------------
+# DBSCAN
+# ---------------------------------------------------------------------------
+
+
+class DBSCAN:
+    """Density clustering over a distance matrix (python/unsupv/cluster.py
+    parity). Noise points get label -1."""
+
+    def __init__(self, eps: float, min_samples: int = 4):
+        self.eps = eps
+        self.min_samples = min_samples
+
+    def fit(self, dist: np.ndarray) -> "DBSCAN":
+        n = dist.shape[0]
+        neigh = [np.flatnonzero(dist[i] <= self.eps) for i in range(n)]
+        core = np.array([len(nb) >= self.min_samples for nb in neigh])
+        labels = np.full(n, -1, np.int32)
+        cid = 0
+        for i in range(n):
+            if labels[i] != -1 or not core[i]:
+                continue
+            stack = [i]
+            labels[i] = cid
+            while stack:
+                p = stack.pop()
+                for q in neigh[p]:
+                    if labels[q] == -1:
+                        labels[q] = cid
+                        if core[q]:
+                            stack.append(q)
+            cid += 1
+        self.labels_ = labels
+        return self
+
+
+# ---------------------------------------------------------------------------
+# model selection metrics (python/unsupv/cluster.py cohesion / separation)
+# ---------------------------------------------------------------------------
+
+
+def cohesion(x: np.ndarray, labels: np.ndarray) -> float:
+    """Mean distance to own-cluster centroid (lower = tighter)."""
+    total = 0.0
+    for c in np.unique(labels[labels >= 0]):
+        members = x[labels == c]
+        centroid = members.mean(axis=0)
+        total += np.linalg.norm(members - centroid, axis=1).sum()
+    valid = (labels >= 0).sum()
+    return total / max(valid, 1)
+
+
+def inter_cluster_distance(x: np.ndarray, labels: np.ndarray) -> float:
+    """Mean pairwise centroid distance (higher = better separated)."""
+    cents = [x[labels == c].mean(axis=0) for c in np.unique(labels[labels >= 0])]
+    if len(cents) < 2:
+        return 0.0
+    tot = cnt = 0
+    for a in range(len(cents)):
+        for b in range(a + 1, len(cents)):
+            tot += np.linalg.norm(cents[a] - cents[b])
+            cnt += 1
+    return tot / cnt
+
+
+def dataset_distance_matrix(ds: Dataset, metric: str = "euclidean") -> np.ndarray:
+    """Device-computed mixed-attribute distance matrix for the host
+    clustering algorithms (the EntityDistanceMapFileAccessor role)."""
+    from avenir_tpu.core.dataset import extract_mixed_features
+
+    x_num, ranges, x_cat, bins = extract_mixed_features(ds)
+    d = pairwise_distance(
+        jnp.asarray(x_num),
+        jnp.asarray(x_num),
+        jnp.asarray(x_cat) if x_cat is not None else None,
+        jnp.asarray(x_cat) if x_cat is not None else None,
+        cat_bins=bins,
+        num_ranges=jnp.asarray(ranges) if ranges.size else None,
+        metric=metric,
+    )
+    return np.asarray(d)

@@ -1,0 +1,453 @@
+"""Exploration / feature-selection suite (org.avenir.explore re-designed).
+
+Every job in the reference package is a contingency-table or moment
+reduction over records: mutual information + selection scores
+(MutualInformation.java, MutualInformationScore.java), Cramér / categorical
+/ heterogeneity-reduction / numerical correlation, Relief feature relevance,
+per-value class affinity, supervised categorical->continuous encoding,
+class-balancing samplers. On TPU each is one or two one-hot einsum
+contractions (cross_count) producing small count tensors, with the greedy
+selection loops on host over those tiny tables.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from avenir_tpu.core.dataset import Dataset
+from avenir_tpu.core.schema import FeatureField
+from avenir_tpu.ops.infotheory import bits_entropy, entropy, gini, mutual_information
+from avenir_tpu.ops.reduce import cross_count
+
+_EPS = 1e-12
+
+
+# ---------------------------------------------------------------------------
+# mutual information + feature selection scores
+# ---------------------------------------------------------------------------
+
+
+class MutualInformationAnalyzer:
+    """MutualInformation MR job equivalent (MutualInformation.java:62).
+
+    One device pass builds all the distributions the reducer held in memory
+    (class, feature, feature-pair, feature-class, feature-pair-class,
+    MutualInformation.java:138-216); the score algorithms are the greedy
+    loops of MutualInformationScore.java over those tables:
+      mutual.info.maximization (MIM)        :98
+      mutual.info.selection (MIFS, beta)    :116-140
+      joint.mutual.info (JMI)               :177
+      double.input.symmetric.relevance(DISR):185-229
+      min.redundancy.max.relevance (mRMR)   :265-288
+    MI values are in nats (reference uses log base e via Math.log).
+    """
+
+    def __init__(self, ds: Dataset):
+        self.ds = ds
+        codes, bins = ds.feature_codes()
+        self.fields = ds.encodable_feature_fields()
+        self.bins = bins
+        self.codes = codes
+        self.labels = ds.labels()
+        self.k = ds.schema.num_classes()
+        self.n = len(ds)
+        self._compute()
+
+    def _compute(self):
+        codes_d = jnp.asarray(self.codes)
+        y = jnp.asarray(self.labels)
+        F = len(self.bins)
+        self.feature_class_mi = np.zeros(F)
+        self.pair_mi = np.zeros((F, F))
+        self.pair_class_mi = np.zeros((F, F))
+        self.pair_class_entropy = np.zeros((F, F))
+
+        # feature-class MI: I(Xf; C) from [Bf, K] contingency
+        for f in range(F):
+            joint = cross_count(codes_d[:, f], y, self.bins[f], self.k)
+            self.feature_class_mi[f] = float(mutual_information(joint))
+
+        # pair MI I(Xi; Xj) and pair-class I((Xi,Xj); C), H(Xi,Xj,C)
+        for i in range(F):
+            for j in range(i + 1, F):
+                bi, bj = self.bins[i], self.bins[j]
+                joint_ij = cross_count(codes_d[:, i], codes_d[:, j], bi, bj)
+                mi_ij = float(mutual_information(joint_ij))
+                self.pair_mi[i, j] = self.pair_mi[j, i] = mi_ij
+                # combined code (i,j) vs class
+                comb = codes_d[:, i] * bj + codes_d[:, j]
+                joint_ijc = cross_count(comb, y, bi * bj, self.k)
+                mic = float(mutual_information(joint_ijc))
+                self.pair_class_mi[i, j] = self.pair_class_mi[j, i] = mic
+                h = float(entropy(jnp.asarray(joint_ijc).reshape(-1), axis=-1))
+                self.pair_class_entropy[i, j] = self.pair_class_entropy[j, i] = h
+
+    # ------------------------------------------------------------- scores
+    def _ordinals(self) -> List[int]:
+        return [f.ordinal for f in self.fields]
+
+    def mim(self) -> List[Tuple[int, float]]:
+        """Max relevance: features sorted by I(Xf; C) descending."""
+        order = np.argsort(-self.feature_class_mi)
+        ords = self._ordinals()
+        return [(ords[i], float(self.feature_class_mi[i])) for i in order]
+
+    def mifs(self, redundancy_factor: float = 1.0) -> List[Tuple[int, float]]:
+        """Greedy: score = I(Xf;C) - beta * sum_{s in selected} I(Xf;Xs)."""
+        F = len(self.bins)
+        selected: List[int] = []
+        out = []
+        while len(selected) < F:
+            best, best_score = -1, -np.inf
+            for f in range(F):
+                if f in selected:
+                    continue
+                red = sum(self.pair_mi[f, s] for s in selected)
+                score = self.feature_class_mi[f] - redundancy_factor * red
+                if score > best_score:
+                    best, best_score = f, score
+            selected.append(best)
+            out.append((self._ordinals()[best], float(best_score)))
+        return out
+
+    def _jmi_helper(self, joint: bool) -> List[Tuple[int, float]]:
+        F = len(self.bins)
+        first = int(np.argmax(self.feature_class_mi))
+        selected = [first]
+        out = [(self._ordinals()[first], float(self.feature_class_mi[first]))]
+        while len(selected) < F:
+            best, best_score = -1, -np.inf
+            for f in range(F):
+                if f in selected:
+                    continue
+                if joint:
+                    s_sum = sum(self.pair_class_mi[f, s] for s in selected)
+                else:
+                    s_sum = sum(
+                        self.pair_class_mi[f, s]
+                        / max(self.pair_class_entropy[f, s], _EPS)
+                        for s in selected
+                    )
+                if s_sum > best_score:
+                    best, best_score = f, s_sum
+            selected.append(best)
+            out.append((self._ordinals()[best], float(best_score)))
+        return out
+
+    def jmi(self) -> List[Tuple[int, float]]:
+        """Joint mutual information selection."""
+        return self._jmi_helper(True)
+
+    def disr(self) -> List[Tuple[int, float]]:
+        """Double-input symmetric relevance (JMI normalized by pair entropy)."""
+        return self._jmi_helper(False)
+
+    def mrmr(self) -> List[Tuple[int, float]]:
+        """Greedy: score = I(Xf;C) - mean_{s in selected} I(Xf;Xs)."""
+        F = len(self.bins)
+        selected: List[int] = []
+        out = []
+        while len(selected) < F:
+            best, best_score = -1, -np.inf
+            for f in range(F):
+                if f in selected:
+                    continue
+                red = sum(self.pair_mi[f, s] for s in selected)
+                score = (
+                    self.feature_class_mi[f] - red / len(selected)
+                    if selected else self.feature_class_mi[f]
+                )
+                if score > best_score:
+                    best, best_score = f, score
+            selected.append(best)
+            out.append((self._ordinals()[best], float(best_score)))
+        return out
+
+    def score(self, algorithm: str, redundancy_factor: float = 1.0):
+        """Dispatch by the reference's mut.* algorithm names."""
+        return {
+            "mutual.info.maximization": self.mim,
+            "mutual.info.selection": lambda: self.mifs(redundancy_factor),
+            "joint.mutual.info": self.jmi,
+            "double.input.symmetric.relevance": self.disr,
+            "min.redundancy.max.relevance": self.mrmr,
+        }[algorithm]()
+
+
+# ---------------------------------------------------------------------------
+# correlations
+# ---------------------------------------------------------------------------
+
+
+def contingency(ds: Dataset, fld: FeatureField) -> np.ndarray:
+    """[Bf, K] feature-value x class count table (one one-hot matmul)."""
+    codes, _ = ds.feature_codes([fld])
+    return np.asarray(cross_count(
+        jnp.asarray(codes[:, 0]), jnp.asarray(ds.labels()),
+        fld.num_bins(), ds.schema.num_classes(),
+    ))
+
+
+def cramer_index(table: np.ndarray) -> float:
+    """Cramér index V^2 = chi2 / (n * min(r-1, c-1))
+    (CramerCorrelation.java via chombo ContingencyMatrix)."""
+    n = table.sum()
+    if n == 0:
+        return 0.0
+    row = table.sum(axis=1, keepdims=True)
+    col = table.sum(axis=0, keepdims=True)
+    expected = row @ col / n
+    chi2 = float(np.where(expected > 0,
+                          (table - expected) ** 2 / np.maximum(expected, _EPS),
+                          0.0).sum())
+    r, c = table.shape
+    denom = n * max(min(r - 1, c - 1), 1)
+    return chi2 / denom
+
+
+def cramer_correlation(ds: Dataset) -> Dict[int, float]:
+    """Per-categorical-feature Cramér index against the class attribute."""
+    return {
+        f.ordinal: cramer_index(contingency(ds, f))
+        for f in ds.schema.feature_fields if f.num_bins() > 0
+    }
+
+
+def heterogeneity_reduction(ds: Dataset, algo: str = "entropy") -> Dict[int, float]:
+    """Proportional impurity reduction of the class by each feature
+    (HeterogeneityReductionCorrelation.java:38):
+    (imp(C) - sum_b p(b) imp(C|b)) / imp(C)."""
+    imp_fn = bits_entropy if algo == "entropy" else gini
+    y = jnp.asarray(ds.labels())
+    k = ds.schema.num_classes()
+    class_counts = np.asarray(jax.ops.segment_sum(
+        jnp.ones_like(y, dtype=jnp.float32), y, num_segments=k))
+    base = float(np.asarray(imp_fn(jnp.asarray(class_counts))))
+    out = {}
+    for f in ds.schema.feature_fields:
+        if f.num_bins() <= 0:
+            continue
+        tab = contingency(ds, f)                      # [B, K]
+        seg_tot = tab.sum(axis=1)
+        seg_imp = np.asarray(imp_fn(jnp.asarray(tab), axis=-1))
+        cond = float((seg_tot / max(seg_tot.sum(), _EPS) * seg_imp).sum())
+        out[f.ordinal] = (base - cond) / max(base, _EPS)
+    return out
+
+
+def numerical_correlation(ds: Dataset) -> np.ndarray:
+    """Pearson correlation matrix over numeric features + numeric-coded
+    class, via a single moment pass (NumericalCorrelation.java:48)."""
+    x = ds.feature_matrix()
+    y = ds.labels().astype(np.float32)[:, None]
+    m = np.concatenate([x, y], axis=1)
+    return np.corrcoef(m, rowvar=False)
+
+
+# ---------------------------------------------------------------------------
+# Relief feature relevance
+# ---------------------------------------------------------------------------
+
+
+def relief_relevance(
+    ds: Dataset,
+    sample_size: Optional[int] = None,
+    seed: int = 0,
+) -> Dict[int, float]:
+    """Relief: w_f += diff_f(x, nearest miss) - diff_f(x, nearest hit),
+    averaged over sampled records (ReliefFeatureRelevance.java:49).
+
+    Vectorized: all-pairs distances within the (sampled) set; hit = nearest
+    same-class other record, miss = nearest other-class record. Features are
+    range-normalized like the reference's metric."""
+    n = len(ds)
+    rng = np.random.default_rng(seed)
+    idx = (np.arange(n) if sample_size is None or sample_size >= n
+           else rng.choice(n, sample_size, replace=False))
+    sub = ds.take(idx)
+    y = sub.labels()
+
+    num_fields = [f for f in ds.schema.feature_fields if f.is_numeric]
+    cat_fields = [f for f in ds.schema.feature_fields if f.is_categorical]
+    xs = []
+    per_feature_diff = []  # list of [m, m] diff matrices per feature
+    m = len(sub)
+    for f in num_fields:
+        col = sub.column(f.ordinal).astype(np.float32)
+        rngf = (f.max - f.min) if f.max is not None and f.min is not None else (
+            col.max() - col.min() or 1.0)
+        d = np.abs(col[:, None] - col[None, :]) / max(rngf, _EPS)
+        per_feature_diff.append((f.ordinal, d))
+    for f in cat_fields:
+        col = sub.column(f.ordinal).astype(np.int64)
+        d = (col[:, None] != col[None, :]).astype(np.float32)
+        per_feature_diff.append((f.ordinal, d))
+
+    total = sum(d for _, d in per_feature_diff) / max(len(per_feature_diff), 1)
+    np.fill_diagonal(total, np.inf)
+    same = y[:, None] == y[None, :]
+    d_hit = np.where(same, total, np.inf)
+    d_miss = np.where(~same, total, np.inf)
+    hit = d_hit.argmin(axis=1)
+    miss = d_miss.argmin(axis=1)
+
+    weights = {}
+    rows = np.arange(m)
+    for ordn, d in per_feature_diff:
+        weights[ordn] = float((d[rows, miss] - d[rows, hit]).mean())
+    return weights
+
+
+# ---------------------------------------------------------------------------
+# class affinity + supervised encoding
+# ---------------------------------------------------------------------------
+
+
+def class_affinity(ds: Dataset, fld: FeatureField, top_n: int = 3
+                   ) -> Dict[str, List[Tuple[str, float]]]:
+    """Per class: top-n categorical values by P(value | class)
+    (CategoricalClassAffinity.java:51)."""
+    tab = contingency(ds, fld)                        # [B, K]
+    cls_tot = tab.sum(axis=0)
+    out = {}
+    for ki, cv in enumerate(ds.schema.class_values()):
+        p = tab[:, ki] / max(cls_tot[ki], _EPS)
+        order = np.argsort(-p)[:top_n]
+        out[cv] = [(fld.cardinality[b], float(p[b])) for b in order]
+    return out
+
+
+def supervised_encoding(
+    ds: Dataset,
+    fld: FeatureField,
+    strategy: str = "supervisedRatio",
+    pos_class: Optional[str] = None,
+) -> Dict[str, float]:
+    """Categorical value -> continuous code
+    (CategoricalContinuousEncoding.java:47, coe.encoding.strategy):
+      supervisedRatio: count(value, pos) / count(value)
+      weightOfEvidence: ln( (count(value,pos)/total_pos) /
+                            (count(value,neg)/total_neg) )
+    """
+    tab = contingency(ds, fld)                        # [B, K]
+    classes = ds.schema.class_values()
+    pi = classes.index(pos_class) if pos_class else 1
+    pos = tab[:, pi]
+    neg = tab.sum(axis=1) - pos
+    total_pos = max(pos.sum(), _EPS)
+    total_neg = max(neg.sum(), _EPS)
+    out = {}
+    for b, value in enumerate(fld.cardinality):
+        if strategy == "weightOfEvidence":
+            num = max(pos[b], 0.5) / total_pos        # 0.5 = continuity corr.
+            den = max(neg[b], 0.5) / total_neg
+            out[value] = math.log(num / den)
+        else:
+            out[value] = float(pos[b] / max(pos[b] + neg[b], _EPS))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# samplers
+# ---------------------------------------------------------------------------
+
+
+def undersample_balance(ds: Dataset, seed: int = 0) -> Dataset:
+    """Undersample majority classes to the minority count
+    (UnderSamplingBalancer.java:45)."""
+    y = ds.labels()
+    rng = np.random.default_rng(seed)
+    counts = np.bincount(y, minlength=ds.schema.num_classes())
+    target = counts[counts > 0].min()
+    keep = []
+    for c in range(len(counts)):
+        rows = np.flatnonzero(y == c)
+        if len(rows) > target:
+            rows = rng.choice(rows, target, replace=False)
+        keep.append(rows)
+    keep = np.sort(np.concatenate(keep))
+    return ds.take(keep)
+
+
+def bagging_sample(ds: Dataset, rate: float = 1.0, seed: int = 0) -> Dataset:
+    """Bootstrap sample (BaggingSampler.java:47)."""
+    rng = np.random.default_rng(seed)
+    n = len(ds)
+    idx = rng.integers(0, n, int(n * rate))
+    return ds.take(idx)
+
+
+# ---------------------------------------------------------------------------
+# top matches by class + rule evaluation
+# ---------------------------------------------------------------------------
+
+
+def top_matches_by_class(ds: Dataset, k: int = 3, block: int = 4096
+                         ) -> Dict[str, Tuple[np.ndarray, np.ndarray]]:
+    """Per class: k nearest same-class neighbors for each record of that
+    class (TopMatchesByClass.java:47). Returns class -> (dist, local idx)."""
+    from avenir_tpu.models.knn import NeighborIndex
+
+    y = ds.labels()
+    out = {}
+    for ki, cv in enumerate(ds.schema.class_values()):
+        rows = np.flatnonzero(y == ki)
+        if len(rows) < 2:
+            continue
+        sub = ds.take(rows)
+        index = NeighborIndex(sub, k=min(k + 1, len(rows)), block=block)
+        dist, idx = index.neighbors(sub)
+        # first neighbor is self (distance 0); drop it
+        out[cv] = (np.asarray(dist)[:, 1:], rows[np.asarray(idx)[:, 1:]])
+    return out
+
+
+@dataclass
+class Rule:
+    """condition => consequence, both conjunctions of simple predicates
+    "attr op value" with op in (eq, ne, gt, ge, lt, le, in)
+    (RuleEvaluator.java:48, util/RuleExpression.java)."""
+
+    condition: List[str]
+    consequence: List[str]
+
+    @staticmethod
+    def _eval_one(ds: Dataset, expr: str) -> np.ndarray:
+        toks = expr.strip().split(None, 2)
+        attr, op, val = int(toks[0]), toks[1], toks[2]
+        fld = ds.schema.field_by_ordinal(attr)
+        col = ds.column(attr)
+        if fld.is_categorical:
+            index = fld.cardinality_index()
+            if op == "in":
+                codes = [index[v] for v in val.split(":") if v in index]
+                return np.isin(col.astype(np.int64), codes)
+            code = index[val]
+            m = col.astype(np.int64) == code
+            return m if op == "eq" else ~m
+        x = col.astype(np.float64)
+        v = float(val)
+        return {
+            "eq": x == v, "ne": x != v, "gt": x > v, "ge": x >= v,
+            "lt": x < v, "le": x <= v,
+        }[op]
+
+    def evaluate(self, ds: Dataset) -> Dict[str, float]:
+        cond = np.ones(len(ds), bool)
+        for e in self.condition:
+            cond &= self._eval_one(ds, e)
+        cons = np.ones(len(ds), bool)
+        for e in self.consequence:
+            cons &= self._eval_one(ds, e)
+        both = cond & cons
+        n = len(ds)
+        support = both.sum() / n if n else 0.0
+        confidence = both.sum() / max(cond.sum(), 1)
+        return {"support": float(support), "confidence": float(confidence),
+                "conditionCount": int(cond.sum()), "bothCount": int(both.sum())}

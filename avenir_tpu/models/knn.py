@@ -47,25 +47,7 @@ KERNEL_SCALE = 100
 KERNELS = ("none", "linearMultiplicative", "linearAdditive", "gaussian")
 
 
-def _extract(ds: Dataset):
-    """Split a dataset into (numeric matrix, ranges, categorical codes, bins)."""
-    num_fields = [f for f in ds.schema.feature_fields if f.is_numeric]
-    cat_fields = [f for f in ds.schema.feature_fields if f.is_categorical]
-    x_num = ds.feature_matrix(num_fields)
-    ranges = np.array(
-        [
-            (f.max - f.min) if (f.max is not None and f.min is not None) else 1.0
-            for f in num_fields
-        ],
-        dtype=np.float32,
-    )
-    if cat_fields:
-        cat_cols = [ds.column(f.ordinal).astype(np.int32) for f in cat_fields]
-        x_cat = np.stack(cat_cols, axis=1)
-        bins = tuple(len(f.cardinality) for f in cat_fields)
-    else:
-        x_cat, bins = None, None
-    return x_num, ranges, x_cat, bins
+from avenir_tpu.core.dataset import extract_mixed_features as _extract
 
 
 @partial(jax.jit, static_argnames=("kernel", "num_classes", "class_cond",
@@ -86,7 +68,10 @@ def _vote(
     elif kernel == "linearMultiplicative":
         score = jnp.where(d == 0, 2.0 * KERNEL_SCALE, jnp.floor(KERNEL_SCALE / jnp.maximum(d, 1.0)))
     elif kernel == "linearAdditive":
-        score = KERNEL_SCALE - d
+        # clamp at 0: distances can exceed the normalized range when test
+        # values fall outside the schema's declared [min, max], and a
+        # negative score would subtract votes from the neighbor's class
+        score = jnp.maximum(KERNEL_SCALE - d, 0.0)
     elif kernel == "gaussian":
         t = d / kernel_param
         score = jnp.floor(KERNEL_SCALE * jnp.exp(-0.5 * t * t))
@@ -192,7 +177,6 @@ class NearestNeighborClassifier:
         labels = np.zeros((pad,), np.int32)
         labels[:n_valid] = train.labels()
         self.train_labels = jnp.asarray(labels)
-        self.train_ids = train.ids()
 
         # class-conditional weighting: P(features_i | class_i) per train row,
         # the quantity jobs (2)-(4) of the reference pipeline compute + join

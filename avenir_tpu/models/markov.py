@@ -1,0 +1,429 @@
+"""Markov / HMM sequence models (org.avenir.markov + spark/sequence ports).
+
+Reference semantics:
+- MarkovStateTransitionModel.java:50 — count (prevState, state) bigrams per
+  row-sequence, optional per-class-label matrices; reducer row-normalizes
+  into scaled-int matrices; model file = states header line, optional
+  "classLabel:<v>" section markers, then matrix rows (:116-133, :184-219).
+- MarkovModelClassifier.java:44 — cumulative log odds of a sequence under
+  two class matrices, threshold -> class (:127-150).
+- HiddenMarkovModelBuilder.java:50 — counts state-transition,
+  state-observation and initial-state triples from tagged sequences.
+- ViterbiStatePredictor.java:45 + ViterbiDecoder.java:31 — hidden state
+  decoding from observations + HMM params.
+- ProbabilisticSuffixTreeGenerator.java:51 — sliding-window suffix counts ->
+  higher-order conditional probabilities.
+- spark/markov/StateTransitionRate.scala:30 / ContTimeStateTransitionStats
+  .scala:34 — continuous-time Markov chain rates and dwell statistics.
+
+TPU design: sequences pad to [S, L] int32 (-1 sentinel); bigram/emission
+counting is one one-hot einsum over the (prev, next[, class]) codes —
+the same contraction pattern as Naive Bayes; Viterbi is a lax.scan over
+time vmap'd across the sequence batch.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field
+from functools import partial
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+_EPS = 1e-12
+
+
+def encode_sequences(
+    seqs: Sequence[Sequence[str]], states: Sequence[str]
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Pad string sequences to int32 [S, L] with -1 sentinel; returns
+    (padded, lengths)."""
+    index = {s: i for i, s in enumerate(states)}
+    lens = np.array([len(s) for s in seqs], np.int32)
+    L = int(lens.max()) if len(seqs) else 0
+    out = np.full((len(seqs), L), -1, np.int32)
+    for i, s in enumerate(seqs):
+        out[i, : len(s)] = [index[tok] for tok in s]
+    return out, lens
+
+
+@partial(jax.jit, static_argnames=("n_states", "n_classes"))
+def _bigram_counts(padded, labels, n_states: int, n_classes: int):
+    """counts[c, i, j] = #(class c sequences with transition i->j)."""
+    prev = padded[:, :-1]
+    nxt = padded[:, 1:]
+    valid = (prev >= 0) & (nxt >= 0)
+    oh_prev = jax.nn.one_hot(prev, n_states, dtype=jnp.float32) * valid[..., None]
+    oh_next = jax.nn.one_hot(nxt, n_states, dtype=jnp.float32)
+    oh_cls = jax.nn.one_hot(labels, n_classes, dtype=jnp.float32)
+    return jnp.einsum("sc,sli,slj->cij", oh_cls, oh_prev, oh_next)
+
+
+class MarkovStateTransitionModel:
+    """mst.* job equivalent: (per-class) row-normalized transition matrices."""
+
+    def __init__(self, states: Sequence[str], scale: int = 1000,
+                 class_labels: Optional[Sequence[str]] = None):
+        self.states = list(states)
+        self.scale = scale
+        self.class_labels = list(class_labels) if class_labels else None
+        n, k = len(self.states), (len(class_labels) if class_labels else 1)
+        self.counts = np.zeros((k, n, n), np.float64)
+
+    # ----------------------------------------------------------------- fit
+    def fit(self, seqs: Sequence[Sequence[str]],
+            labels: Optional[Sequence[str]] = None) -> "MarkovStateTransitionModel":
+        padded, _ = encode_sequences(seqs, self.states)
+        if self.class_labels:
+            lab_idx = {v: i for i, v in enumerate(self.class_labels)}
+            y = np.array([lab_idx[v] for v in labels], np.int32)
+            k = len(self.class_labels)
+        else:
+            y = np.zeros(len(seqs), np.int32)
+            k = 1
+        self.counts += np.asarray(
+            _bigram_counts(jnp.asarray(padded), jnp.asarray(y),
+                           len(self.states), k)
+        )
+        return self
+
+    def matrix(self, class_label: Optional[str] = None,
+               scaled: bool = True) -> np.ndarray:
+        ki = (self.class_labels.index(class_label)
+              if class_label and self.class_labels else 0)
+        c = self.counts[ki]
+        prob = c / np.maximum(c.sum(axis=1, keepdims=True), _EPS)
+        return np.rint(prob * self.scale).astype(np.int64) if scaled else prob
+
+    # ------------------------------------------------------------- file IO
+    def save(self, path: str, delim: str = ",") -> None:
+        """Reference text format: states line, then (per class) matrix rows,
+        class sections marked 'classLabel:<v>'."""
+        with open(path, "w") as fh:
+            fh.write(delim.join(self.states) + "\n")
+            if self.class_labels:
+                for cv in self.class_labels:
+                    fh.write(f"classLabel:{cv}\n")
+                    for row in self.matrix(cv):
+                        fh.write(delim.join(str(int(v)) for v in row) + "\n")
+            else:
+                for row in self.matrix():
+                    fh.write(delim.join(str(int(v)) for v in row) + "\n")
+
+    @classmethod
+    def load(cls, path: str, delim: str = ",", scale: int = 1000
+             ) -> "MarkovStateTransitionModel":
+        with open(path) as fh:
+            lines = [ln.rstrip("\n") for ln in fh if ln.strip()]
+        states = lines[0].split(delim)
+        n = len(states)
+        sections: Dict[Optional[str], List[List[float]]] = {}
+        cur: Optional[str] = None
+        for ln in lines[1:]:
+            if ln.startswith("classLabel:"):
+                cur = ln.split(":", 1)[1]
+                sections[cur] = []
+            else:
+                sections.setdefault(cur, []).append(
+                    [float(v) for v in ln.split(delim)]
+                )
+        class_labels = [c for c in sections if c is not None] or None
+        model = cls(states, scale=scale, class_labels=class_labels)
+        for ki, key in enumerate(class_labels or [None]):
+            model.counts[ki] = np.asarray(sections[key])  # scaled probs as counts
+        return model
+
+
+class MarkovModelClassifier:
+    """mmc.* job: two-class sequence classification by cumulative log odds
+    (MarkovModelClassifier.java:127-150)."""
+
+    def __init__(self, model: MarkovStateTransitionModel,
+                 pos_class: str, neg_class: str, threshold: float = 0.0):
+        assert model.class_labels, "classifier needs a class-based model"
+        self.model = model
+        self.pos_class = pos_class
+        self.neg_class = neg_class
+        self.threshold = threshold
+        p_pos = model.matrix(pos_class, scaled=False)
+        p_neg = model.matrix(neg_class, scaled=False)
+        self.log_odds = jnp.asarray(
+            np.log(np.maximum(p_pos, _EPS)) - np.log(np.maximum(p_neg, _EPS)),
+            jnp.float32,
+        )
+
+    def predict(self, seqs: Sequence[Sequence[str]]) -> Tuple[np.ndarray, np.ndarray]:
+        """Returns (class strings, log-odds scores)."""
+        padded, _ = encode_sequences(seqs, self.model.states)
+        padded = jnp.asarray(padded)
+        prev, nxt = padded[:, :-1], padded[:, 1:]
+        valid = (prev >= 0) & (nxt >= 0)
+        lo = self.log_odds[jnp.maximum(prev, 0), jnp.maximum(nxt, 0)]
+        score = np.asarray(jnp.sum(jnp.where(valid, lo, 0.0), axis=1))
+        pred = np.where(score > self.threshold, self.pos_class, self.neg_class)
+        return pred, score
+
+
+# ---------------------------------------------------------------------------
+# hidden Markov model
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class HiddenMarkovModel:
+    """HMM parameter container (HiddenMarkovModel.java:31)."""
+
+    states: List[str]
+    observations: List[str]
+    initial: np.ndarray          # [S]
+    transition: np.ndarray       # [S, S]
+    emission: np.ndarray         # [S, O]
+
+    def save(self, path: str, delim: str = ",") -> None:
+        with open(path, "w") as fh:
+            fh.write(delim.join(self.states) + "\n")
+            fh.write(delim.join(self.observations) + "\n")
+            fh.write(delim.join(f"{v:.6f}" for v in self.initial) + "\n")
+            for row in self.transition:
+                fh.write(delim.join(f"{v:.6f}" for v in row) + "\n")
+            for row in self.emission:
+                fh.write(delim.join(f"{v:.6f}" for v in row) + "\n")
+
+    @classmethod
+    def load(cls, path: str, delim: str = ",") -> "HiddenMarkovModel":
+        with open(path) as fh:
+            lines = [ln.rstrip("\n") for ln in fh if ln.strip()]
+        states = lines[0].split(delim)
+        obs = lines[1].split(delim)
+        s, o = len(states), len(obs)
+        initial = np.array([float(v) for v in lines[2].split(delim)])
+        trans = np.array([[float(v) for v in lines[3 + i].split(delim)]
+                          for i in range(s)])
+        emis = np.array([[float(v) for v in lines[3 + s + i].split(delim)]
+                         for i in range(s)])
+        return cls(states, obs, initial, trans, emis)
+
+
+class HiddenMarkovModelBuilder:
+    """hmmb.* job: count (state->state), (state->obs) and initial-state
+    occurrences from tagged sequences (HiddenMarkovModelBuilder.java:136-153)."""
+
+    def __init__(self, states: Sequence[str], observations: Sequence[str],
+                 laplace: float = 1.0):
+        self.states = list(states)
+        self.observations = list(observations)
+        self.laplace = laplace
+        s, o = len(self.states), len(self.observations)
+        self.trans_counts = np.zeros((s, s))
+        self.emis_counts = np.zeros((s, o))
+        self.init_counts = np.zeros(s)
+
+    def add(self, state_seq: Sequence[str], obs_seq: Sequence[str]) -> None:
+        sidx = {v: i for i, v in enumerate(self.states)}
+        oidx = {v: i for i, v in enumerate(self.observations)}
+        ss = [sidx[v] for v in state_seq]
+        oo = [oidx[v] for v in obs_seq]
+        if ss:
+            self.init_counts[ss[0]] += 1
+        for a, b in zip(ss[:-1], ss[1:]):
+            self.trans_counts[a, b] += 1
+        for s, o in zip(ss, oo):
+            self.emis_counts[s, o] += 1
+
+    def fit(self, state_seqs, obs_seqs) -> HiddenMarkovModel:
+        for ss, oo in zip(state_seqs, obs_seqs):
+            self.add(ss, oo)
+        lp = self.laplace
+        t = self.trans_counts + lp
+        e = self.emis_counts + lp
+        i = self.init_counts + lp
+        return HiddenMarkovModel(
+            self.states, self.observations,
+            i / i.sum(),
+            t / t.sum(axis=1, keepdims=True),
+            e / e.sum(axis=1, keepdims=True),
+        )
+
+
+@partial(jax.jit, static_argnames=())
+def _viterbi_kernel(obs, length, log_init, log_trans, log_emis):
+    """Single padded observation sequence [L] -> best state path [L]."""
+    L = obs.shape[0]
+
+    def step(carry, t):
+        delta = carry                                   # [S]
+        o = obs[t]
+        cand = delta[:, None] + log_trans               # [S, S]
+        best_prev = jnp.argmax(cand, axis=0)            # [S]
+        new_delta = jnp.max(cand, axis=0) + log_emis[:, jnp.maximum(o, 0)]
+        new_delta = jnp.where(t < length, new_delta, delta)
+        best_prev = jnp.where(t < length, best_prev, jnp.arange(delta.shape[0]))
+        return new_delta, best_prev
+
+    delta0 = log_init + log_emis[:, jnp.maximum(obs[0], 0)]
+    delta, back = lax.scan(step, delta0, jnp.arange(1, L))
+
+    last = jnp.argmax(delta)
+
+    def backstep(carry, t):
+        nxt = carry
+        prev = back[t][nxt]
+        prev = jnp.where(t + 1 < length, prev, nxt)
+        return prev, prev
+
+    _, path_rev = lax.scan(backstep, last, jnp.arange(L - 2, -1, -1))
+    path = jnp.concatenate([path_rev[::-1], jnp.array([last])])
+    return path
+
+
+class ViterbiDecoder:
+    """vsp.* job: hidden state decoding (ViterbiStatePredictor.java:45)."""
+
+    def __init__(self, hmm: HiddenMarkovModel):
+        self.hmm = hmm
+        self.log_init = jnp.asarray(np.log(np.maximum(hmm.initial, _EPS)), jnp.float32)
+        self.log_trans = jnp.asarray(np.log(np.maximum(hmm.transition, _EPS)), jnp.float32)
+        self.log_emis = jnp.asarray(np.log(np.maximum(hmm.emission, _EPS)), jnp.float32)
+
+    def decode(self, obs_seqs: Sequence[Sequence[str]]) -> List[List[str]]:
+        padded, lens = encode_sequences(obs_seqs, self.hmm.observations)
+        paths = jax.vmap(
+            lambda o, l: _viterbi_kernel(o, l, self.log_init, self.log_trans,
+                                         self.log_emis)
+        )(jnp.asarray(padded), jnp.asarray(lens))
+        paths = np.asarray(paths)
+        return [
+            [self.hmm.states[s] for s in paths[i, : lens[i]]]
+            for i in range(len(obs_seqs))
+        ]
+
+
+# ---------------------------------------------------------------------------
+# probabilistic suffix tree
+# ---------------------------------------------------------------------------
+
+
+class ProbabilisticSuffixTree:
+    """pstg.* job: sliding-window suffix counts -> conditional next-symbol
+    probabilities up to max_depth history
+    (ProbabilisticSuffixTreeGenerator.java:88-123)."""
+
+    def __init__(self, symbols: Sequence[str], max_depth: int = 3):
+        self.symbols = list(symbols)
+        self.max_depth = max_depth
+        self.counts: Dict[Tuple[str, ...], np.ndarray] = {}
+
+    def fit(self, seqs: Sequence[Sequence[str]]) -> "ProbabilisticSuffixTree":
+        nsym = len(self.symbols)
+        idx = {s: i for i, s in enumerate(self.symbols)}
+        for seq in seqs:
+            enc = [idx[t] for t in seq]
+            for t in range(len(enc)):
+                for d in range(0, self.max_depth + 1):
+                    if t - d < 0:
+                        break
+                    ctx = tuple(seq[t - d: t])
+                    if ctx not in self.counts:
+                        self.counts[ctx] = np.zeros(nsym)
+                    self.counts[ctx][enc[t]] += 1
+        return self
+
+    def cond_prob(self, context: Sequence[str], symbol: str) -> float:
+        """P(symbol | longest tracked suffix of context)."""
+        ctx = tuple(context[-self.max_depth:])
+        while ctx not in self.counts and ctx:
+            ctx = ctx[1:]
+        c = self.counts.get(ctx)
+        if c is None or c.sum() == 0:
+            return 1.0 / len(self.symbols)
+        return float(c[self.symbols.index(symbol)] / c.sum())
+
+    def sequence_log_prob(self, seq: Sequence[str]) -> float:
+        lp = 0.0
+        for t, sym in enumerate(seq):
+            lp += math.log(max(self.cond_prob(seq[:t], sym), _EPS))
+        return lp
+
+
+# ---------------------------------------------------------------------------
+# continuous-time Markov chain (spark/markov ports)
+# ---------------------------------------------------------------------------
+
+
+class StateTransitionRate:
+    """CTMC transition rates from timestamped state visits
+    (spark/markov/StateTransitionRate.scala:30): rate(i->j) =
+    count(i->j) / total dwell time in i."""
+
+    def __init__(self, states: Sequence[str]):
+        self.states = list(states)
+        n = len(self.states)
+        self.trans_counts = np.zeros((n, n))
+        self.dwell_time = np.zeros(n)
+
+    def fit(self, seqs: Sequence[Sequence[Tuple[str, float]]]
+            ) -> "StateTransitionRate":
+        """seqs: per entity, list of (state, timestamp) in time order."""
+        idx = {s: i for i, s in enumerate(self.states)}
+        for seq in seqs:
+            for (s0, t0), (s1, t1) in zip(seq[:-1], seq[1:]):
+                i, j = idx[s0], idx[s1]
+                self.dwell_time[i] += max(t1 - t0, 0.0)
+                if i != j:
+                    self.trans_counts[i, j] += 1
+        return self
+
+    def rates(self) -> np.ndarray:
+        return self.trans_counts / np.maximum(self.dwell_time[:, None], _EPS)
+
+    def dwell_stats(self) -> Dict[str, Tuple[float, float]]:
+        """Mean dwell time + exit rate per state
+        (ContTimeStateTransitionStats.scala:34)."""
+        exits = self.trans_counts.sum(axis=1)
+        mean_dwell = self.dwell_time / np.maximum(exits, 1.0)
+        return {
+            s: (float(mean_dwell[i]), float(exits[i] / max(self.dwell_time[i], _EPS)))
+            for i, s in enumerate(self.states)
+        }
+
+
+def generate_markov_sequences(
+    trans: np.ndarray,
+    init: np.ndarray,
+    states: Sequence[str],
+    n_seqs: int,
+    length: int,
+    seed: int = 0,
+) -> List[List[str]]:
+    """Synthetic sequence generation (spark/sequence/SequenceGenerator.scala:31)."""
+    rng = np.random.default_rng(seed)
+    out = []
+    for _ in range(n_seqs):
+        s = rng.choice(len(states), p=init)
+        seq = [states[s]]
+        for _ in range(length - 1):
+            s = rng.choice(len(states), p=trans[s])
+            seq.append(states[s])
+        out.append(seq)
+    return out
+
+
+def event_time_distribution(
+    seqs: Sequence[Sequence[float]], num_buckets: int = 24,
+    bucket_width: float = 3600.0,
+) -> np.ndarray:
+    """Inter-arrival time histogram
+    (spark/sequence/EventTimeDistribution.scala:27)."""
+    gaps = []
+    for seq in seqs:
+        ts = np.asarray(seq)
+        gaps.append(np.diff(ts))
+    if not gaps:
+        return np.zeros(num_buckets)
+    all_gaps = np.concatenate(gaps)
+    bucket = np.clip((all_gaps // bucket_width).astype(int), 0, num_buckets - 1)
+    return np.bincount(bucket, minlength=num_buckets)

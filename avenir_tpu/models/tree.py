@@ -1,0 +1,591 @@
+"""Decision tree / random forest: level-wise builder with tensorized splits.
+
+Reference semantics (org.avenir.tree, SURVEY §2.3/§3.4):
+- DecisionTreeBuilder is an *iterative MR job*, one tree level per run: the
+  mapper routes every record through every candidate split predicate of
+  every candidate attribute, emitting (path-so-far, splitId:predicate) keys;
+  the reducer accumulates per-(path, split, predicate) class histograms and
+  picks the min weighted-entropy/gini split per parent
+  (DecisionTreeBuilder.java:258-347, :440-576). State between levels is a
+  DecisionPathList JSON file rotated by resource/detr.sh:34-41.
+- SplitManager enumerates candidate splits: numeric attributes partition
+  [min,max] into up to maxSplit segments at splitScanInterval boundaries
+  (SplitManager.java:284-391); categoricals enumerate set partitions into
+  2..maxSplit groups (:397-561). Predicates serialize as "attr op value
+  [otherBound]" / "attr in a:b:c" strings.
+- Stopping: maxDepth / minPopulation / minInfoGain
+  (DecisionPathStoppingStrategy.java:57-70). Random forest = first-pass
+  sampling (with/without replacement) + per-level random attribute selection
+  (DecisionTreeBuilder.java:200-236, :353-369).
+
+TPU design: candidate splits are static (schema-driven), so each split is a
+record->segment mapping computed ONCE as an int8 matrix [n, n_splits]; a
+tree level is then a single one-hot einsum producing the histogram tensor
+[leaves, splits, segments, classes] — no predicate branching, no shuffle.
+The host picks best splits / applies stopping (tiny tensors) and updates the
+on-device leaf assignment by gathering the winning split's segment column.
+Random forest reuses the same segment matrix across trees with per-tree row
+weights (bootstrap counts) and attribute masks.
+
+Model format: DecisionPathList-compatible JSON (jackson field names), so
+reference decPathOut.txt files and ours are interchangeable.
+"""
+
+from __future__ import annotations
+
+import itertools
+import json
+import math
+from dataclasses import dataclass, field
+from functools import partial
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from avenir_tpu.core.dataset import Dataset
+from avenir_tpu.core.schema import FeatureField, FeatureSchema
+from avenir_tpu.ops.infotheory import bits_entropy, gini
+from avenir_tpu.utils.metrics import ConfusionMatrix
+
+ROOT_PATH = "$root"
+
+# ---------------------------------------------------------------------------
+# candidate split enumeration (host; SplitManager semantics)
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class Predicate:
+    """One predicate of one split segment ("attr op value [other]" form)."""
+
+    attribute: int
+    operator: str                       # ge / lt / in  (segment predicates)
+    value: Optional[float] = None
+    other_bound: Optional[float] = None
+    cat_values: List[str] = field(default_factory=list)
+    is_int: bool = True
+
+    def to_string(self) -> str:
+        if self.operator == "in":
+            return f"{self.attribute} in " + ":".join(self.cat_values)
+        fmt = (lambda v: str(int(v))) if self.is_int else (lambda v: str(v))
+        s = f"{self.attribute} {self.operator} {fmt(self.value)}"
+        if self.other_bound is not None:
+            s += f" {fmt(self.other_bound)}"
+        return s
+
+    def to_json(self) -> Dict:
+        obj: Dict = {"attribute": self.attribute, "operator": self.operator,
+                     "predicateStr": self.to_string()}
+        if self.operator == "in":
+            obj["categoricalValues"] = list(self.cat_values)
+        elif self.is_int:
+            obj["valueInt"] = int(self.value)
+            if self.other_bound is not None:
+                obj["otherBoundInt"] = int(self.other_bound)
+        else:
+            obj["valueDbl"] = float(self.value)
+            if self.other_bound is not None:
+                obj["otherBoundDbl"] = float(self.other_bound)
+        return obj
+
+
+@dataclass
+class CandidateSplit:
+    """One candidate split of one attribute into `n_segments` segments.
+
+    `segment_of` maps a raw column (numpy) to segment ids; `predicates[s]`
+    is the predicate describing segment s."""
+
+    attribute: int
+    split_id: int
+    n_segments: int
+    predicates: List[Predicate]
+    _kind: str = "numeric"
+    _bounds: Optional[np.ndarray] = None        # numeric: inner boundaries
+    _group_of: Optional[np.ndarray] = None      # categorical: code -> group
+
+    def segment_of(self, col: np.ndarray) -> np.ndarray:
+        if self._kind == "numeric":
+            return np.searchsorted(self._bounds, col, side="right").astype(np.int8)
+        return self._group_of[col.astype(np.int64)].astype(np.int8)
+
+
+def _numeric_splits(fld: FeatureField, max_split: int) -> List[List[float]]:
+    """All partitions of [min, max] into 2..max_split segments with
+    boundaries at splitScanInterval steps (SplitManager.java:284-391)."""
+    lo, hi = fld.min, fld.max
+    interval = fld.split_scan_interval or fld.bucket_width
+    if lo is None or hi is None or not interval:
+        return []
+    points = []
+    p = lo + interval
+    while p < hi - 1e-9:
+        points.append(p)
+        p += interval
+    out: List[List[float]] = []
+    for nseg in range(2, max_split + 1):
+        for combo in itertools.combinations(points, nseg - 1):
+            out.append(list(combo))
+    return out
+
+
+def _set_partitions(items: Sequence[str], max_groups: int,
+                    cap: int = 128) -> List[List[List[str]]]:
+    """Partitions of a category set into 2..max_groups groups
+    (SplitManager.java:397-561), capped to avoid blow-up."""
+    n = len(items)
+    results: List[List[List[str]]] = []
+    # enumerate by group-assignment vectors in canonical form
+    seen = set()
+    max_groups = min(max_groups, n)
+
+    def assignments(prefix, next_group):
+        if len(results) >= cap:
+            return
+        if len(prefix) == n:
+            ngroups = next_group
+            if 2 <= ngroups <= max_groups:
+                key = tuple(prefix)
+                if key not in seen:
+                    seen.add(key)
+                    groups: List[List[str]] = [[] for _ in range(ngroups)]
+                    for i, g in enumerate(prefix):
+                        groups[g].append(items[i])
+                    results.append(groups)
+            return
+        for g in range(next_group + 1):
+            if g > max_groups - 1:
+                continue
+            assignments(prefix + [g], max(next_group, g + 1))
+
+    assignments([], 0)
+    return results
+
+
+def enumerate_splits(schema: FeatureSchema,
+                     cat_partition_cap: int = 128) -> List[CandidateSplit]:
+    """All candidate splits of all feature attributes, in stable order."""
+    splits: List[CandidateSplit] = []
+    sid = 0
+    for fld in schema.feature_fields:
+        max_split = fld.max_split or 2
+        if fld.is_numeric:
+            for bounds in _numeric_splits(fld, max_split):
+                preds = []
+                is_int = fld.data_type == "int"
+                for s in range(len(bounds) + 1):
+                    if s == 0:
+                        preds.append(Predicate(fld.ordinal, "lt", bounds[0],
+                                               is_int=is_int))
+                    elif s == len(bounds):
+                        preds.append(Predicate(fld.ordinal, "ge", bounds[-1],
+                                               is_int=is_int))
+                    else:
+                        preds.append(Predicate(fld.ordinal, "ge", bounds[s - 1],
+                                               other_bound=bounds[s], is_int=is_int))
+                splits.append(CandidateSplit(
+                    fld.ordinal, sid, len(bounds) + 1, preds,
+                    _kind="numeric", _bounds=np.asarray(bounds),
+                ))
+                sid += 1
+        elif fld.is_categorical and len(fld.cardinality) >= 2:
+            for groups in _set_partitions(fld.cardinality, max_split,
+                                          cap=cat_partition_cap):
+                group_of = np.zeros(len(fld.cardinality), np.int64)
+                preds = []
+                index = fld.cardinality_index()
+                for g, members in enumerate(groups):
+                    for m in members:
+                        group_of[index[m]] = g
+                    preds.append(Predicate(fld.ordinal, "in",
+                                           cat_values=list(members)))
+                splits.append(CandidateSplit(
+                    fld.ordinal, sid, len(groups), preds,
+                    _kind="categorical", _group_of=group_of,
+                ))
+                sid += 1
+    return splits
+
+
+# ---------------------------------------------------------------------------
+# the level histogram kernel
+# ---------------------------------------------------------------------------
+
+
+@partial(jax.jit, static_argnames=("n_leaves", "n_splits", "smax", "k"))
+def _level_histogram(leaf_id, seg_matrix, labels, weights,
+                     n_leaves: int, n_splits: int, smax: int, k: int):
+    """counts[l, s, seg, c] for all leaves x splits x segments x classes in
+    one segment_sum — the whole MR shuffle of one tree level."""
+    # combined key: ((leaf * n_splits + split) * smax + segment) * k + class
+    base = (leaf_id.astype(jnp.int32) * n_splits)[:, None] + jnp.arange(n_splits)[None, :]
+    key = (base * smax + seg_matrix.astype(jnp.int32)) * k + labels[:, None]
+    flat = jax.ops.segment_sum(
+        jnp.broadcast_to(weights[:, None], key.shape).reshape(-1),
+        key.reshape(-1),
+        num_segments=n_leaves * n_splits * smax * k,
+    )
+    return flat.reshape(n_leaves, n_splits, smax, k)
+
+
+@partial(jax.jit, static_argnames=())
+def _advance_leaves(leaf_id, seg_matrix, best_split_of_leaf, child_offset):
+    """new_leaf = child_offset[leaf] + segment under the leaf's chosen split;
+    leaves without a split (stopped/unsplit) keep a fixed id via offset -1."""
+    split = best_split_of_leaf[leaf_id]                       # [n]
+    seg = jnp.take_along_axis(
+        seg_matrix, jnp.maximum(split, 0)[:, None], axis=1
+    )[:, 0].astype(jnp.int32)
+    off = child_offset[leaf_id]
+    return jnp.where(split >= 0, off + seg, leaf_id)
+
+
+# ---------------------------------------------------------------------------
+# model: DecisionPathList-compatible
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class DecisionPath:
+    predicates: List[Predicate]        # empty -> root
+    population: int
+    info_content: float
+    stopped: bool
+    class_val_pr: Dict[str, float]
+
+    def to_json(self) -> Dict:
+        return {
+            "predicates": [p.to_json() for p in self.predicates] or None,
+            "population": int(self.population),
+            "infoContent": float(self.info_content),
+            "stopped": bool(self.stopped),
+            "classValPr": {k: float(v) for k, v in self.class_val_pr.items()},
+        }
+
+
+class DecisionPathList:
+    """The JSON tree model (reference tree/DecisionPathList.java format)."""
+
+    def __init__(self, paths: List[DecisionPath]):
+        self.paths = paths
+
+    def to_json(self) -> Dict:
+        return {"decisionPaths": [p.to_json() for p in self.paths]}
+
+    def save(self, path: str) -> None:
+        with open(path, "w") as fh:
+            json.dump(self.to_json(), fh, indent=1)
+
+    @classmethod
+    def from_json(cls, obj: Dict) -> "DecisionPathList":
+        paths = []
+        for p in obj["decisionPaths"]:
+            preds = []
+            for pr in (p.get("predicates") or []):
+                op = pr["operator"]
+                if op == "in":
+                    pred = Predicate(pr["attribute"], "in",
+                                     cat_values=pr.get("categoricalValues", []))
+                elif "valueInt" in pr and pr.get("valueInt") is not None:
+                    pred = Predicate(pr["attribute"], op,
+                                     value=pr["valueInt"],
+                                     other_bound=pr.get("otherBoundInt"),
+                                     is_int=True)
+                else:
+                    pred = Predicate(pr["attribute"], op,
+                                     value=pr.get("valueDbl"),
+                                     other_bound=pr.get("otherBoundDbl"),
+                                     is_int=False)
+                preds.append(pred)
+            paths.append(DecisionPath(
+                preds, p.get("population", 0), p.get("infoContent", 0.0),
+                p.get("stopped", False), p.get("classValPr", {}) or {},
+            ))
+        return cls(paths)
+
+    @classmethod
+    def load(cls, path: str) -> "DecisionPathList":
+        with open(path) as fh:
+            return cls.from_json(json.load(fh))
+
+    # ------------------------------------------------------------ prediction
+    def predict(self, ds: Dataset, class_values: List[str]) -> np.ndarray:
+        """Route every record down its matching path; argmax classValPr."""
+        n = len(ds)
+        pred = np.zeros(n, np.int32)
+        assigned = np.zeros(n, bool)
+        for path in self.paths:
+            mask = np.ones(n, bool)
+            for pr in path.predicates:
+                col = ds.column(pr.attribute)
+                if pr.operator == "in":
+                    fld = ds.schema.field_by_ordinal(pr.attribute)
+                    codes = {fld.cardinality_index()[v] for v in pr.cat_values
+                             if v in fld.cardinality_index()}
+                    mask &= np.isin(col.astype(np.int64), list(codes))
+                else:
+                    x = col.astype(np.float64)
+                    if pr.operator == "ge":
+                        m = x >= pr.value
+                        if pr.other_bound is not None:
+                            m &= x < pr.other_bound
+                    elif pr.operator == "lt":
+                        m = x < pr.value
+                        if pr.other_bound is not None:
+                            m &= x >= pr.other_bound
+                    elif pr.operator == "gt":
+                        m = x > pr.value
+                        if pr.other_bound is not None:
+                            m &= x <= pr.other_bound
+                    else:  # le
+                        m = x <= pr.value
+                        if pr.other_bound is not None:
+                            m &= x > pr.other_bound
+                    mask &= m
+            if path.class_val_pr:
+                best = max(path.class_val_pr.items(), key=lambda kv: kv[1])[0]
+                ci = class_values.index(best)
+                take = mask & ~assigned
+                pred[take] = ci
+                assigned |= mask
+        return pred
+
+
+# ---------------------------------------------------------------------------
+# builder
+# ---------------------------------------------------------------------------
+
+
+class DecisionTreeBuilder:
+    """dtb.* job equivalent: level-wise tree growth, all state in-process."""
+
+    def __init__(
+        self,
+        schema: FeatureSchema,
+        split_algorithm: str = "entropy",          # or giniIndex
+        max_depth: int = 3,
+        min_info_gain: float = -1.0,
+        min_population: int = -1,
+        stopping_strategy: str = "maxDepth",
+        attr_selection_strategy: str = "notUsedYet",
+        cat_partition_cap: int = 128,
+        seed: int = 0,
+    ):
+        self.schema = schema
+        self.algo = split_algorithm
+        self.max_depth = max_depth
+        self.min_info_gain = min_info_gain
+        self.min_population = min_population
+        self.stopping = stopping_strategy
+        self.attr_strategy = attr_selection_strategy
+        self.class_values = schema.class_values()
+        self.splits = enumerate_splits(schema, cat_partition_cap)
+        self.smax = max((s.n_segments for s in self.splits), default=2)
+        self.rng = np.random.default_rng(seed)
+
+    # ------------------------------------------------------------------- fit
+    def fit(self, ds: Dataset, row_weights: Optional[np.ndarray] = None
+            ) -> DecisionPathList:
+        n = len(ds)
+        k = len(self.class_values)
+        ns = len(self.splits)
+        seg = np.stack(
+            [sp.segment_of(np.asarray(ds.column(sp.attribute))) for sp in self.splits],
+            axis=1,
+        ).astype(np.int8)                                     # [n, NS]
+        seg_d = jnp.asarray(seg)
+        labels_d = jnp.asarray(ds.labels())
+        w = jnp.asarray(
+            row_weights.astype(np.float32) if row_weights is not None
+            else np.ones(n, np.float32)
+        )
+        leaf_id = jnp.zeros(n, jnp.int32)
+
+        # host-side tree state: leaf -> (predicate chain, used attrs)
+        leaves: List[Dict] = [{"preds": [], "used": set(), "stopped": False}]
+        done_paths: List[DecisionPath] = []
+
+        impurity_fn = bits_entropy if self.algo in ("entropy", "infoGain") else gini
+
+        for depth in range(self.max_depth):
+            active = [
+                i for i, lf in enumerate(leaves)
+                if not lf["stopped"] and "split" not in lf
+            ]
+            if not active:
+                break
+            counts = np.asarray(_level_histogram(
+                leaf_id, seg_d, labels_d, w, len(leaves), ns, self.smax, k
+            ))                                                # [L, NS, S, K]
+            seg_tot = counts.sum(axis=3)                      # [L, NS, S]
+            leaf_tot = seg_tot.sum(axis=2)                    # [L, NS] (same per split)
+
+            # weighted impurity per (leaf, split)
+            imp = np.asarray(impurity_fn(jnp.asarray(counts), axis=-1))  # [L,NS,S]
+            wimp = (seg_tot * imp).sum(axis=2) / np.maximum(leaf_tot, 1e-9)
+
+            best_split_of_leaf = np.full(len(leaves), -1, np.int32)
+            child_offset = np.full(len(leaves), -1, np.int32)
+            new_leaves: List[Dict] = []
+
+            for li in active:
+                lf = leaves[li]
+                pop = float(leaf_tot[li].max())
+                # class counts of this leaf: any split column's segment-sum
+                cls_counts = counts[li, 0].sum(axis=0) if ns else np.zeros(k)
+                node_imp = float(np.asarray(impurity_fn(jnp.asarray(cls_counts))))
+
+                allowed = self._allowed_splits(lf)
+                if pop <= 0 or not allowed:
+                    lf["stopped"] = True
+                    continue
+                cand = wimp[li, allowed]
+                bi = int(allowed[int(np.argmin(cand))])
+                gain = node_imp - float(wimp[li, bi])
+
+                # stopping strategies (DecisionPathStoppingStrategy.java:57-70;
+                # maxDepth is enforced by the level-loop bound itself)
+                stop = False
+                if self.stopping == "minInfoGain" and self.min_info_gain >= 0:
+                    stop = gain < self.min_info_gain
+                elif self.stopping == "minPopulation" and self.min_population >= 0:
+                    stop = pop < self.min_population
+                if stop:
+                    lf["stopped"] = True
+                    continue
+
+                sp = self.splits[bi]
+                best_split_of_leaf[li] = bi
+                child_offset[li] = len(leaves) + len(new_leaves)
+                for s in range(self.smax):
+                    if s < sp.n_segments:
+                        new_leaves.append({
+                            "preds": lf["preds"] + [sp.predicates[s]],
+                            "used": lf["used"] | {sp.attribute},
+                            "stopped": False,
+                        })
+                    else:
+                        # pad children so child ids stay contiguous per leaf
+                        new_leaves.append({"preds": lf["preds"], "used": lf["used"],
+                                           "stopped": True})
+                lf["split"] = bi           # parent becomes an internal node
+
+            if not new_leaves:
+                break
+            # materialize finished leaves for paths that stopped this level
+            leaf_id = _advance_leaves(
+                leaf_id, seg_d,
+                jnp.asarray(best_split_of_leaf), jnp.asarray(child_offset),
+            )
+            # children get smax slots per split parent; re-index leaves
+            leaves = leaves + new_leaves
+
+        # emit final paths: any leaf never split
+        model_paths: List[DecisionPath] = []
+        counts_final = np.asarray(_level_histogram(
+            leaf_id, seg_d, labels_d, w, len(leaves), max(ns, 1), self.smax, k
+        )) if ns else None
+        for li, lf in enumerate(leaves):
+            if "split" in lf:
+                continue                   # internal node
+            cls_counts = (
+                counts_final[li, 0].sum(axis=0)
+                if counts_final is not None else np.zeros(k)
+            )
+            tot = cls_counts.sum()
+            if tot <= 0 and lf["preds"]:
+                continue                   # padded/empty child
+            pr = {
+                self.class_values[c]: (float(cls_counts[c]) / tot if tot else 0.0)
+                for c in range(k)
+            }
+            info = float(np.asarray(
+                (bits_entropy if self.algo in ("entropy", "infoGain") else gini)(
+                    jnp.asarray(cls_counts))))
+            model_paths.append(DecisionPath(
+                lf["preds"], int(tot), info, True, pr
+            ))
+        return DecisionPathList(model_paths)
+
+    def _allowed_splits(self, leaf: Dict) -> List[int]:
+        strat = self.attr_strategy
+        used = leaf["used"]
+        attrs = sorted({sp.attribute for sp in self.splits})
+        if strat == "all":
+            chosen = set(attrs)
+        elif strat == "notUsedYet":
+            chosen = set(a for a in attrs if a not in used) or set(attrs)
+        elif strat == "randomAll":
+            m = max(1, int(math.sqrt(len(attrs))))
+            chosen = set(self.rng.choice(attrs, size=m, replace=False).tolist())
+        elif strat == "randomNotUsedYet":
+            avail = [a for a in attrs if a not in used] or attrs
+            m = max(1, int(math.sqrt(len(avail))))
+            chosen = set(self.rng.choice(avail, size=m, replace=False).tolist())
+        else:
+            chosen = set(attrs)
+        return [i for i, sp in enumerate(self.splits) if sp.attribute in chosen]
+
+
+# ---------------------------------------------------------------------------
+# random forest
+# ---------------------------------------------------------------------------
+
+
+class RandomForestBuilder:
+    """RF = trees over bootstrap row weights + random attribute selection
+    (reference first-iteration sampling DecisionTreeBuilder.java:200-236 with
+    sub.sampling.strategy withReplace/withoutReplace)."""
+
+    def __init__(
+        self,
+        schema: FeatureSchema,
+        num_trees: int = 10,
+        sampling: str = "withReplace",
+        sample_rate: float = 0.7,
+        seed: int = 0,
+        **tree_kwargs,
+    ):
+        self.schema = schema
+        self.num_trees = num_trees
+        self.sampling = sampling
+        self.sample_rate = sample_rate
+        self.seed = seed
+        tree_kwargs.setdefault("attr_selection_strategy", "randomNotUsedYet")
+        self.tree_kwargs = tree_kwargs
+        self.trees: List[DecisionPathList] = []
+        self.class_values = schema.class_values()
+
+    def fit(self, ds: Dataset) -> "RandomForestBuilder":
+        n = len(ds)
+        rng = np.random.default_rng(self.seed)
+        self.trees = []
+        for t in range(self.num_trees):
+            if self.sampling == "withReplace":
+                idx = rng.integers(0, n, n)
+                w = np.bincount(idx, minlength=n).astype(np.float32)
+            elif self.sampling == "withoutReplace":
+                w = (rng.random(n) < self.sample_rate).astype(np.float32)
+            else:
+                w = np.ones(n, np.float32)
+            builder = DecisionTreeBuilder(
+                self.schema, seed=self.seed + t, **self.tree_kwargs
+            )
+            self.trees.append(builder.fit(ds, row_weights=w))
+        return self
+
+    def predict(self, ds: Dataset) -> np.ndarray:
+        k = len(self.class_values)
+        votes = np.zeros((len(ds), k), np.int64)
+        for tree in self.trees:
+            pred = tree.predict(ds, self.class_values)
+            votes[np.arange(len(ds)), pred] += 1
+        return votes.argmax(axis=1).astype(np.int32)
+
+    def validate(self, ds: Dataset, pos_class: int = 1) -> ConfusionMatrix:
+        cm = ConfusionMatrix(self.class_values, pos_class=pos_class)
+        cm.add(ds.labels(), self.predict(ds))
+        return cm

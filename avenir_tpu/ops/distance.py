@@ -149,7 +149,8 @@ def blocked_topk_neighbors(
     nblocks = nt // block
     n_valid_arr = jnp.int32(nt if n_valid is None else n_valid)
 
-    def body(_, b):
+    def block_topk(b):
+        """Reduce one train block to its local top-k candidates."""
         start = b * block
         tn = lax.dynamic_slice_in_dim(t_num, start, block, 0) if t_num is not None else None
         tc = lax.dynamic_slice_in_dim(t_cat, start, block, 0) if t_cat is not None else None
@@ -161,17 +162,27 @@ def blocked_topk_neighbors(
         else:
             neg, bpos = lax.top_k(-d, k)
             bd = -neg
-        return 0, (bd, start + bpos.astype(jnp.int32))
+        return bd, start + bpos.astype(jnp.int32)
 
     if nblocks == 1:
-        _, (dist, idx) = body(0, jnp.int32(0))
+        dist, idx = block_topk(jnp.int32(0))
     else:
-        _, (ds, idxs) = lax.scan(body, 0, jnp.arange(nblocks))
-        # [nblocks, nq, k] -> [nq, nblocks*k] candidate merge
-        ds = jnp.moveaxis(ds, 0, 1).reshape(nq, nblocks * k)
-        idxs = jnp.moveaxis(idxs, 0, 1).reshape(nq, nblocks * k)
-        neg, pos = lax.top_k(-ds, k)
-        dist, idx = -neg, jnp.take_along_axis(idxs, pos, axis=1)
+        # running-carry merge: each block reduces to k candidates, then a
+        # tiny [nq, 2k] top_k folds them into the carry — O(nq*k) memory,
+        # so billion-row train sets stream without big intermediates
+        def body(carry, b):
+            best_d, best_i = carry
+            bd, bi = block_topk(b)
+            cat_d = jnp.concatenate([best_d, bd], axis=1)
+            cat_i = jnp.concatenate([best_i, bi], axis=1)
+            neg, pos = lax.top_k(-cat_d, k)
+            return (-neg, jnp.take_along_axis(cat_i, pos, axis=1)), None
+
+        init = (
+            jnp.full((nq, k), jnp.inf, dtype=jnp.float32),
+            jnp.full((nq, k), -1, dtype=jnp.int32),
+        )
+        (dist, idx), _ = lax.scan(body, init, jnp.arange(nblocks))
     # unfillable slots (n_valid < k): -1 sentinel instead of phantom rows
     idx = jnp.where(jnp.isinf(dist), -1, idx)
     return dist, idx

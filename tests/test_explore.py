@@ -1,0 +1,160 @@
+"""Explore suite vs NumPy/scipy-free oracles."""
+
+import numpy as np
+import pytest
+
+from avenir_tpu.data import generate_churn, churn_schema
+from avenir_tpu.models.explore import (
+    MutualInformationAnalyzer,
+    Rule,
+    bagging_sample,
+    class_affinity,
+    contingency,
+    cramer_correlation,
+    cramer_index,
+    heterogeneity_reduction,
+    numerical_correlation,
+    relief_relevance,
+    supervised_encoding,
+    top_matches_by_class,
+    undersample_balance,
+)
+
+
+@pytest.fixture(scope="module")
+def churn():
+    return generate_churn(3000, seed=17)
+
+
+class TestMutualInformation:
+    @pytest.fixture(scope="class")
+    def mia(self, churn):
+        return MutualInformationAnalyzer(churn)
+
+    def test_feature_class_mi_matches_oracle(self, churn, mia):
+        codes, bins = churn.feature_codes()
+        y = churn.labels()
+        f = 0
+        joint = np.zeros((bins[f], 2))
+        for b in range(bins[f]):
+            for c in range(2):
+                joint[b, c] = ((codes[:, f] == b) & (y == c)).sum()
+        pj = joint / joint.sum()
+        pa = pj.sum(1, keepdims=True)
+        pb = pj.sum(0, keepdims=True)
+        with np.errstate(divide="ignore", invalid="ignore"):
+            mi = np.nansum(pj * np.log(pj / (pa * pb)))
+        np.testing.assert_allclose(mia.feature_class_mi[0], mi, atol=1e-5)
+
+    def test_mim_sorted_descending(self, mia):
+        scores = [s for _, s in mia.mim()]
+        assert scores == sorted(scores, reverse=True)
+
+    def test_all_algorithms_cover_all_features(self, mia, churn):
+        F = len(churn.encodable_feature_fields())
+        for algo in ("mutual.info.maximization", "joint.mutual.info",
+                     "double.input.symmetric.relevance",
+                     "min.redundancy.max.relevance"):
+            out = mia.score(algo)
+            assert len(out) == F
+            assert len({o for o, _ in out}) == F
+        out = mia.score("mutual.info.selection", redundancy_factor=0.5)
+        assert len(out) == F
+
+    def test_mifs_first_pick_is_mim_best(self, mia):
+        assert mia.mifs()[0][0] == mia.mim()[0][0]
+
+
+class TestCorrelations:
+    def test_cramer_perfect_association(self, churn):
+        # table where feature determines class exactly
+        t = np.array([[50.0, 0.0], [0.0, 50.0]])
+        np.testing.assert_allclose(cramer_index(t), 1.0, atol=1e-9)
+        t_ind = np.array([[25.0, 25.0], [25.0, 25.0]])
+        np.testing.assert_allclose(cramer_index(t_ind), 0.0, atol=1e-9)
+
+    def test_cramer_correlation_ranks_signal(self, churn):
+        corr = cramer_correlation(churn)
+        assert all(0 <= v <= 1.0 + 1e-9 for v in corr.values())
+        # CSCalls (ord 3) carries planted signal: stronger than random-ish
+        assert corr[3] > 0.05
+
+    def test_heterogeneity_reduction_bounds(self, churn):
+        for algo in ("entropy", "gini"):
+            hr = heterogeneity_reduction(churn, algo)
+            assert all(-1e-9 <= v <= 1.0 for v in hr.values())
+
+    def test_numerical_correlation_shape(self, churn):
+        m = numerical_correlation(churn)
+        # 1 numeric feature + class
+        assert m.shape == (2, 2)
+        np.testing.assert_allclose(np.diag(m), 1.0, atol=1e-9)
+        # acctAge negatively correlates with churn (closed accounts are young)
+        assert m[0, 1] < -0.2
+
+
+class TestRelief:
+    def test_informative_features_rank_higher(self, churn):
+        w = relief_relevance(churn, sample_size=600, seed=1)
+        # CSCalls (ord 3, planted strong signal) should beat acctAge bucket
+        assert w[3] > 0.0
+
+
+class TestAffinityEncoding:
+    def test_class_affinity(self, churn):
+        fld = churn.schema.field_by_ordinal(3)      # CSCalls
+        aff = class_affinity(churn, fld, top_n=2)
+        assert set(aff) == {"open", "closed"}
+        # churned customers call support more
+        assert aff["closed"][0][0] == "high"
+
+    def test_supervised_ratio_encoding(self, churn):
+        fld = churn.schema.field_by_ordinal(4)      # payment
+        enc = supervised_encoding(churn, fld, "supervisedRatio",
+                                  pos_class="closed")
+        tab = contingency(churn, fld)
+        idx = fld.cardinality_index()["poor"]
+        np.testing.assert_allclose(
+            enc["poor"], tab[idx, 1] / tab[idx].sum(), atol=1e-9
+        )
+        # poor payers churn more
+        assert enc["poor"] > enc["good"]
+
+    def test_weight_of_evidence_monotone(self, churn):
+        fld = churn.schema.field_by_ordinal(4)
+        woe = supervised_encoding(churn, fld, "weightOfEvidence",
+                                  pos_class="closed")
+        assert woe["poor"] > woe["good"]
+
+
+class TestSamplers:
+    def test_undersample_balances(self, churn):
+        bal = undersample_balance(churn, seed=2)
+        counts = np.bincount(bal.labels(), minlength=2)
+        assert counts[0] == counts[1]
+
+    def test_bagging_size(self, churn):
+        bs = bagging_sample(churn, rate=0.5, seed=3)
+        assert len(bs) == len(churn) // 2
+
+
+class TestTopMatchesAndRules:
+    def test_top_matches_same_class(self, churn):
+        out = top_matches_by_class(churn.take(np.arange(300)), k=2, block=64)
+        y = churn.take(np.arange(300)).labels()
+        for cv, (dist, idx) in out.items():
+            ki = churn.schema.class_values().index(cv)
+            # all matched neighbors belong to the same class
+            assert (y[idx] == ki).all()
+            assert (dist >= 0).all()
+
+    def test_rule_support_confidence(self, churn):
+        rule = Rule(condition=["3 eq high"], consequence=["6 eq closed"])
+        out = rule.evaluate(churn)
+        y = churn.labels()
+        codes, _ = churn.feature_codes()
+        cond = codes[:, 2] == 2                     # CSCalls == high
+        both = cond & (y == 1)
+        np.testing.assert_allclose(out["support"], both.sum() / len(churn))
+        np.testing.assert_allclose(out["confidence"], both.sum() / cond.sum())
+        assert out["confidence"] > 0.4              # planted signal

@@ -1,0 +1,195 @@
+"""Markov/HMM family vs NumPy oracles."""
+
+import numpy as np
+import pytest
+
+from avenir_tpu.models.markov import (
+    HiddenMarkovModel,
+    HiddenMarkovModelBuilder,
+    MarkovModelClassifier,
+    MarkovStateTransitionModel,
+    ProbabilisticSuffixTree,
+    StateTransitionRate,
+    ViterbiDecoder,
+    encode_sequences,
+    event_time_distribution,
+    generate_markov_sequences,
+)
+
+STATES = ["A", "B", "C"]
+
+
+def chain_sequences(trans, n, length, seed):
+    init = np.ones(len(STATES)) / len(STATES)
+    return generate_markov_sequences(trans, init, STATES, n, length, seed)
+
+
+@pytest.fixture(scope="module")
+def sticky_trans():
+    return np.array([[0.8, 0.1, 0.1], [0.1, 0.8, 0.1], [0.1, 0.1, 0.8]])
+
+
+@pytest.fixture(scope="module")
+def jumpy_trans():
+    return np.array([[0.1, 0.45, 0.45], [0.45, 0.1, 0.45], [0.45, 0.45, 0.1]])
+
+
+class TestTransitionModel:
+    def test_counts_match_oracle(self):
+        seqs = [["A", "B", "B", "C"], ["B", "A"]]
+        m = MarkovStateTransitionModel(STATES).fit(seqs)
+        expect = np.zeros((3, 3))
+        expect[0, 1] += 1; expect[1, 1] += 1; expect[1, 2] += 1; expect[1, 0] += 1
+        np.testing.assert_allclose(m.counts[0], expect)
+
+    def test_row_normalized_scaled(self, sticky_trans):
+        seqs = chain_sequences(sticky_trans, 200, 30, seed=1)
+        m = MarkovStateTransitionModel(STATES, scale=1000).fit(seqs)
+        mat = m.matrix()
+        assert mat.shape == (3, 3)
+        # scaled rows sum to ~scale and diagonal dominates
+        np.testing.assert_allclose(mat.sum(axis=1), 1000, atol=3)
+        assert (np.diag(mat) > 600).all()
+
+    def test_file_roundtrip(self, sticky_trans, tmp_path):
+        seqs = chain_sequences(sticky_trans, 100, 20, seed=2)
+        m = MarkovStateTransitionModel(
+            STATES, class_labels=["x", "y"]
+        ).fit(seqs, labels=["x", "y"] * 50)
+        p = tmp_path / "markov.txt"
+        m.save(str(p))
+        lines = open(p).read().splitlines()
+        assert lines[0] == "A,B,C"
+        assert "classLabel:x" in lines
+        again = MarkovStateTransitionModel.load(str(p))
+        # loaded scaled matrices act as counts; normalized matrices agree
+        np.testing.assert_allclose(
+            again.matrix("x", scaled=False), m.matrix("x", scaled=False),
+            atol=2e-3,
+        )
+
+
+class TestClassifier:
+    def test_separates_chain_types(self, sticky_trans, jumpy_trans):
+        pos = chain_sequences(sticky_trans, 150, 25, seed=3)
+        neg = chain_sequences(jumpy_trans, 150, 25, seed=4)
+        m = MarkovStateTransitionModel(STATES, class_labels=["sticky", "jumpy"])
+        m.fit(pos + neg, labels=["sticky"] * 150 + ["jumpy"] * 150)
+        clf = MarkovModelClassifier(m, pos_class="sticky", neg_class="jumpy")
+        pred_pos, _ = clf.predict(chain_sequences(sticky_trans, 60, 25, seed=5))
+        pred_neg, _ = clf.predict(chain_sequences(jumpy_trans, 60, 25, seed=6))
+        assert (pred_pos == "sticky").mean() > 0.9
+        assert (pred_neg == "jumpy").mean() > 0.9
+
+
+class TestHMM:
+    @pytest.fixture(scope="class")
+    def hmm_data(self):
+        """2 hidden states with distinct emission profiles."""
+        rng = np.random.default_rng(7)
+        trans = np.array([[0.9, 0.1], [0.1, 0.9]])
+        emis = np.array([[0.8, 0.15, 0.05], [0.05, 0.15, 0.8]])
+        states, obs = ["H", "L"], ["up", "flat", "down"]
+        state_seqs, obs_seqs = [], []
+        for _ in range(120):
+            s = rng.integers(0, 2)
+            ss, oo = [], []
+            for _ in range(40):
+                ss.append(states[s])
+                oo.append(obs[rng.choice(3, p=emis[s])])
+                s = rng.choice(2, p=trans[s])
+            state_seqs.append(ss)
+            obs_seqs.append(oo)
+        return states, obs, state_seqs, obs_seqs, trans, emis
+
+    def test_builder_recovers_params(self, hmm_data):
+        states, obs, ss, oo, trans, emis = hmm_data
+        hmm = HiddenMarkovModelBuilder(states, obs).fit(ss, oo)
+        np.testing.assert_allclose(hmm.transition, trans, atol=0.05)
+        np.testing.assert_allclose(hmm.emission, emis, atol=0.05)
+
+    def test_viterbi_decodes_majority_correct(self, hmm_data):
+        states, obs, ss, oo, trans, emis = hmm_data
+        hmm = HiddenMarkovModelBuilder(states, obs).fit(ss, oo)
+        decoder = ViterbiDecoder(hmm)
+        paths = decoder.decode(oo[:20])
+        correct = np.mean([
+            np.mean([a == b for a, b in zip(paths[i], ss[i])])
+            for i in range(20)
+        ])
+        assert correct > 0.8
+
+    def test_viterbi_matches_numpy_oracle(self, hmm_data):
+        states, obs, ss, oo, trans, emis = hmm_data
+        hmm = HiddenMarkovModelBuilder(states, obs).fit(ss, oo)
+        seq = oo[0]
+        got = ViterbiDecoder(hmm).decode([seq])[0]
+
+        # numpy viterbi
+        oidx = [obs.index(o) for o in seq]
+        lt = np.log(hmm.transition)
+        le = np.log(hmm.emission)
+        li = np.log(hmm.initial)
+        T, S = len(seq), 2
+        delta = li + le[:, oidx[0]]
+        back = np.zeros((T, S), int)
+        for t in range(1, T):
+            cand = delta[:, None] + lt
+            back[t] = cand.argmax(axis=0)
+            delta = cand.max(axis=0) + le[:, oidx[t]]
+        path = [int(delta.argmax())]
+        for t in range(T - 1, 0, -1):
+            path.append(back[t][path[-1]])
+        oracle = [states[s] for s in path[::-1]]
+        assert got == oracle
+
+    def test_hmm_file_roundtrip(self, hmm_data, tmp_path):
+        states, obs, ss, oo, *_ = hmm_data
+        hmm = HiddenMarkovModelBuilder(states, obs).fit(ss, oo)
+        p = tmp_path / "hmm.txt"
+        hmm.save(str(p))
+        again = HiddenMarkovModel.load(str(p))
+        np.testing.assert_allclose(again.transition, hmm.transition, atol=1e-5)
+        np.testing.assert_allclose(again.emission, hmm.emission, atol=1e-5)
+
+
+class TestPST:
+    def test_conditional_probabilities(self):
+        seqs = [list("ababab"), list("ababab")]
+        pst = ProbabilisticSuffixTree(["a", "b"], max_depth=2).fit(seqs)
+        assert pst.cond_prob(["a"], "b") > 0.95
+        assert pst.cond_prob(["b"], "a") > 0.95
+        # unseen context falls back to shorter suffix
+        assert pst.cond_prob(["b", "b"], "a") > 0.5
+
+    def test_sequence_log_prob_ranks(self):
+        seqs = [list("abcabcabc")] * 5
+        pst = ProbabilisticSuffixTree(["a", "b", "c"], max_depth=2).fit(seqs)
+        assert pst.sequence_log_prob(list("abcabc")) > pst.sequence_log_prob(
+            list("aaaaaa")
+        )
+
+
+class TestCTMC:
+    def test_rates_and_dwell(self):
+        # A dwells 10s then -> B; B dwells 5s then -> A
+        seqs = [[("A", 0.0), ("B", 10.0), ("A", 15.0), ("B", 25.0)]]
+        r = StateTransitionRate(["A", "B"]).fit(seqs)
+        rates = r.rates()
+        np.testing.assert_allclose(rates[0, 1], 2 / 20.0)
+        np.testing.assert_allclose(rates[1, 0], 1 / 5.0)
+        stats = r.dwell_stats()
+        np.testing.assert_allclose(stats["A"][0], 10.0)
+
+    def test_event_time_distribution(self):
+        seqs = [[0.0, 3600.0, 7200.0, 7260.0]]
+        hist = event_time_distribution(seqs, num_buckets=4, bucket_width=3600)
+        np.testing.assert_array_equal(hist, [1, 2, 0, 0])
+
+
+class TestEncoding:
+    def test_padding(self):
+        padded, lens = encode_sequences([["A"], ["A", "B", "C"]], STATES)
+        assert padded.shape == (2, 3)
+        np.testing.assert_array_equal(padded[0], [0, -1, -1])
+        np.testing.assert_array_equal(lens, [1, 3])

@@ -1,0 +1,126 @@
+"""Logistic regression, Fisher discriminant, clustering."""
+
+import numpy as np
+import pytest
+
+from avenir_tpu.data import generate_elearn
+from avenir_tpu.models.regress import (
+    CONVERGED,
+    NOT_CONVERGED,
+    LogisticRegression,
+)
+from avenir_tpu.models.discriminant import FisherDiscriminant
+from avenir_tpu.models.cluster import (
+    DBSCAN,
+    AgglomerativeGraphical,
+    KMeans,
+    cohesion,
+    dataset_distance_matrix,
+    inter_cluster_distance,
+)
+
+
+@pytest.fixture(scope="module")
+def elearn():
+    return generate_elearn(1500, seed=31)
+
+
+class TestLogisticRegression:
+    def test_learns_separable_data(self, elearn):
+        lr = LogisticRegression(learning_rate=2.0, iteration_limit=200).fit(elearn)
+        cm = lr.validate(elearn)
+        assert cm.accuracy() > 0.9
+
+    def test_gradient_matches_numpy(self, elearn):
+        lr = LogisticRegression(learning_rate=0.5, iteration_limit=1).fit(elearn)
+        x = elearn.feature_matrix().astype(np.float64)
+        x = (x - x.mean(axis=0)) / np.maximum(x.std(axis=0), 1e-9)
+        x = np.concatenate([np.ones((len(elearn), 1)), x], axis=1)
+        y = elearn.labels().astype(np.float64)
+        # one step from zero coefficients
+        p = 1.0 / (1.0 + np.exp(0.0))
+        grad = x.T @ (y - p) / len(y)
+        np.testing.assert_allclose(lr.coeff_history[1], 0.5 * grad, rtol=1e-4)
+
+    def test_convergence_criteria(self, elearn):
+        lr = LogisticRegression(
+            learning_rate=0.1, iteration_limit=500,
+            convergence_criteria="averageBelowThreshold",
+            convergence_threshold=0.5,
+        ).fit(elearn)
+        # stopped early on the threshold
+        assert len(lr.coeff_history) - 1 < 500
+        assert lr.check_convergence() == CONVERGED
+
+    def test_coeff_history_file(self, elearn, tmp_path):
+        lr = LogisticRegression(iteration_limit=5).fit(elearn)
+        p = tmp_path / "coeff.txt"
+        lr.save_coeff_history(str(p))
+        last = LogisticRegression.load_coeff(str(p))
+        np.testing.assert_allclose(last, lr.coeff, atol=1e-5)
+        assert len(open(p).read().splitlines()) == len(lr.coeff_history)
+
+
+class TestFisher:
+    def test_boundary_between_means(self, elearn):
+        fd = FisherDiscriminant().fit(elearn)
+        ordn = elearn.schema.feature_fields[0].ordinal
+        m0, m1 = fd.means[ordn]
+        # near-equal priors -> boundary close to midpoint, between means
+        assert min(m0, m1) < fd.boundaries[ordn] < max(m0, m1)
+
+    def test_single_feature_classification(self, elearn):
+        fd = FisherDiscriminant().fit(elearn)
+        ordn = elearn.schema.feature_fields[0].ordinal
+        pred = fd.predict(elearn, ordn)
+        acc = (pred == elearn.labels()).mean()
+        assert acc > 0.8
+
+
+class TestClustering:
+    @pytest.fixture(scope="class")
+    def blobs(self):
+        rng = np.random.default_rng(5)
+        a = rng.normal(0, 0.5, (50, 2))
+        b = rng.normal(5, 0.5, (50, 2))
+        return np.concatenate([a, b]).astype(np.float32)
+
+    def test_kmeans_separates_blobs(self, blobs):
+        km = KMeans(k=2, seed=1).fit(blobs)
+        l = km.labels_
+        # all of cluster a together, all of b together
+        assert len(set(l[:50])) == 1 and len(set(l[50:])) == 1
+        assert l[0] != l[60]
+
+    def test_kmeans_predict(self, blobs):
+        km = KMeans(k=2, seed=1).fit(blobs)
+        pred = km.predict(np.array([[0.1, 0.1], [5.1, 4.9]], np.float32))
+        assert pred[0] != pred[1]
+
+    def test_agglomerative(self, blobs):
+        d = np.sqrt(((blobs[:, None] - blobs[None]) ** 2).sum(-1))
+        ag = AgglomerativeGraphical(num_clusters=2).fit(d)
+        l = ag.labels_
+        assert len(set(l[:50])) == 1 and len(set(l[50:])) == 1
+        assert l[0] != l[60]
+
+    def test_dbscan(self, blobs):
+        d = np.sqrt(((blobs[:, None] - blobs[None]) ** 2).sum(-1))
+        db = DBSCAN(eps=1.0, min_samples=4).fit(d)
+        labs = db.labels_
+        assert len(set(labs[labs >= 0])) == 2
+
+    def test_quality_metrics(self, blobs):
+        km2 = KMeans(k=2, seed=1).fit(blobs)
+        km5 = KMeans(k=5, seed=1).fit(blobs)
+        # true k has lower cohesion per cluster count trade-off and clear
+        # separation
+        assert cohesion(blobs, km2.labels_) < 2.0
+        assert inter_cluster_distance(blobs, km2.labels_) > 4.0
+
+    def test_dataset_distance_matrix(self, elearn):
+        sub = elearn.take(np.arange(40))
+        d = dataset_distance_matrix(sub)
+        assert d.shape == (40, 40)
+        np.testing.assert_allclose(np.diag(d), 0.0, atol=1e-3)
+        assert (d >= -1e-6).all()

@@ -1,0 +1,195 @@
+"""Decision tree / random forest: split enumeration, learning, model format."""
+
+import json
+
+import numpy as np
+import pytest
+
+from avenir_tpu.core.dataset import Dataset
+from avenir_tpu.core.schema import FeatureSchema
+from avenir_tpu.data import generate_churn, churn_schema
+from avenir_tpu.models.tree import (
+    DecisionPathList,
+    DecisionTreeBuilder,
+    RandomForestBuilder,
+    enumerate_splits,
+    _set_partitions,
+)
+
+HANGUP_SCHEMA = FeatureSchema.from_json({
+    "fields": [
+        {"name": "id", "ordinal": 0, "id": True, "dataType": "string"},
+        {"name": "custType", "ordinal": 1, "dataType": "categorical",
+         "feature": True, "maxSplit": 2,
+         "cardinality": ["business", "residence"]},
+        {"name": "holdTime", "ordinal": 2, "dataType": "int", "feature": True,
+         "min": 0, "max": 600, "bucketWidth": 60, "maxSplit": 2,
+         "splitScanInterval": 200},
+        {"name": "hungup", "ordinal": 3, "dataType": "categorical",
+         "cardinality": ["no", "yes"]},
+    ]
+})
+
+
+def hangup_data(n, seed=0):
+    """hold time > 300 and residence -> mostly hangs up."""
+    rng = np.random.default_rng(seed)
+    ct = rng.integers(0, 2, n)
+    ht = rng.integers(0, 600, n)
+    p = 0.08 + 0.75 * ((ht > 300) & (ct == 1)) + 0.1 * (ht > 300)
+    y = (rng.random(n) < p).astype(int)
+    rows = [
+        [f"c{i}", ["business", "residence"][ct[i]], str(ht[i]),
+         ["no", "yes"][y[i]]]
+        for i in range(n)
+    ]
+    return Dataset.from_rows(rows, HANGUP_SCHEMA)
+
+
+class TestSplitEnumeration:
+    def test_set_partitions_binary(self):
+        parts = _set_partitions(["a", "b", "c"], 2)
+        # 3 ways to 2-partition a 3-set
+        assert len(parts) == 3
+        for groups in parts:
+            assert sorted(sum(groups, [])) == ["a", "b", "c"]
+            assert len(groups) == 2
+
+    def test_numeric_split_predicates(self):
+        splits = enumerate_splits(HANGUP_SCHEMA)
+        num = [s for s in splits if s.attribute == 2]
+        # scan interval 200 over (0,600) -> points {200,400}, maxSplit 2 ->
+        # two 2-segment splits
+        assert len(num) == 2
+        s0 = num[0]
+        assert s0.predicates[0].to_string() == "2 lt 200"
+        assert s0.predicates[1].to_string() == "2 ge 200"
+        col = np.array([0, 199, 200, 599], dtype=np.float32)
+        np.testing.assert_array_equal(s0.segment_of(col), [0, 0, 1, 1])
+
+    def test_categorical_split_predicates(self):
+        splits = enumerate_splits(HANGUP_SCHEMA)
+        cat = [s for s in splits if s.attribute == 1]
+        assert len(cat) == 1
+        assert cat[0].predicates[0].operator == "in"
+        col = np.array([0, 1, 0])
+        segs = cat[0].segment_of(col)
+        assert segs[0] != segs[1] and segs[0] == segs[2]
+
+
+class TestTreeLearning:
+    def test_learns_planted_rule(self):
+        ds = hangup_data(4000, seed=1)
+        tree = DecisionTreeBuilder(
+            HANGUP_SCHEMA, split_algorithm="giniIndex", max_depth=2,
+            attr_selection_strategy="notUsedYet",
+        ).fit(ds)
+        test = hangup_data(1000, seed=2)
+        pred = tree.predict(test, ["no", "yes"])
+        acc = (pred == test.labels()).mean()
+        assert acc > 0.75
+
+    def test_depth_one_picks_oracle_best_split(self):
+        ds = hangup_data(3000, seed=3)
+        tree = DecisionTreeBuilder(
+            HANGUP_SCHEMA, split_algorithm="giniIndex", max_depth=1
+        ).fit(ds)
+        # numpy oracle: weighted gini of every candidate split
+        y = ds.labels()
+        splits = enumerate_splits(HANGUP_SCHEMA)
+        best, best_score = None, np.inf
+        for si, sp in enumerate(splits):
+            seg = sp.segment_of(np.asarray(ds.column(sp.attribute)))
+            score = 0.0
+            for s in range(sp.n_segments):
+                m = seg == s
+                if m.sum() == 0:
+                    continue
+                p = np.bincount(y[m], minlength=2) / m.sum()
+                score += m.sum() / len(y) * (1 - (p ** 2).sum())
+            if score < best_score:
+                best, best_score = sp, score
+        attrs = {p.predicates[0].attribute for p in tree.paths if p.predicates}
+        assert attrs == {best.attribute}
+        # the chosen segment predicates match the oracle split's
+        got = sorted(p.predicates[0].to_string() for p in tree.paths)
+        want = sorted(pr.to_string() for pr in best.predicates)
+        assert got == want
+
+    def test_entropy_vs_gini_both_work(self):
+        ds = hangup_data(2000, seed=4)
+        for algo in ("entropy", "giniIndex"):
+            tree = DecisionTreeBuilder(
+                HANGUP_SCHEMA, split_algorithm=algo, max_depth=2
+            ).fit(ds)
+            assert len(tree.paths) >= 2
+
+    def test_populations_sum_to_n(self):
+        ds = hangup_data(1500, seed=5)
+        tree = DecisionTreeBuilder(HANGUP_SCHEMA, max_depth=2).fit(ds)
+        assert sum(p.population for p in tree.paths) == 1500
+
+    def test_min_population_stops(self):
+        ds = hangup_data(500, seed=6)
+        tree = DecisionTreeBuilder(
+            HANGUP_SCHEMA, max_depth=4, stopping_strategy="minPopulation",
+            min_population=10_000,
+        ).fit(ds)
+        # root can never split
+        assert len(tree.paths) == 1 and tree.paths[0].predicates == []
+
+
+class TestModelFormat:
+    def test_json_roundtrip(self, tmp_path):
+        ds = hangup_data(2000, seed=7)
+        tree = DecisionTreeBuilder(HANGUP_SCHEMA, max_depth=2).fit(ds)
+        p = tmp_path / "decPathOut.txt"
+        tree.save(str(p))
+        obj = json.load(open(p))
+        assert "decisionPaths" in obj
+        path0 = obj["decisionPaths"][0]
+        assert {"population", "infoContent", "stopped", "classValPr"} <= set(path0)
+        again = DecisionPathList.load(str(p))
+        test = hangup_data(300, seed=8)
+        np.testing.assert_array_equal(
+            tree.predict(test, ["no", "yes"]),
+            again.predict(test, ["no", "yes"]),
+        )
+
+    def test_predicate_strings_reference_format(self):
+        ds = hangup_data(1000, seed=9)
+        tree = DecisionTreeBuilder(HANGUP_SCHEMA, max_depth=1).fit(ds)
+        for path in tree.paths:
+            for pr in path.predicates:
+                s = pr.to_string()
+                parts = s.split(" ")
+                assert parts[1] in ("ge", "lt", "gt", "le", "in")
+
+
+class TestRandomForest:
+    def test_forest_beats_chance(self):
+        ds = hangup_data(3000, seed=10)
+        rf = RandomForestBuilder(
+            HANGUP_SCHEMA, num_trees=5, max_depth=2, seed=3
+        ).fit(ds)
+        test = hangup_data(800, seed=11)
+        cm = rf.validate(test, pos_class=1)
+        assert cm.accuracy() > 0.72
+
+    def test_sampling_strategies(self):
+        ds = hangup_data(800, seed=12)
+        for sampling in ("withReplace", "withoutReplace", "none"):
+            rf = RandomForestBuilder(
+                HANGUP_SCHEMA, num_trees=2, sampling=sampling, max_depth=1
+            ).fit(ds)
+            assert len(rf.trees) == 2
+
+    def test_churn_end_to_end(self):
+        ds = generate_churn(2500, seed=13)
+        rf = RandomForestBuilder(
+            churn_schema(), num_trees=5, max_depth=3, seed=1,
+            cat_partition_cap=32,
+        ).fit(ds)
+        test = generate_churn(600, seed=14)
+        cm = rf.validate(test, pos_class=1)
+        assert cm.accuracy() > 0.75
