@@ -208,8 +208,9 @@ def extract_mixed_features(ds: "Dataset"):
     ranges float32 [Dn], x_cat int32 [n, Dc] | None, cat_bins tuple | None).
 
     Ranges come from the schema's declared min/max (1.0 fallback) — the
-    normalization the mixed-attribute distance metric uses. Shared by KNN,
-    clustering and Relief so the convention lives in one place."""
+    normalization the mixed-attribute distance metric uses. Shared by KNN
+    and clustering. (Relief normalizes per-feature diffs itself with a
+    data-derived range fallback — explore.relief_relevance.)"""
     num_fields = [f for f in ds.schema.feature_fields if f.is_numeric]
     cat_fields = [f for f in ds.schema.feature_fields if f.is_categorical]
     x_num = ds.feature_matrix(num_fields)

@@ -1,0 +1,368 @@
+"""Association mining: Apriori frequent itemsets + rule generation.
+
+Reference (SURVEY §2.5): org/avenir/association/ — FrequentItemsApriori runs
+one MR job per itemset length k (driver loops over k): k=1 emits each item
+(FrequentItemsApriori.java:138-150); k>1 loads the frequent (k-1)-itemset
+file and extends each itemset with co-occurring items, sorted-key dedup
+(:151-195); values are transaction ids (exact support, fia.emit.trans.id) or
+counts; the reducer thresholds support = count / fia.total.tans.count
+against fia.support.threshold. InfrequentItemMarker.java:41-46 replaces
+infrequent items with a marker token after k=1 to shrink later scans.
+AssociationRuleMiner.java:44-190 generates antecedent sublists (up to
+arm.max.ante.size) of each frequent itemset and keeps rules whose
+confidence = support(itemset) / support(antecedent) exceeds
+arm.conf.threshold.
+
+TPU-native design: transactions are multi-hot rows of an [N, V] matrix over
+the item vocabulary (dictionary-encoded at ingest, like every other
+categorical in this framework). Candidate k-itemsets are an [C, V] multi-hot
+matrix; "transaction contains candidate" is exactly
+`(T @ C.T) == k` — one blocked matmul on the MXU per transaction tile
+replaces the Hadoop shuffle. Candidate *generation* stays on the host
+(classical Apriori join + subset prune over the frequent (k-1) sets): it is
+tiny, irregular, and data-dependent — the wrong shape for XLA — while the
+support counting it gates is the N-proportional work and runs on device.
+The per-k loop of the reference's driver survives as a host loop; the
+frequent-itemset state between rounds stays as a plain file via save/load
+(the reference's "model = file between steps" property, SURVEY §5).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from functools import partial
+from itertools import combinations
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple, Union
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+
+# --------------------------------------------------------------------------
+# Transaction ingest
+# --------------------------------------------------------------------------
+class TransactionSet:
+    """Dictionary-encoded transactions: multi-hot uint8 [N, V] + id column.
+
+    Input rows follow the reference's layout (FrequentItemsApriori.java:
+    134-150): a transaction id at `trans_id_ord`, `skip_field_count` leading
+    non-item fields, every remaining field an item token. A `marker` token
+    (InfrequentItemMarker output) is dropped at ingest.
+    """
+
+    def __init__(self, multihot: np.ndarray, vocab: List[str],
+                 trans_ids: np.ndarray):
+        self.multihot = multihot            # uint8 [N, V]
+        self.vocab = vocab                  # item id -> token
+        self.index = {t: i for i, t in enumerate(vocab)}
+        self.trans_ids = trans_ids          # object [N]
+
+    @classmethod
+    def from_rows(cls, rows: Sequence[Sequence[str]], trans_id_ord: int = 0,
+                  skip_field_count: int = 1,
+                  marker: Optional[str] = None) -> "TransactionSet":
+        vocab: List[str] = []
+        index: Dict[str, int] = {}
+        encoded: List[List[int]] = []
+        ids: List[str] = []
+        for row in rows:
+            ids.append(row[trans_id_ord])
+            items = []
+            for tok in row[skip_field_count:]:
+                if tok == "" or (marker is not None and tok == marker):
+                    continue
+                if tok not in index:
+                    index[tok] = len(vocab)
+                    vocab.append(tok)
+                items.append(index[tok])
+            encoded.append(items)
+        mh = np.zeros((len(rows), max(len(vocab), 1)), dtype=np.uint8)
+        for i, items in enumerate(encoded):
+            mh[i, items] = 1
+        return cls(mh, vocab, np.array(ids, dtype=object))
+
+    @classmethod
+    def from_csv(cls, source: Union[str, Iterable[str]], delim: str = ",",
+                 trans_id_ord: int = 0, skip_field_count: int = 1,
+                 marker: Optional[str] = None) -> "TransactionSet":
+        import io, os
+        if isinstance(source, str):
+            if os.path.exists(source):
+                lines: Iterable[str] = open(source, "r")
+            else:
+                lines = io.StringIO(source)
+        else:
+            lines = source
+        rows = [
+            [t.strip() for t in ln.rstrip("\n").split(delim)]
+            for ln in lines if ln.strip()
+        ]
+        if hasattr(lines, "close") and lines is not source:
+            lines.close()
+        return cls.from_rows(rows, trans_id_ord, skip_field_count, marker)
+
+    def __len__(self) -> int:
+        return self.multihot.shape[0]
+
+
+# --------------------------------------------------------------------------
+# Itemset containers (the between-rounds file state)
+# --------------------------------------------------------------------------
+@dataclass
+class ItemSet:
+    items: Tuple[str, ...]          # sorted item tokens
+    support: float                  # fraction of transactions
+    count: int
+    trans_ids: Optional[List[str]] = None
+
+    def line(self, delim: str = ",") -> str:
+        parts = list(self.items) + [f"{self.support:.6f}"]
+        if self.trans_ids is not None:
+            parts += list(self.trans_ids)
+        return delim.join(parts)
+
+
+@dataclass
+class ItemSetList:
+    """Frequent itemsets of one length k (association/ItemSetList.java:34):
+    the file handed from round k to round k+1."""
+    length: int
+    item_sets: List[ItemSet] = field(default_factory=list)
+
+    def save(self, path: str, delim: str = ",") -> None:
+        with open(path, "w") as fh:
+            for s in self.item_sets:
+                fh.write(s.line(delim) + "\n")
+
+    @classmethod
+    def load(cls, path: str, length: int, with_trans_ids: bool = False,
+             delim: str = ",") -> "ItemSetList":
+        sets = []
+        with open(path) as fh:
+            for ln in fh:
+                toks = [t.strip() for t in ln.rstrip("\n").split(delim)]
+                if not toks or toks == [""]:
+                    continue
+                items = tuple(toks[:length])
+                support = float(toks[length])
+                tids = toks[length + 1:] if with_trans_ids else None
+                sets.append(ItemSet(items, support, 0, tids))
+        return cls(length, sets)
+
+    def supports(self) -> Dict[Tuple[str, ...], float]:
+        return {s.items: s.support for s in self.item_sets}
+
+    def __len__(self) -> int:
+        return len(self.item_sets)
+
+
+# --------------------------------------------------------------------------
+# Device support counting
+# --------------------------------------------------------------------------
+@partial(jax.jit, static_argnames=("k",))
+def _contain_counts(trans: jnp.ndarray, cand: jnp.ndarray, k: int):
+    """counts[c] = #transactions containing all k items of candidate c.
+
+    trans float32 [B, V] multi-hot tile, cand float32 [C, V] multi-hot.
+    The matmul rides the MXU; equality against the static k recovers exact
+    set containment."""
+    overlap = trans @ cand.T                       # [B, C]
+    return jnp.sum(overlap >= k, axis=0, dtype=jnp.int32)
+
+
+@partial(jax.jit, static_argnames=("k",))
+def _contain_mask(trans: jnp.ndarray, cand: jnp.ndarray, k: int):
+    return (trans @ cand.T) >= k                   # [B, C] bool
+
+
+def _count_support(multihot: np.ndarray, cand_rows: np.ndarray, k: int,
+                   block: int = 8192,
+                   want_mask: bool = False):
+    """Blocked streaming support count over transaction tiles."""
+    n, v = multihot.shape
+    c = cand_rows.shape[0]
+    counts = np.zeros((c,), dtype=np.int64)
+    masks = [] if want_mask else None
+    cand_f = jnp.asarray(cand_rows, dtype=jnp.float32)
+    for s in range(0, n, block):
+        tile = jnp.asarray(multihot[s:s + block], dtype=jnp.float32)
+        if want_mask:
+            m = np.asarray(_contain_mask(tile, cand_f, k))
+            masks.append(m)
+            counts += m.sum(axis=0)
+        else:
+            counts += np.asarray(_contain_counts(tile, cand_f, k), dtype=np.int64)
+    if want_mask:
+        return counts, np.concatenate(masks, axis=0)
+    return counts, None
+
+
+# --------------------------------------------------------------------------
+# Apriori driver
+# --------------------------------------------------------------------------
+def _generate_candidates(freq_prev: List[Tuple[int, ...]], k: int
+                         ) -> List[Tuple[int, ...]]:
+    """Classical Apriori join + prune on item-id tuples (host side).
+
+    Equivalent to the reference's extend-with-co-occurring-item + sorted-key
+    dedup (FrequentItemsApriori.java:151-195), minus the candidates the
+    subset prune can reject early."""
+    prev_set = set(freq_prev)
+    freq_sorted = sorted(freq_prev)
+    cands = []
+    for i, a in enumerate(freq_sorted):
+        for b in freq_sorted[i + 1:]:
+            if a[:-1] != b[:-1]:
+                break               # sorted: no more shared (k-2)-prefix
+            cand = a + (b[-1],)
+            # prune: all (k-1)-subsets must be frequent
+            if all(cand[:j] + cand[j + 1:] in prev_set for j in range(k)):
+                cands.append(cand)
+    return cands
+
+
+class FrequentItemsApriori:
+    """Frequent itemset miner: host per-k loop + device support matmuls.
+
+    Parameters mirror the reference's fia.* keys: support_threshold
+    (fia.support.threshold, fraction), max_length (driver loop bound),
+    emit_trans_id (fia.emit.trans.id → exact transaction id lists in the
+    output, FrequentItemsApriori.java:143-149)."""
+
+    def __init__(self, support_threshold: float, max_length: int = 3,
+                 emit_trans_id: bool = False, block: int = 8192):
+        self.support_threshold = support_threshold
+        self.max_length = max_length
+        self.emit_trans_id = emit_trans_id
+        self.block = block
+
+    def mine(self, tx: TransactionSet) -> List[ItemSetList]:
+        n = len(tx)
+        min_count = self.support_threshold * n
+        out: List[ItemSetList] = []
+
+        # k = 1: column sums of the multi-hot matrix
+        col_counts = self.multihot_item_counts(tx)
+        freq_ids: List[Tuple[int, ...]] = [
+            (i,) for i in range(len(tx.vocab)) if col_counts[i] > min_count
+        ]
+        out.append(self._pack(tx, freq_ids, 1))
+
+        for k in range(2, self.max_length + 1):
+            cands = _generate_candidates(freq_ids, k)
+            if not cands:
+                break
+            cand_rows = np.zeros((len(cands), tx.multihot.shape[1]),
+                                 dtype=np.uint8)
+            for ci, items in enumerate(cands):
+                cand_rows[ci, list(items)] = 1
+            counts, _ = _count_support(tx.multihot, cand_rows, k, self.block)
+            freq_ids = [c for c, cnt in zip(cands, counts) if cnt > min_count]
+            if not freq_ids:
+                break
+            out.append(self._pack(tx, freq_ids, k))
+        return out
+
+    def _pack(self, tx: TransactionSet, freq_ids: List[Tuple[int, ...]],
+              k: int) -> ItemSetList:
+        if not freq_ids:
+            return ItemSetList(k, [])
+        n = len(tx)
+        cand_rows = np.zeros((len(freq_ids), tx.multihot.shape[1]), np.uint8)
+        for ci, items in enumerate(freq_ids):
+            cand_rows[ci, list(items)] = 1
+        counts, mask = _count_support(
+            tx.multihot, cand_rows, k, self.block, want_mask=self.emit_trans_id
+        )
+        sets = []
+        for ci, ids in enumerate(freq_ids):
+            tokens = tuple(sorted(tx.vocab[i] for i in ids))
+            tids = (
+                [str(t) for t in tx.trans_ids[mask[:, ci]]]
+                if self.emit_trans_id else None
+            )
+            sets.append(ItemSet(tokens, counts[ci] / n, int(counts[ci]), tids))
+        sets.sort(key=lambda s: s.items)
+        return ItemSetList(k, sets)
+
+    @staticmethod
+    def multihot_item_counts(tx: TransactionSet) -> np.ndarray:
+        return tx.multihot.astype(np.int64).sum(axis=0)
+
+
+# --------------------------------------------------------------------------
+# Infrequent item marker
+# --------------------------------------------------------------------------
+class InfrequentItemMarker:
+    """Replace infrequent items with a marker token after the k=1 round
+    (InfrequentItemMarker.java:41-46) so later scans shrink."""
+
+    def __init__(self, frequent_items: Iterable[str], marker: str = "*",
+                 skip_field_count: int = 1):
+        self.frequent = set(frequent_items)
+        self.marker = marker
+        self.skip = skip_field_count
+
+    def mark_row(self, row: Sequence[str]) -> List[str]:
+        out = list(row[:self.skip])
+        for tok in row[self.skip:]:
+            out.append(tok if tok in self.frequent else self.marker)
+        return out
+
+    def mark(self, rows: Iterable[Sequence[str]]) -> List[List[str]]:
+        return [self.mark_row(r) for r in rows]
+
+
+# --------------------------------------------------------------------------
+# Rule mining
+# --------------------------------------------------------------------------
+@dataclass
+class AssociationRule:
+    antecedent: Tuple[str, ...]
+    consequent: Tuple[str, ...]
+    confidence: float
+    support: float                  # support of the full itemset
+    lift: float = float("nan")
+
+    def line(self) -> str:
+        return (",".join(self.antecedent) + " -> " + ",".join(self.consequent)
+                + f" ({self.confidence:.4f})")
+
+
+class AssociationRuleMiner:
+    """Rules from frequent itemsets (AssociationRuleMiner.java:94-190):
+    antecedent = each sublist up to max_ante_size, confidence =
+    support(itemset) / support(antecedent), kept when above the threshold
+    (arm.conf.threshold). Lift (vs the consequent's marginal support) is
+    added when the consequent's support is known."""
+
+    def __init__(self, conf_threshold: float, max_ante_size: int = 3):
+        self.conf_threshold = conf_threshold
+        self.max_ante_size = max_ante_size
+
+    def mine(self, item_set_lists: Sequence[ItemSetList]
+             ) -> List[AssociationRule]:
+        supports: Dict[Tuple[str, ...], float] = {}
+        for isl in item_set_lists:
+            supports.update(isl.supports())
+        rules: List[AssociationRule] = []
+        for isl in item_set_lists:
+            if isl.length < 2:
+                continue
+            for s in isl.item_sets:
+                items = s.items
+                for size in range(1, min(self.max_ante_size, len(items) - 1) + 1):
+                    for ante in combinations(items, size):
+                        ante_sup = supports.get(tuple(sorted(ante)))
+                        if ante_sup is None or ante_sup <= 0:
+                            continue
+                        conf = s.support / ante_sup
+                        if conf > self.conf_threshold:
+                            cons = tuple(t for t in items if t not in ante)
+                            cons_sup = supports.get(tuple(sorted(cons)))
+                            lift = (conf / cons_sup) if cons_sup else float("nan")
+                            rules.append(AssociationRule(
+                                ante, cons, conf, s.support, lift))
+        rules.sort(key=lambda r: (-r.confidence, r.antecedent, r.consequent))
+        return rules

@@ -439,7 +439,9 @@ class DecisionTreeBuilder:
                 node_imp = float(np.asarray(impurity_fn(jnp.asarray(cls_counts))))
 
                 allowed = self._allowed_splits(lf)
-                if pop <= 0 or not allowed:
+                if pop <= 0 or not allowed or node_imp <= 0.0:
+                    # pure nodes cannot improve; splitting them only burns
+                    # device passes and bloats the path list
                     lf["stopped"] = True
                     continue
                 cand = wimp[li, allowed]
@@ -468,9 +470,10 @@ class DecisionTreeBuilder:
                             "stopped": False,
                         })
                     else:
-                        # pad children so child ids stay contiguous per leaf
+                        # pad children so child ids stay contiguous per leaf;
+                        # never emitted as paths (no rows can route here)
                         new_leaves.append({"preds": lf["preds"], "used": lf["used"],
-                                           "stopped": True})
+                                           "stopped": True, "pad": True})
                 lf["split"] = bi           # parent becomes an internal node
 
             if not new_leaves:
@@ -489,8 +492,8 @@ class DecisionTreeBuilder:
             leaf_id, seg_d, labels_d, w, len(leaves), max(ns, 1), self.smax, k
         )) if ns else None
         for li, lf in enumerate(leaves):
-            if "split" in lf:
-                continue                   # internal node
+            if "split" in lf or lf.get("pad"):
+                continue                   # internal node / padded child slot
             cls_counts = (
                 counts_final[li, 0].sum(axis=0)
                 if counts_final is not None else np.zeros(k)
@@ -517,12 +520,16 @@ class DecisionTreeBuilder:
         if strat == "all":
             chosen = set(attrs)
         elif strat == "notUsedYet":
-            chosen = set(a for a in attrs if a not in used) or set(attrs)
+            # exhausted attributes stop the leaf rather than re-splitting on
+            # an already-used attribute (which yields duplicate predicates)
+            chosen = set(a for a in attrs if a not in used)
         elif strat == "randomAll":
             m = max(1, int(math.sqrt(len(attrs))))
             chosen = set(self.rng.choice(attrs, size=m, replace=False).tolist())
         elif strat == "randomNotUsedYet":
-            avail = [a for a in attrs if a not in used] or attrs
+            avail = [a for a in attrs if a not in used]
+            if not avail:
+                return []
             m = max(1, int(math.sqrt(len(avail))))
             chosen = set(self.rng.choice(avail, size=m, replace=False).tolist())
         else:

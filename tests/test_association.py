@@ -1,0 +1,150 @@
+"""Association mining tests: Apriori + rule miner vs a brute-force oracle."""
+
+import numpy as np
+import pytest
+
+from avenir_tpu.models.association import (
+    AssociationRuleMiner,
+    FrequentItemsApriori,
+    InfrequentItemMarker,
+    ItemSetList,
+    TransactionSet,
+)
+
+from itertools import combinations
+
+
+def brute_force_frequent(baskets, support_threshold, max_len):
+    """Oracle: enumerate all itemsets up to max_len, count by scan."""
+    n = len(baskets)
+    items = sorted({i for b in baskets for i in b})
+    out = {}
+    for k in range(1, max_len + 1):
+        for cand in combinations(items, k):
+            cnt = sum(1 for b in baskets if set(cand) <= set(b))
+            if cnt > support_threshold * n:
+                out[cand] = cnt / n
+    return out
+
+
+BASKETS = [
+    ["milk", "bread", "butter"],
+    ["milk", "bread"],
+    ["milk", "eggs"],
+    ["bread", "butter"],
+    ["milk", "bread", "butter", "eggs"],
+    ["bread", "eggs"],
+    ["milk", "bread", "eggs"],
+    ["butter"],
+]
+
+
+def rows_from_baskets(baskets):
+    return [[f"T{i}"] + b for i, b in enumerate(baskets)]
+
+
+class TestApriori:
+    def test_matches_brute_force(self):
+        tx = TransactionSet.from_rows(rows_from_baskets(BASKETS))
+        miner = FrequentItemsApriori(support_threshold=0.2, max_length=3)
+        got = {
+            s.items: s.support
+            for isl in miner.mine(tx)
+            for s in isl.item_sets
+        }
+        want = brute_force_frequent(BASKETS, 0.2, 3)
+        assert got == pytest.approx(want)
+
+    def test_random_matches_brute_force(self, rng):
+        vocab = [f"i{j}" for j in range(12)]
+        baskets = [
+            list(rng.choice(vocab, size=rng.integers(1, 7), replace=False))
+            for _ in range(200)
+        ]
+        tx = TransactionSet.from_rows(rows_from_baskets(baskets))
+        got = {
+            s.items: s.support
+            for isl in FrequentItemsApriori(0.1, max_length=4).mine(tx)
+            for s in isl.item_sets
+        }
+        want = brute_force_frequent(baskets, 0.1, 4)
+        assert got == pytest.approx(want)
+
+    def test_blocked_counting_matches_single_block(self, rng):
+        vocab = [f"i{j}" for j in range(10)]
+        baskets = [
+            list(rng.choice(vocab, size=rng.integers(1, 6), replace=False))
+            for _ in range(100)
+        ]
+        tx = TransactionSet.from_rows(rows_from_baskets(baskets))
+        a = FrequentItemsApriori(0.1, max_length=3, block=7).mine(tx)
+        b = FrequentItemsApriori(0.1, max_length=3, block=100000).mine(tx)
+        fa = {s.items: s.count for isl in a for s in isl.item_sets}
+        fb = {s.items: s.count for isl in b for s in isl.item_sets}
+        assert fa == fb
+
+    def test_trans_ids_exact(self):
+        tx = TransactionSet.from_rows(rows_from_baskets(BASKETS))
+        isls = FrequentItemsApriori(0.2, max_length=2,
+                                    emit_trans_id=True).mine(tx)
+        by_items = {s.items: s for isl in isls for s in isl.item_sets}
+        s = by_items[("bread", "milk")]
+        want = {f"T{i}" for i, b in enumerate(BASKETS)
+                if {"bread", "milk"} <= set(b)}
+        assert set(s.trans_ids) == want
+        assert s.count == len(want)
+
+    def test_save_load_roundtrip(self, tmp_path):
+        tx = TransactionSet.from_rows(rows_from_baskets(BASKETS))
+        isls = FrequentItemsApriori(0.2, max_length=2).mine(tx)
+        p = str(tmp_path / "fis2.csv")
+        isls[1].save(p)
+        loaded = ItemSetList.load(p, length=2)
+        assert loaded.supports() == pytest.approx(isls[1].supports())
+
+
+class TestMarker:
+    def test_marks_infrequent(self):
+        rows = rows_from_baskets(BASKETS)
+        tx = TransactionSet.from_rows(rows)
+        counts = FrequentItemsApriori.multihot_item_counts(tx)
+        frequent = [t for t, c in zip(tx.vocab, counts) if c > 0.3 * len(tx)]
+        marked = InfrequentItemMarker(frequent, marker="*").mark(rows)
+        for orig, m in zip(rows, marked):
+            assert m[0] == orig[0]
+            for o, t in zip(orig[1:], m[1:]):
+                assert t == (o if o in frequent else "*")
+        # marked input re-ingests cleanly, marker dropped
+        tx2 = TransactionSet.from_rows(marked, marker="*")
+        assert set(tx2.vocab) <= set(frequent)
+
+
+class TestRuleMiner:
+    def test_confidence_oracle(self):
+        tx = TransactionSet.from_rows(rows_from_baskets(BASKETS))
+        isls = FrequentItemsApriori(0.1, max_length=3).mine(tx)
+        sup = {}
+        for isl in isls:
+            sup.update(isl.supports())
+        rules = AssociationRuleMiner(conf_threshold=0.5).mine(isls)
+        assert rules, "expected some rules"
+        for r in rules:
+            full = tuple(sorted(r.antecedent + r.consequent))
+            want_conf = sup[full] / sup[tuple(sorted(r.antecedent))]
+            assert r.confidence == pytest.approx(want_conf)
+            assert r.confidence > 0.5
+            assert r.support == pytest.approx(sup[full])
+
+    def test_threshold_filters(self):
+        tx = TransactionSet.from_rows(rows_from_baskets(BASKETS))
+        isls = FrequentItemsApriori(0.1, max_length=3).mine(tx)
+        hi = AssociationRuleMiner(conf_threshold=0.9).mine(isls)
+        lo = AssociationRuleMiner(conf_threshold=0.1).mine(isls)
+        assert len(hi) <= len(lo)
+        assert all(r.confidence > 0.9 for r in hi)
+
+    def test_max_ante_size(self):
+        tx = TransactionSet.from_rows(rows_from_baskets(BASKETS))
+        isls = FrequentItemsApriori(0.1, max_length=3).mine(tx)
+        rules = AssociationRuleMiner(0.1, max_ante_size=1).mine(isls)
+        assert all(len(r.antecedent) == 1 for r in rules)

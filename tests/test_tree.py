@@ -193,3 +193,53 @@ class TestRandomForest:
         test = generate_churn(600, seed=14)
         cm = rf.validate(test, pos_class=1)
         assert cm.accuracy() > 0.75
+
+
+class TestPaddedChildRegression:
+    # mixed segment counts: one attr maxSplit=3, another maxSplit=2, so the
+    # tensorized level pass pads children of the 2-segment split; padded
+    # slots must never surface as predicate-less catch-all paths
+    MIXED_SCHEMA = FeatureSchema.from_json({
+        "fields": [
+            {"name": "id", "ordinal": 0, "id": True, "dataType": "string"},
+            {"name": "a", "ordinal": 1, "dataType": "int", "feature": True,
+             "min": 0, "max": 600, "bucketWidth": 60, "maxSplit": 3,
+             "splitScanInterval": 200},
+            {"name": "b", "ordinal": 2, "dataType": "int", "feature": True,
+             "min": 0, "max": 400, "bucketWidth": 40, "maxSplit": 2,
+             "splitScanInterval": 200},
+            {"name": "cls", "ordinal": 3, "dataType": "categorical",
+             "cardinality": ["no", "yes"]},
+        ]
+    })
+
+    def _data(self, n=400, seed=3):
+        rng = np.random.default_rng(seed)
+        a = rng.integers(0, 600, n)
+        b = rng.integers(0, 400, n)
+        y = ((a > 300) & (b > 200)).astype(int)
+        rows = [[f"r{i}", str(a[i]), str(b[i]), ["no", "yes"][y[i]]]
+                for i in range(n)]
+        return Dataset.from_rows(rows, self.MIXED_SCHEMA), y
+
+    def test_no_empty_predicate_paths(self):
+        ds, y = self._data()
+        model = DecisionTreeBuilder(self.MIXED_SCHEMA, max_depth=3).fit(ds)
+        assert all(p.predicates for p in model.paths)
+        assert all(p.population > 0 for p in model.paths)
+
+    def test_predict_not_clobbered(self):
+        ds, y = self._data()
+        model = DecisionTreeBuilder(self.MIXED_SCHEMA, max_depth=3).fit(ds)
+        pred = model.predict(ds, ["no", "yes"])
+        acc = (np.asarray(pred) == y).mean()
+        assert acc > 0.85, f"accuracy collapsed: {acc}"
+
+    def test_no_duplicate_predicates_not_used_yet(self):
+        ds, _ = self._data()
+        model = DecisionTreeBuilder(
+            self.MIXED_SCHEMA, max_depth=4,
+            attr_selection_strategy="notUsedYet").fit(ds)
+        for p in model.paths:
+            reprs = [str(pr) for pr in p.predicates]
+            assert len(reprs) == len(set(reprs)), f"dup predicates: {reprs}"
