@@ -90,8 +90,10 @@ class TransactionSet:
         if isinstance(source, str):
             if os.path.exists(source):
                 lines: Iterable[str] = open(source, "r")
-            else:
+            elif "\n" in source or delim in source or source == "":
                 lines = io.StringIO(source)
+            else:
+                raise FileNotFoundError(f"no such transactions file: {source!r}")
         else:
             lines = source
         rows = [
@@ -247,7 +249,8 @@ class FrequentItemsApriori:
         freq_ids: List[Tuple[int, ...]] = [
             (i,) for i in range(len(tx.vocab)) if col_counts[i] > min_count
         ]
-        out.append(self._pack(tx, freq_ids, 1))
+        out.append(self._pack(
+            tx, freq_ids, 1, [int(col_counts[i]) for (i,) in freq_ids]))
 
         for k in range(2, self.max_length + 1):
             cands = _generate_candidates(freq_ids, k)
@@ -258,23 +261,29 @@ class FrequentItemsApriori:
             for ci, items in enumerate(cands):
                 cand_rows[ci, list(items)] = 1
             counts, _ = _count_support(tx.multihot, cand_rows, k, self.block)
-            freq_ids = [c for c, cnt in zip(cands, counts) if cnt > min_count]
-            if not freq_ids:
+            kept = [(c, int(cnt)) for c, cnt in zip(cands, counts)
+                    if cnt > min_count]
+            if not kept:
                 break
-            out.append(self._pack(tx, freq_ids, k))
+            freq_ids = [c for c, _ in kept]
+            out.append(self._pack(tx, freq_ids, k, [cnt for _, cnt in kept]))
         return out
 
     def _pack(self, tx: TransactionSet, freq_ids: List[Tuple[int, ...]],
-              k: int) -> ItemSetList:
+              k: int, counts: List[int]) -> ItemSetList:
         if not freq_ids:
             return ItemSetList(k, [])
         n = len(tx)
-        cand_rows = np.zeros((len(freq_ids), tx.multihot.shape[1]), np.uint8)
-        for ci, items in enumerate(freq_ids):
-            cand_rows[ci, list(items)] = 1
-        counts, mask = _count_support(
-            tx.multihot, cand_rows, k, self.block, want_mask=self.emit_trans_id
-        )
+        mask = None
+        if self.emit_trans_id:
+            # the only case needing a second device pass: per-transaction
+            # membership masks for the surviving frequent sets
+            cand_rows = np.zeros((len(freq_ids), tx.multihot.shape[1]),
+                                 np.uint8)
+            for ci, items in enumerate(freq_ids):
+                cand_rows[ci, list(items)] = 1
+            _, mask = _count_support(
+                tx.multihot, cand_rows, k, self.block, want_mask=True)
         sets = []
         for ci, ids in enumerate(freq_ids):
             tokens = tuple(sorted(tx.vocab[i] for i in ids))

@@ -1,0 +1,329 @@
+"""Batch multi-armed bandits: the MR bandit jobs, group-vectorized on device.
+
+Reference (SURVEY §2.7): the batch side of org/avenir/reinforce/ is a set of
+map-only MR jobs run once per decision round by a driver loop
+(resource/price_optimize_tutorial.txt:20-82). Input rows are
+(groupID, itemID, trialCount, avgReward); each mapper streams one group at a
+time and selects `batch.size` items for that group:
+
+- GreedyRandomBandit.java:148-310 — ε-greedy; per position i the effective
+  trial count is (roundNum-1)*batchSize + i and the exploration probability
+  decays linearly (prob*c/count) or log-linearly (prob*c*ln(count)/count),
+  clamped at the base prob; "AuerGreedy" scales ε by d²-separation of the
+  top two rewards.
+- AuerDeterministic.java:130-175 — UCB1: untried items first, then by
+  reward + confidence-radius value.
+- RandomFirstGreedyBandit.java:55-120 — pure exploration for the first E
+  rounds (E = factor*itemCount, or the PAC bound 4/d² + ln(2k/δ)), then
+  greedy by rank.
+- SoftMaxBandit.java:82-187 — Boltzmann sampling with temperature.
+
+TPU-native design: one round over ALL groups is a single jitted call on
+padded [G, A] arrays (counts, rewards, validity mask) — the group loop of
+the mapper becomes the leading array axis, selection math vectorizes over
+it, and `jax.random` drives exploration reproducibly. The between-rounds
+reward-aggregate file (chombo RunningAggregator) stays a plain CSV via
+GroupBanditData.from_rows / to_rows.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+NEG = -1e30
+
+
+# ---------------------------------------------------------------------------
+# Group-padded round state (the reward-aggregate file between rounds)
+# ---------------------------------------------------------------------------
+@dataclass
+class GroupBanditData:
+    """Padded per-group item stats: the round input/output surface."""
+    group_ids: List[str]
+    item_ids: List[List[str]]       # per group, length = n items of group
+    counts: np.ndarray              # int32 [G, A] trial counts (padded 0)
+    rewards: np.ndarray             # float32 [G, A] avg rewards (padded 0)
+    mask: np.ndarray                # bool [G, A] valid item slots
+
+    @classmethod
+    def from_rows(cls, rows: Iterable[Sequence[str]],
+                  count_ord: int = 2, reward_ord: int = 3
+                  ) -> "GroupBanditData":
+        """Rows of (groupID, itemID, trialCount, avgReward) CSV fields,
+        group-contiguous or not."""
+        groups: Dict[str, List[Tuple[str, int, float]]] = {}
+        order: List[str] = []
+        for r in rows:
+            g = r[0]
+            if g not in groups:
+                groups[g] = []
+                order.append(g)
+            groups[g].append((r[1], int(r[count_ord]), float(r[reward_ord])))
+        a = max(len(v) for v in groups.values()) if groups else 0
+        gn = len(order)
+        counts = np.zeros((gn, a), np.int32)
+        rewards = np.zeros((gn, a), np.float32)
+        mask = np.zeros((gn, a), bool)
+        item_ids = []
+        for gi, g in enumerate(order):
+            items = groups[g]
+            item_ids.append([it[0] for it in items])
+            for ai, (_, c, rw) in enumerate(items):
+                counts[gi, ai] = c
+                rewards[gi, ai] = rw
+                mask[gi, ai] = True
+        return cls(order, item_ids, counts, rewards, mask)
+
+    def selections_to_rows(self, sel: np.ndarray,
+                           output_decision_count: bool = False
+                           ) -> List[List[str]]:
+        """[G, B] selected item indices -> output rows, reference format:
+        (group, item) per pick, or (group, item, count) when counting
+        (GreedyRandomBandit.java output modes)."""
+        out: List[List[str]] = []
+        for gi, g in enumerate(self.group_ids):
+            picks = [self.item_ids[gi][int(ai)] for ai in sel[gi]
+                     if int(ai) < len(self.item_ids[gi])]
+            if output_decision_count:
+                cnt: Dict[str, int] = {}
+                for it in picks:
+                    cnt[it] = cnt.get(it, 0) + 1
+                out.extend([[g, it, str(c)] for it, c in cnt.items()])
+            else:
+                out.extend([[g, it] for it in picks])
+        return out
+
+
+# ---------------------------------------------------------------------------
+# Jitted selection kernels, vectorized over groups
+# ---------------------------------------------------------------------------
+@partial(jax.jit, static_argnames=("batch_size", "log_linear", "unique"))
+def _eps_greedy_kernel(key, rewards, mask, round_num,
+                       base_prob, red_const, min_prob,
+                       batch_size: int, log_linear: bool, unique: bool):
+    """ε-greedy batch select per group (GreedyRandomBandit.linearSelect).
+
+    Per position i the decayed ε uses count = (round-1)*B + i + 1; a greedy
+    position takes the (next-)best reward, a random position a uniformly
+    random valid item. `unique` walks down the reward order so a batch never
+    repeats an item (selection.unique)."""
+    g, a = rewards.shape
+
+    def position(carry, i):
+        key, taken = carry
+        key, k1, k2 = jax.random.split(key, 3)
+        count = (round_num - 1.0) * batch_size + i + 1.0
+        if log_linear:
+            p = base_prob * red_const * jnp.log(count) / count
+        else:
+            p = base_prob * red_const / count
+        p = jnp.clip(p, min_prob, base_prob)
+        explore = jax.random.uniform(k1, (g,)) < p
+        # both paths draw from valid, not-yet-taken (when unique) slots;
+        # when a group exhausts its items, fall back to the full mask
+        avail = (mask & ~taken) if unique else mask
+        avail = jnp.where(avail.any(axis=1, keepdims=True), avail, mask)
+        rnd_pick = jax.random.categorical(
+            k2, jnp.where(avail, 0.0, NEG), axis=1)
+        greedy_pick = jnp.argmax(jnp.where(avail, rewards, NEG), axis=1)
+        pick = jnp.where(explore, rnd_pick, greedy_pick)
+        taken = taken.at[jnp.arange(g), pick].set(True)
+        return (key, taken), pick
+
+    init = (key, jnp.zeros_like(mask))
+    _, picks = jax.lax.scan(position, init, jnp.arange(batch_size))
+    return picks.T                                      # [G, B]
+
+
+def _ranked_batch(score: jnp.ndarray, mask: jnp.ndarray,
+                  batch_size: int) -> jnp.ndarray:
+    """Top-`batch_size` valid indices by score per group; when the batch
+    exceeds a group's valid item count, that group's ranked list repeats
+    cyclically (padded slots are never picked)."""
+    _, idx = jax.lax.top_k(score, score.shape[1])      # full rank, valid first
+    n_valid = jnp.maximum(mask.sum(axis=1), 1)
+    cols = jnp.arange(batch_size)[None, :] % n_valid[:, None]
+    return jnp.take_along_axis(idx, cols, axis=1)
+
+
+@partial(jax.jit, static_argnames=("batch_size",))
+def _ucb1_kernel(counts, rewards, mask, round_num, batch_size: int):
+    """Deterministic UCB1 (AuerDeterministic): untried items first (score
+    +inf), then avg reward + sqrt(2 ln t / n)."""
+    t = jnp.maximum(round_num * batch_size, 2.0)
+    n = counts.astype(jnp.float32)
+    radius = jnp.sqrt(2.0 * jnp.log(t) / jnp.maximum(n, 1.0))
+    score = jnp.where(n > 0, rewards + radius, jnp.inf)
+    score = jnp.where(mask, score, NEG)
+    return _ranked_batch(score, mask, batch_size)       # [G, B]
+
+
+@partial(jax.jit, static_argnames=("batch_size",))
+def _softmax_kernel(key, rewards, mask, temp, batch_size: int):
+    """Boltzmann batch sampling (SoftMaxBandit.java:187):
+    p ∝ exp(reward / temp) over valid items, batch draws with replacement."""
+    logits = jnp.where(mask, rewards / temp, NEG)
+    g = rewards.shape[0]
+    return jax.random.categorical(
+        key, logits[:, None, :], axis=-1, shape=(g, batch_size))  # [G, B]
+
+
+@partial(jax.jit, static_argnames=("batch_size",))
+def _random_explore_kernel(key, mask, batch_size: int):
+    """Uniform random batch over valid items (exploration rounds)."""
+    logits = jnp.where(mask, 0.0, NEG)
+    g = mask.shape[0]
+    return jax.random.categorical(
+        key, logits[:, None, :], axis=-1, shape=(g, batch_size))
+
+
+# ---------------------------------------------------------------------------
+# Round-job facades (the MR job analogs)
+# ---------------------------------------------------------------------------
+class GreedyRandomBandit:
+    """ε-greedy round job (GreedyRandomBandit.java:49).
+
+    Config keys mirror the reference: random.selection.prob,
+    prob.reduction.constant, prob.reduction.algorithm (linear | logLinear |
+    auerGreedy), current.round.num, selection.unique, min.prob."""
+
+    def __init__(self, batch_size: int, random_selection_prob: float = 0.5,
+                 prob_reduction_constant: float = 1.0,
+                 prob_reduction_algorithm: str = "linear",
+                 selection_unique: bool = False,
+                 min_prob: float = 0.0,
+                 auer_greedy_constant: float = 1.0,
+                 seed: int = 0):
+        self.batch_size = batch_size
+        self.prob = random_selection_prob
+        self.const = prob_reduction_constant
+        self.algo = prob_reduction_algorithm
+        self.unique = selection_unique
+        self.min_prob = min_prob
+        self.auer_const = auer_greedy_constant
+        self.key = jax.random.PRNGKey(seed)
+
+    def select(self, data: GroupBanditData, round_num: int) -> np.ndarray:
+        self.key, sub = jax.random.split(self.key)
+        if self.algo in ("linear", "logLinear"):
+            picks = _eps_greedy_kernel(
+                sub, jnp.asarray(data.rewards),
+                jnp.asarray(data.mask), float(round_num),
+                self.prob, self.const, self.min_prob,
+                self.batch_size, self.algo == "logLinear", self.unique)
+        elif self.algo == "auerGreedy":
+            picks = self._auer_greedy(sub, data, round_num)
+        else:
+            raise ValueError(f"unknown prob reduction algorithm {self.algo}")
+        return np.asarray(picks)
+
+    def _auer_greedy(self, key, data: GroupBanditData, round_num: int):
+        """AuerGreedy (GreedyRandomBandit.greedyAuerSelect): ε scaled by the
+        relative gap d of the two best rewards, ε = c·k/(d²·t) capped at 1;
+        untried items are taken first."""
+        r = np.where(data.mask, data.rewards, -np.inf)
+        top2 = -np.sort(-r, axis=1)[:, :2]
+        best, second = top2[:, 0], (top2[:, 1] if r.shape[1] > 1 else top2[:, 0])
+        d = np.where(best > 0, (best - second) / np.maximum(best, 1e-9), 0.0)
+        kcnt = data.mask.sum(axis=1)
+        t = max((round_num - 1) * self.batch_size, 1)
+        eps = jnp.asarray(np.where(
+            d <= 0, 1.0,
+            np.minimum(self.auer_const * kcnt / (np.maximum(d, 1e-9) ** 2 * t), 1.0),
+        ).astype(np.float32))
+        k1, k2 = jax.random.split(key)
+        rnd = _random_explore_kernel(k1, jnp.asarray(data.mask),
+                                     self.batch_size)
+        greedy_score = jnp.where(jnp.asarray(data.mask),
+                                 jnp.asarray(data.rewards), NEG)
+        greedy = _ranked_batch(greedy_score, jnp.asarray(data.mask),
+                               self.batch_size)
+        explore = jax.random.uniform(
+            k2, (len(data.group_ids), self.batch_size)) < eps[:, None]
+        return jnp.where(explore, rnd, greedy)
+
+
+class AuerDeterministic:
+    """UCB1 deterministic round job (AuerDeterministic.java:47)."""
+
+    def __init__(self, batch_size: int):
+        self.batch_size = batch_size
+
+    def select(self, data: GroupBanditData, round_num: int) -> np.ndarray:
+        return np.asarray(_ucb1_kernel(
+            jnp.asarray(data.counts), jnp.asarray(data.rewards),
+            jnp.asarray(data.mask), float(round_num), self.batch_size))
+
+
+class RandomFirstGreedyBandit:
+    """Explore-first-then-greedy round job (RandomFirstGreedyBandit.java:47).
+
+    Exploration round count per group: simple = factor * itemCount, or the
+    PAC bound 4/d² + ln(2k/δ) (getExplorationCount, :71-79)."""
+
+    def __init__(self, batch_size: int,
+                 expl_count_strategy: str = "simple",
+                 exploration_count_factor: int = 2,
+                 reward_diff: float = 0.1, prob_diff: float = 0.2,
+                 seed: int = 0):
+        self.batch_size = batch_size
+        self.strategy = expl_count_strategy
+        self.factor = exploration_count_factor
+        self.reward_diff = reward_diff
+        self.prob_diff = prob_diff
+        self.key = jax.random.PRNGKey(seed)
+
+    def exploration_rounds(self, item_count: int) -> int:
+        if self.strategy == "simple":
+            return self.factor * item_count
+        return int(4.0 / (self.reward_diff ** 2)
+                   + np.log(2.0 * item_count / self.prob_diff))
+
+    def select(self, data: GroupBanditData, round_num: int) -> np.ndarray:
+        self.key, sub = jax.random.split(self.key)
+        rnd = np.asarray(_random_explore_kernel(
+            sub, jnp.asarray(data.mask), self.batch_size))
+        greedy_score = jnp.where(jnp.asarray(data.mask),
+                                 jnp.asarray(data.rewards), NEG)
+        greedy = np.asarray(_ranked_batch(
+            greedy_score, jnp.asarray(data.mask), self.batch_size))
+        expl = np.array([
+            round_num <= self.exploration_rounds(len(items))
+            for items in data.item_ids
+        ])
+        return np.where(expl[:, None], rnd, greedy)
+
+
+class SoftMaxBandit:
+    """Boltzmann round job (SoftMaxBandit.java:49)."""
+
+    def __init__(self, batch_size: int, temp_constant: float = 1.0,
+                 seed: int = 0):
+        self.batch_size = batch_size
+        self.temp = temp_constant
+        self.key = jax.random.PRNGKey(seed)
+
+    def select(self, data: GroupBanditData, round_num: int) -> np.ndarray:
+        self.key, sub = jax.random.split(self.key)
+        return np.asarray(_softmax_kernel(
+            sub, jnp.asarray(data.rewards), jnp.asarray(data.mask),
+            self.temp, self.batch_size))
+
+
+def make_bandit_job(name: str, batch_size: int, **kw):
+    """Round-job factory by the reference's job/algorithm names."""
+    table = {
+        "greedyRandomBandit": GreedyRandomBandit,
+        "auerDeterministic": AuerDeterministic,
+        "randomFirstGreedyBandit": RandomFirstGreedyBandit,
+        "softMaxBandit": SoftMaxBandit,
+    }
+    if name not in table:
+        raise ValueError(f"invalid bandit job: {name}")
+    return table[name](batch_size, **kw)

@@ -1,0 +1,304 @@
+"""Reinforcement learning: streaming learner hierarchy, batch bandits,
+streaming loop. Regret-style checks: with a clearly-best arm every learner
+must converge to picking it most of the time."""
+
+import numpy as np
+import pytest
+
+from avenir_tpu.models.reinforce import (
+    Action,
+    create_learner,
+    GroupedLearners,
+)
+from avenir_tpu.models.bandits import (
+    AuerDeterministic,
+    GreedyRandomBandit,
+    GroupBanditData,
+    RandomFirstGreedyBandit,
+    SoftMaxBandit,
+    make_bandit_job,
+)
+from avenir_tpu.streaming import (
+    LearnerStream,
+    QueueActionWriter,
+    QueueRewardReader,
+)
+
+ACTIONS = ["a", "b", "c"]
+TRUE_MEANS = {"a": 20, "b": 50, "c": 80}   # c is best
+
+BASE_CONFIG = {
+    "batch.size": 1, "reward.scale": 100, "seed": 7,
+    # intervalEstimator
+    "bin.width": 10, "confidence.limit": 90, "min.confidence.limit": 50,
+    "confidence.limit.reduction.step": 5,
+    "confidence.limit.reduction.round.interval": 50,
+    "min.reward.distr.sample": 20,
+    # sampsonSampler
+    "min.sample.size": 10, "max.reward": 100,
+    # randomGreedy
+    "random.selection.prob": 0.5, "prob.reduction.algorithm": "linear",
+    # softMax
+    "temp.constant": 30.0, "min.temp.constant": 1.0,
+    # exponentialWeight
+    "distr.constant": 0.2,
+    # rewardComparison
+    "intial.reference.reward": 50.0, "preference.change.rate": 0.1,
+    "reference.reward.change.rate": 0.05,
+    # actionPursuit
+    "pursuit.learning.rate": 0.05,
+}
+
+ALL_LEARNERS = [
+    "intervalEstimator", "sampsonSampler", "optimisticSampsonSampler",
+    "randomGreedy", "upperConfidenceBoundOne", "upperConfidenceBoundTwo",
+    "softMax", "actionPursuit", "rewardComparison", "exponentialWeight",
+]
+
+
+def run_bandit_sim(learner, n_rounds=600, seed=0, noise=8.0):
+    rng = np.random.default_rng(seed)
+    picks = []
+    for _ in range(n_rounds):
+        action = learner.next_action()
+        picks.append(action.id)
+        r = int(np.clip(TRUE_MEANS[action.id] + rng.normal(0, noise), 0, 100))
+        learner.set_reward(action.id, r)
+    return picks
+
+
+class TestLearnerHierarchy:
+    @pytest.mark.parametrize("name", ALL_LEARNERS)
+    def test_factory_creates(self, name):
+        lr = create_learner(name, ACTIONS, BASE_CONFIG)
+        a = lr.next_action()
+        assert a.id in ACTIONS
+        lr.set_reward(a.id, 50)
+
+    def test_factory_rejects_unknown(self):
+        with pytest.raises(ValueError, match="invalid learner type"):
+            create_learner("nope", ACTIONS, BASE_CONFIG)
+
+    @pytest.mark.parametrize("name", ALL_LEARNERS)
+    def test_converges_to_best_arm(self, name):
+        lr = create_learner(name, ACTIONS, BASE_CONFIG)
+        picks = run_bandit_sim(lr, n_rounds=800)
+        late = picks[-200:]
+        frac_best = late.count("c") / len(late)
+        assert frac_best > 0.5, f"{name}: best-arm rate {frac_best}"
+
+    def test_trial_counts_track_selections(self):
+        lr = create_learner("randomGreedy", ACTIONS, BASE_CONFIG)
+        run_bandit_sim(lr, n_rounds=100)
+        assert sum(a.trial_count for a in lr.actions) == 100
+
+    def test_min_trial_forces_exploration(self):
+        cfg = dict(BASE_CONFIG, **{"min.trial": 20})
+        lr = create_learner("upperConfidenceBoundOne", ACTIONS, cfg)
+        run_bandit_sim(lr, n_rounds=100)
+        for a in lr.actions:
+            assert a.trial_count >= 20
+
+    def test_batch_size(self):
+        cfg = dict(BASE_CONFIG, **{"batch.size": 4})
+        lr = create_learner("sampsonSampler", ACTIONS, cfg)
+        actions = lr.next_actions()
+        assert len(actions) == 4
+
+    def test_interval_estimator_phases(self):
+        lr = create_learner("intervalEstimator", ACTIONS, BASE_CONFIG)
+        run_bandit_sim(lr, n_rounds=400)
+        assert lr.random_select_count > 0      # warmup phase happened
+        assert lr.intv_est_select_count > 0    # UCB phase happened
+        assert lr.cur_confidence_limit < lr.confidence_limit  # decayed
+        assert "randomSelectCount" in lr.get_stat()
+
+    def test_optimistic_sampler_floors_at_mean(self):
+        lr = create_learner("optimisticSampsonSampler", ACTIONS, BASE_CONFIG)
+        for _ in range(15):
+            lr.set_reward("a", 10)
+            lr.set_reward("a", 30)
+        assert lr.enforce("a", 5.0) == pytest.approx(20.0)  # mean wins
+        assert lr.enforce("a", 25.0) == pytest.approx(25.0)  # sample wins
+
+    def test_grouped_learners_independent(self):
+        groups = GroupedLearners("randomGreedy", ACTIONS, BASE_CONFIG)
+        g1, g2 = groups.get("g1"), groups.get("g2")
+        assert g1 is not g2
+        assert groups.get("g1") is g1
+        g1.set_reward("a", 99)
+        assert g2.reward_stats["a"].count == 0
+
+
+# ---------------------------------------------------------------------------
+# batch bandit jobs
+# ---------------------------------------------------------------------------
+def round_rows(counts, rewards):
+    """(group, item, count, reward) rows for 2 groups x 3 items."""
+    rows = []
+    for g in ("g0", "g1"):
+        for i, it in enumerate(("x", "y", "z")):
+            rows.append([g, it, str(counts[g][i]), str(rewards[g][i])])
+    return rows
+
+
+class TestBatchBandits:
+    COUNTS = {"g0": [10, 10, 10], "g1": [5, 5, 5]}
+    REWARDS = {"g0": [10, 90, 50], "g1": [80, 20, 40]}
+
+    def data(self):
+        return GroupBanditData.from_rows(round_rows(self.COUNTS, self.REWARDS))
+
+    def test_from_rows_padding(self):
+        rows = [["g0", "x", "1", "5"], ["g0", "y", "2", "6"],
+                ["g1", "only", "3", "7"]]
+        d = GroupBanditData.from_rows(rows)
+        assert d.counts.shape == (2, 2)
+        assert d.mask.tolist() == [[True, True], [True, False]]
+
+    def test_ucb1_prefers_best_and_untried(self):
+        d = self.data()
+        sel = AuerDeterministic(batch_size=1).select(d, round_num=50)
+        # g0 best = y(1), g1 best = x(0); all tried, high round -> greedy
+        assert sel[0][0] == 1 and sel[1][0] == 0
+        # untried item must be picked first
+        d.counts[0, 2] = 0
+        sel = AuerDeterministic(batch_size=1).select(d, round_num=50)
+        assert sel[0][0] == 2
+
+    def test_eps_greedy_late_rounds_greedy(self):
+        d = self.data()
+        job = GreedyRandomBandit(batch_size=8, random_selection_prob=0.5,
+                                 seed=3)
+        sel = job.select(d, round_num=200)       # epsilon ~ 0
+        assert (sel[0] == 1).mean() > 0.9
+        assert (sel[1] == 0).mean() > 0.9
+
+    def test_eps_greedy_round_one_explores(self):
+        d = self.data()
+        job = GreedyRandomBandit(batch_size=64, random_selection_prob=1.0,
+                                 prob_reduction_algorithm="linear", seed=5)
+        sel = job.select(d, round_num=1)
+        # first pick has eps=1 -> exploration occurs somewhere in the batch
+        assert len(np.unique(sel[0])) > 1
+
+    def test_eps_greedy_unique(self):
+        d = self.data()
+        job = GreedyRandomBandit(batch_size=3, selection_unique=True, seed=2)
+        sel = job.select(d, round_num=1)
+        for g in range(2):
+            assert len(set(sel[g].tolist())) == 3
+
+    def test_softmax_distribution_shifts(self):
+        d = self.data()
+        hot = SoftMaxBandit(batch_size=400, temp_constant=5.0, seed=0)
+        sel = hot.select(d, round_num=1)
+        # low temperature concentrates on best arm per group
+        assert (sel[0] == 1).mean() > 0.8
+        assert (sel[1] == 0).mean() > 0.8
+
+    def test_random_first_greedy_phases(self):
+        d = self.data()
+        job = RandomFirstGreedyBandit(batch_size=200,
+                                      exploration_count_factor=2, seed=1)
+        expl = job.select(d, round_num=1)            # 1 <= 2*3 -> explore
+        assert len(np.unique(expl[0])) == 3
+        greedy = job.select(d, round_num=100)        # past exploration
+        assert (greedy[0] == 1).all() or (greedy[0][0] == 1)
+
+    def test_auer_greedy_runs(self):
+        d = self.data()
+        job = GreedyRandomBandit(batch_size=4,
+                                 prob_reduction_algorithm="auerGreedy",
+                                 seed=0)
+        sel = job.select(d, round_num=500)
+        assert sel.shape == (2, 4)
+        assert (sel < 3).all()
+
+    def test_selections_to_rows(self):
+        d = self.data()
+        sel = np.array([[1, 1], [0, 2]])
+        rows = d.selections_to_rows(sel)
+        assert rows == [["g0", "y"], ["g0", "y"], ["g1", "x"], ["g1", "z"]]
+        counted = d.selections_to_rows(sel, output_decision_count=True)
+        assert ["g0", "y", "2"] in counted
+
+    def test_job_factory(self):
+        assert isinstance(make_bandit_job("softMaxBandit", 2), SoftMaxBandit)
+        with pytest.raises(ValueError):
+            make_bandit_job("nope", 2)
+
+    def test_rounds_improve_regret(self):
+        """Simulated multi-round loop: reward aggregates flow back between
+        rounds like price_optimize_tutorial.txt:55-82."""
+        rng = np.random.default_rng(0)
+        true = np.array([[10.0, 90.0, 50.0], [80.0, 20.0, 40.0]])
+        counts = np.ones((2, 3), np.int64)
+        sums = true.copy()                      # one warm sample per arm
+        job = GreedyRandomBandit(batch_size=16, seed=4)
+        picked_best = []
+        for rnd in range(1, 21):
+            rows = []
+            for g in range(2):
+                for a in range(3):
+                    avg = sums[g, a] / counts[g, a]
+                    rows.append([f"g{g}", f"i{a}", str(counts[g, a]),
+                                 str(avg)])
+            d = GroupBanditData.from_rows(rows)
+            sel = job.select(d, rnd)
+            for g in range(2):
+                for a in sel[g]:
+                    r = true[g, a] + rng.normal(0, 5)
+                    counts[g, a] += 1
+                    sums[g, a] += r
+            picked_best.append(
+                ((sel[0] == 1).mean() + (sel[1] == 0).mean()) / 2)
+        assert np.mean(picked_best[-5:]) > 0.8
+
+
+# ---------------------------------------------------------------------------
+# streaming loop
+# ---------------------------------------------------------------------------
+class TestLearnerStream:
+    def test_sync_event_reward_cycle(self):
+        stream = LearnerStream("randomGreedy", ACTIONS, BASE_CONFIG)
+        actions = stream.process_event("e1", 1)
+        assert len(actions) == 1
+        out = stream.action_writer.pop(timeout=1)
+        assert out.startswith("e1,")
+        stream.reward_reader.push(actions[0].id, 60)
+        stream.process_event("e2", 2)
+        assert stream.learner.actions[
+            stream.learner.action_index[actions[0].id]].total_reward == 60
+
+    def test_async_loop(self):
+        stream = LearnerStream("softMax", ACTIONS, BASE_CONFIG).start()
+        rng = np.random.default_rng(1)
+        for i in range(50):
+            stream.submit_event(f"e{i}", i)
+            msg = stream.action_writer.pop(timeout=5)
+            assert msg is not None
+            event_id, *acts = msg.split(",")
+            assert event_id == f"e{i}"
+            for a in acts:
+                r = int(np.clip(TRUE_MEANS[a] + rng.normal(0, 5), 0, 100))
+                stream.reward_reader.push(a, r)
+        stream.stop()
+        assert stream.processed == 50
+
+    def test_reward_tuples_processed_directly(self):
+        stream = LearnerStream("upperConfidenceBoundOne", ACTIONS, BASE_CONFIG)
+        stream.process_reward("b", 70)
+        assert stream.learner.reward_stats["b"].count == 1
+
+    def test_ranked_batch_small_group_cycles(self):
+        """A group with fewer items than batch_size must still get
+        batch_size valid picks (cyclic), never padded slots."""
+        rows = [["g0", "a", "5", "10"], ["g0", "b", "5", "20"],
+                ["g0", "c", "5", "30"], ["g1", "solo", "5", "50"]]
+        d = GroupBanditData.from_rows(rows)
+        sel = AuerDeterministic(batch_size=3).select(d, round_num=50)
+        assert sel.shape == (2, 3)
+        assert (sel[1] == 0).all()          # only valid slot, repeated
+        out = d.selections_to_rows(sel)
+        assert out.count(["g1", "solo"]) == 3
