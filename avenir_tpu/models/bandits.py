@@ -153,13 +153,16 @@ def _ranked_batch(score: jnp.ndarray, mask: jnp.ndarray,
 
 
 @partial(jax.jit, static_argnames=("batch_size",))
-def _ucb1_kernel(counts, rewards, mask, round_num, batch_size: int):
+def _ucb1_kernel(counts, rewards, mask, round_num, max_reward,
+                 batch_size: int):
     """Deterministic UCB1 (AuerDeterministic): untried items first (score
-    +inf), then avg reward + sqrt(2 ln t / n)."""
+    +inf), then reward/maxReward + sqrt(2 ln t / n) — rewards normalize to
+    [0, 1] so the confidence radius stays comparable to the value term
+    (AuerDeterministic.java value scoring)."""
     t = jnp.maximum(round_num * batch_size, 2.0)
     n = counts.astype(jnp.float32)
     radius = jnp.sqrt(2.0 * jnp.log(t) / jnp.maximum(n, 1.0))
-    score = jnp.where(n > 0, rewards + radius, jnp.inf)
+    score = jnp.where(n > 0, rewards / max_reward + radius, jnp.inf)
     score = jnp.where(mask, score, NEG)
     return _ranked_batch(score, mask, batch_size)       # [G, B]
 
@@ -240,8 +243,11 @@ class GreedyRandomBandit:
         k1, k2 = jax.random.split(key)
         rnd = _random_explore_kernel(k1, jnp.asarray(data.mask),
                                      self.batch_size)
-        greedy_score = jnp.where(jnp.asarray(data.mask),
-                                 jnp.asarray(data.rewards), NEG)
+        # untried items come first (greedyAuerSelect collects not-tried
+        # before value-ranked picks), then by reward
+        greedy_score = jnp.where(jnp.asarray(data.counts) > 0,
+                                 jnp.asarray(data.rewards), jnp.inf)
+        greedy_score = jnp.where(jnp.asarray(data.mask), greedy_score, NEG)
         greedy = _ranked_batch(greedy_score, jnp.asarray(data.mask),
                                self.batch_size)
         explore = jax.random.uniform(
@@ -250,15 +256,18 @@ class GreedyRandomBandit:
 
 
 class AuerDeterministic:
-    """UCB1 deterministic round job (AuerDeterministic.java:47)."""
+    """UCB1 deterministic round job (AuerDeterministic.java:47).
+    max_reward normalizes avg rewards into [0, 1] for the UCB score."""
 
-    def __init__(self, batch_size: int):
+    def __init__(self, batch_size: int, max_reward: float = 100.0):
         self.batch_size = batch_size
+        self.max_reward = max_reward
 
     def select(self, data: GroupBanditData, round_num: int) -> np.ndarray:
         return np.asarray(_ucb1_kernel(
             jnp.asarray(data.counts), jnp.asarray(data.rewards),
-            jnp.asarray(data.mask), float(round_num), self.batch_size))
+            jnp.asarray(data.mask), float(round_num), self.max_reward,
+            self.batch_size))
 
 
 class RandomFirstGreedyBandit:

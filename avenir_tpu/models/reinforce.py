@@ -405,7 +405,10 @@ class SoftMaxLearner(ReinforcementLearner):
         if action is None:
             if self.rewarded:
                 avg = np.array([self.reward_stats[a.id].avg for a in self.actions])
-                e = np.exp((avg - avg.max()) / self.temp_constant)
+                # temp underflows to 0 under the compounding decay schedule;
+                # the zero-temperature limit is argmax selection, not NaN
+                t = max(self.temp_constant, 1e-12)
+                e = np.exp((avg - avg.max()) / t)
                 self.probs = e / e.sum()
                 self.rewarded = False
             action = self.actions[
@@ -419,6 +422,7 @@ class SoftMaxLearner(ReinforcementLearner):
                 if 0 < self.min_temp_constant and \
                         self.temp_constant < self.min_temp_constant:
                     self.temp_constant = self.min_temp_constant
+                self.temp_constant = max(self.temp_constant, 0.0)
         action.select()
         return action
 
@@ -528,7 +532,10 @@ class ExponentialWeightLearner(ReinforcementLearner):
         scaled = reward / self.reward_scale
         k = len(self.actions)
         self.weights[i] *= math.exp(
-            self.gamma * (scaled / max(self.probs[i], 1e-12)) / k)
+            min(self.gamma * (scaled / max(self.probs[i], 1e-12)) / k, 700.0))
+        # renormalize: only weight ratios matter, and unbounded growth
+        # overflows to inf/NaN on long streams
+        self.weights /= self.weights.max()
         self.rewarded = True
 
 

@@ -1,0 +1,288 @@
+"""Sequence mining: GSP candidate generation + support, positional clusters.
+
+Reference (SURVEY §2.8 sequence/): CandidateGenerationWithSelfJoin.java:44-200
+implements the GSP candidate-generation self-join of frequent
+(k-1)-sequences: sequences a, b join when a[1:] == b[:-1] (candidate =
+a + [b[-1]]), with the all-same-token self-join special case
+(selfJoinSequence, :156-172); the MR job shards the join via hashed bucket
+pairs. SequencePositionalCluster.java:49 scores a sliding time window of
+events against locality strategies (hoidla TimeBoundEventLocalityAnalyzer:
+occurrence count / average interval / max interval, weighted or
+condition-gated) and emits window positions whose score beats a threshold.
+
+TPU-native design: the join is tiny host work over the frequent set (the
+bucket-pair sharding exists only because Hadoop must shuffle; in-process a
+dict join is exact and cheaper). What the reference leaves to a separate
+pass — counting how many data sequences contain each candidate as an
+order-preserving subsequence — is the N-proportional work, and runs on
+device: one `lax.scan` over time steps advances a per-(row, candidate)
+match pointer, so support for ALL candidates over ALL rows is a single
+compiled pass with [N, C] state.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+
+# ---------------------------------------------------------------------------
+# GSP candidate generation (host) + device support counting
+# ---------------------------------------------------------------------------
+def join_sequences(this_seq: Sequence[str], that_seq: Sequence[str]
+                   ) -> Optional[List[str]]:
+    """GSP join rule (CandidateGenerationWithSelfJoin.joinSquences:174-200):
+    if this[1:] == that[:-1] the candidate is this + [that[-1]], else the
+    symmetric direction that + [this[-1]]."""
+    if list(this_seq[1:]) == list(that_seq[:-1]):
+        return list(this_seq) + [that_seq[-1]]
+    if list(that_seq[1:]) == list(this_seq[:-1]):
+        return list(that_seq) + [this_seq[-1]]
+    return None
+
+
+def self_join_sequence(seq: Sequence[str]) -> Optional[List[str]]:
+    """All-same-token sequences extend themselves (selfJoinSequence:156-172)."""
+    if all(t == seq[0] for t in seq):
+        return list(seq) + [seq[0]]
+    return None
+
+
+def generate_sequence_candidates(frequent: Iterable[Sequence[str]]
+                                 ) -> List[Tuple[str, ...]]:
+    """All GSP k-candidates from the frequent (k-1)-sequence set, deduped.
+
+    Indexes sequences by their (k-2)-prefix so each sequence only meets the
+    sequences whose prefix equals its suffix — the in-process equivalent of
+    the MR job's hashed bucket-pair self-join."""
+    freq = [tuple(s) for s in frequent]
+    by_prefix: Dict[Tuple[str, ...], List[Tuple[str, ...]]] = {}
+    for s in freq:
+        by_prefix.setdefault(s[:-1], []).append(s)
+    out = set()
+    for s in freq:
+        sj = self_join_sequence(s)
+        if sj is not None:
+            out.add(tuple(sj))
+        for t in by_prefix.get(s[1:], ()):
+            j = join_sequences(s, t)
+            if j is not None:
+                out.add(tuple(j))
+    return sorted(out)
+
+
+@partial(jax.jit, static_argnames=("k",))
+def _subseq_support_kernel(rows: jnp.ndarray, lengths: jnp.ndarray,
+                           cands: jnp.ndarray, k: int):
+    """counts[c] = #rows containing candidate c as an order-preserving
+    (not necessarily contiguous) subsequence.
+
+    rows int32 [N, T] padded with -1, cands int32 [C, k]. One scan over the
+    T time steps advances ptr[n, c] (next candidate position to match);
+    a row supports the candidate when its pointer reaches k."""
+    n, t = rows.shape
+    c = cands.shape[0]
+
+    def step(ptr, tok):                      # ptr [N, C], tok [N]
+        expect = cands[jnp.arange(c)[None, :],
+                       jnp.clip(ptr, 0, k - 1)]          # [N, C]
+        hit = (tok[:, None] == expect) & (ptr < k) & (tok[:, None] >= 0)
+        return ptr + hit.astype(jnp.int32), None
+
+    ptr, _ = jax.lax.scan(step, jnp.zeros((n, c), jnp.int32), rows.T)
+    return jnp.sum(ptr >= k, axis=0, dtype=jnp.int32)
+
+
+@dataclass
+class SequenceSet:
+    """Dictionary-encoded, padded sequences (pad token -1)."""
+    rows: np.ndarray                 # int32 [N, T]
+    lengths: np.ndarray              # int32 [N]
+    vocab: List[str]
+    index: Dict[str, int]
+
+    @classmethod
+    def from_token_rows(cls, token_rows: Sequence[Sequence[str]],
+                        skip_field_count: int = 1) -> "SequenceSet":
+        vocab: List[str] = []
+        index: Dict[str, int] = {}
+        enc = []
+        for r in token_rows:
+            toks = list(r[skip_field_count:])
+            row = []
+            for tok in toks:
+                if tok == "":
+                    continue
+                if tok not in index:
+                    index[tok] = len(vocab)
+                    vocab.append(tok)
+                row.append(index[tok])
+            enc.append(row)
+        t = max((len(r) for r in enc), default=1)
+        rows = np.full((len(enc), max(t, 1)), -1, np.int32)
+        for i, r in enumerate(enc):
+            rows[i, :len(r)] = r
+        lengths = np.array([len(r) for r in enc], np.int32)
+        return cls(rows, lengths, vocab, index)
+
+    def __len__(self) -> int:
+        return self.rows.shape[0]
+
+
+class GSPMiner:
+    """Frequent-sequence miner: host GSP joins per k + device support scans.
+
+    Mirrors the per-k loop the reference drives externally; cgs.* keys map
+    to the constructor (cgs.item.set.length is the per-round k the job was
+    invoked with; here the loop runs to max_length)."""
+
+    def __init__(self, support_threshold: float, max_length: int = 3,
+                 block: int = 65536):
+        self.support_threshold = support_threshold
+        self.max_length = max_length
+        self.block = block
+
+    def _count(self, ss: SequenceSet, cands: List[Tuple[str, ...]], k: int
+               ) -> np.ndarray:
+        cand_arr = np.array(
+            [[ss.index.get(tok, -2) for tok in cd] for cd in cands], np.int32)
+        counts = np.zeros(len(cands), np.int64)
+        for s in range(0, len(ss), self.block):
+            counts += np.asarray(_subseq_support_kernel(
+                jnp.asarray(ss.rows[s:s + self.block]),
+                jnp.asarray(ss.lengths[s:s + self.block]),
+                jnp.asarray(cand_arr), k), dtype=np.int64)
+        return counts
+
+    def mine(self, ss: SequenceSet) -> Dict[int, Dict[Tuple[str, ...], float]]:
+        n = len(ss)
+        min_count = self.support_threshold * n
+        out: Dict[int, Dict[Tuple[str, ...], float]] = {}
+
+        cands1 = [(tok,) for tok in ss.vocab]
+        counts = self._count(ss, cands1, 1)
+        freq = {c: cnt / n for c, cnt in zip(cands1, counts)
+                if cnt > min_count}
+        out[1] = freq
+
+        for k in range(2, self.max_length + 1):
+            cands = generate_sequence_candidates(list(freq))
+            if not cands:
+                break
+            counts = self._count(ss, cands, k)
+            freq = {c: cnt / n for c, cnt in zip(cands, counts)
+                    if cnt > min_count}
+            if not freq:
+                break
+            out[k] = freq
+        return out
+
+
+# ---------------------------------------------------------------------------
+# Positional clustering of event sequences
+# ---------------------------------------------------------------------------
+class EventLocalityAnalyzer:
+    """Sliding-window event-locality scoring
+    (SequencePositionalCluster.java:49 + hoidla TimeBoundEventLocalityAnalyzer).
+
+    Events are (timestamp, value) rows; an event "fires" when the value
+    meets the condition. Per window the locality score comes from the
+    configured strategies over firing-event timestamps:
+
+      numOccurence     #events / window capacity (more events -> higher)
+      averageInterval  1 - avg inter-event gap / window span
+      maxInterval      1 - max inter-event gap / window span
+
+    `weighted_strategies` mixes scores by weight; otherwise the preferred
+    strategies are threshold conditions (min_occurence / max_interval_average
+    / max_interval_max) combined with any/all (`any_cond`)."""
+
+    STRATEGIES = ("numOccurence", "averageInterval", "maxInterval")
+
+    def __init__(self, window_time_span: float, time_step: float,
+                 score_threshold: float,
+                 weighted_strategies: Optional[Dict[str, float]] = None,
+                 preferred_strategies: Sequence[str] = ("numOccurence",),
+                 min_occurence: int = 2,
+                 max_interval_average: float = float("inf"),
+                 max_interval_max: float = float("inf"),
+                 any_cond: bool = True,
+                 min_event_time_interval: float = 0.0):
+        self.window = window_time_span
+        self.step = time_step
+        self.threshold = score_threshold
+        self.weighted = weighted_strategies
+        self.preferred = list(preferred_strategies)
+        self.min_occurence = min_occurence
+        self.max_interval_average = max_interval_average
+        self.max_interval_max = max_interval_max
+        self.any_cond = any_cond
+        self.min_gap = min_event_time_interval
+
+    def _window_score(self, times: np.ndarray) -> float:
+        if len(times) == 0:
+            return 0.0
+        gaps = np.diff(times) if len(times) > 1 else np.array([self.window])
+        gaps = gaps[gaps >= self.min_gap] if self.min_gap > 0 else gaps
+        cap = max(self.window / max(self.step, 1e-9), 1.0)
+        occ = min(len(times) / cap, 1.0)
+        avg_gap = float(gaps.mean()) if len(gaps) else self.window
+        max_gap = float(gaps.max()) if len(gaps) else self.window
+        scores = {
+            "numOccurence": occ,
+            "averageInterval": max(1.0 - avg_gap / self.window, 0.0),
+            "maxInterval": max(1.0 - max_gap / self.window, 0.0),
+        }
+        if self.weighted:
+            tot_w = sum(self.weighted.values()) or 1.0
+            return sum(scores[s] * w for s, w in self.weighted.items()) / tot_w
+        conds = []
+        for s in self.preferred:
+            if s == "numOccurence":
+                conds.append(len(times) >= self.min_occurence)
+            elif s == "averageInterval":
+                conds.append(avg_gap <= self.max_interval_average)
+            elif s == "maxInterval":
+                conds.append(max_gap <= self.max_interval_max)
+        ok = any(conds) if self.any_cond else all(conds)
+        return max(scores[s] for s in self.preferred) if ok else 0.0
+
+    def score_events(self, timestamps: np.ndarray, fired: np.ndarray
+                     ) -> List[Tuple[float, float]]:
+        """Slide the window over (sorted) timestamps; return
+        (window_end_time, score) for windows whose score beats the
+        threshold — the rows the reference mapper emits."""
+        ts = np.asarray(timestamps, np.float64)
+        f = np.asarray(fired, bool)
+        out = []
+        if len(ts) == 0:
+            return out
+        t = ts.min() + self.window
+        t_end = ts.max()
+        while t <= t_end + self.step / 2:
+            in_win = (ts > t - self.window) & (ts <= t) & f
+            score = self._window_score(ts[in_win])
+            if score > self.threshold:
+                out.append((float(t), float(score)))
+            t += self.step
+        return out
+
+
+def positional_cluster(rows: Sequence[Sequence[str]],
+                       analyzer: EventLocalityAnalyzer,
+                       quant_field_ordinal: int,
+                       seq_num_field_ordinal: int,
+                       condition=lambda v: True
+                       ) -> List[Tuple[float, float]]:
+    """SequencePositionalCluster job surface: CSV rows with a timestamp and
+    quantity field; emit high-locality window positions."""
+    ts = np.array([float(r[seq_num_field_ordinal]) for r in rows])
+    vals = np.array([float(r[quant_field_ordinal]) for r in rows])
+    order = np.argsort(ts)
+    fired = np.array([condition(v) for v in vals[order]])
+    return analyzer.score_events(ts[order], fired)

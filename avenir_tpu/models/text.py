@@ -1,0 +1,64 @@
+"""Text utilities: tokenizer + word counting.
+
+Reference (SURVEY §2.8 text/): WordCounter.java:54 — an MR job that splits a
+CSV field (or the whole line) with a Lucene StandardAnalyzer and counts
+tokens. The same tokenizer backs the Naive Bayes free-text mode
+(BayesianDistribution.java:186-195).
+
+The StandardAnalyzer's observable behavior — lowercase, split on
+non-alphanumerics, keep digits, drop English stop words — is reproduced
+with a host regex tokenizer (tokenizing is irreducibly host/string work;
+the counting after dictionary-encoding is a bincount)."""
+
+from __future__ import annotations
+
+import re
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+# Lucene StandardAnalyzer's default English stop set
+STOP_WORDS = frozenset(
+    "a an and are as at be but by for if in into is it no not of on or such "
+    "that the their then there these they this to was will with".split())
+
+_TOKEN_RE = re.compile(r"[a-z0-9]+(?:'[a-z0-9]+)?")
+
+
+def tokenize(text: str, drop_stop_words: bool = True) -> List[str]:
+    """StandardAnalyzer-like tokens: lowercased alphanumeric runs,
+    stop words removed."""
+    toks = _TOKEN_RE.findall(text.lower())
+    if drop_stop_words:
+        return [t for t in toks if t not in STOP_WORDS]
+    return toks
+
+
+class WordCounter:
+    """Word-count job (WordCounter.java:54): count tokens of one CSV field
+    (text_field_ordinal >= 0) or of whole lines (< 0); output rows of
+    (token, count)."""
+
+    def __init__(self, text_field_ordinal: int = -1, delim: str = ",",
+                 drop_stop_words: bool = True):
+        self.ordinal = text_field_ordinal
+        self.delim = delim
+        self.drop_stop = drop_stop_words
+
+    def count(self, lines: Iterable[str]) -> List[Tuple[str, int]]:
+        vocab: Dict[str, int] = {}
+        codes: List[int] = []
+        for line in lines:
+            line = line.rstrip("\n")
+            if not line.strip():
+                continue
+            text = (line.split(self.delim)[self.ordinal]
+                    if self.ordinal >= 0 else line)
+            for tok in tokenize(text, self.drop_stop):
+                codes.append(vocab.setdefault(tok, len(vocab)))
+        if not codes:
+            return []
+        counts = np.bincount(np.asarray(codes, np.int64), minlength=len(vocab))
+        inv = list(vocab)
+        return sorted(((inv[i], int(c)) for i, c in enumerate(counts)),
+                      key=lambda kv: (-kv[1], kv[0]))

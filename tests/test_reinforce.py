@@ -302,3 +302,39 @@ class TestLearnerStream:
         assert (sel[1] == 0).all()          # only valid slot, repeated
         out = d.selections_to_rows(sel)
         assert out.count(["g1", "solo"]) == 3
+
+    def test_ucb1_normalized_explores_undersampled(self):
+        """0-100 reward scale: radius must stay comparable to value so an
+        undersampled arm gets re-tried (reward normalization)."""
+        rows = [["g", "lucky", "200", "50"], ["g", "unlucky", "1", "10"]]
+        d = GroupBanditData.from_rows(rows)
+        sel = AuerDeterministic(batch_size=1).select(d, round_num=5000)
+        assert sel[0][0] == 1      # huge radius on n=1 beats 0.5 vs 0.1
+
+    def test_auer_greedy_untried_first(self):
+        rows = [["g", "tried", "50", "90"], ["g", "fresh", "0", "0"]]
+        d = GroupBanditData.from_rows(rows)
+        job = GreedyRandomBandit(batch_size=2,
+                                 prob_reduction_algorithm="auerGreedy",
+                                 seed=0)
+        sel = np.asarray(job.select(d, round_num=1000))
+        assert 1 in sel[0]          # untried arm appears in the batch
+
+
+class TestLearnerLongStreams:
+    def test_softmax_survives_temp_underflow(self):
+        lr = create_learner(
+            "softMax", ACTIONS,
+            dict(BASE_CONFIG, **{"min.temp.constant": -1.0}))
+        picks = run_bandit_sim(lr, n_rounds=500)
+        assert picks[-1] in ACTIONS          # no NaN crash
+        assert np.isfinite(lr.probs).all()
+
+    def test_exp3_survives_long_stream(self):
+        lr = create_learner(
+            "exponentialWeight", ACTIONS,
+            dict(BASE_CONFIG, **{"reward.scale": 1}))
+        picks = run_bandit_sim(lr, n_rounds=2000)
+        assert np.isfinite(lr.weights).all()
+        assert np.isfinite(lr.probs).all()
+        assert picks[-200:].count("c") / 200 > 0.4

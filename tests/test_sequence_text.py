@@ -1,0 +1,183 @@
+"""GSP sequence mining, positional clustering, word count."""
+
+from itertools import combinations
+
+import numpy as np
+import pytest
+
+from avenir_tpu.models.sequence import (
+    EventLocalityAnalyzer,
+    GSPMiner,
+    SequenceSet,
+    generate_sequence_candidates,
+    join_sequences,
+    positional_cluster,
+    self_join_sequence,
+)
+from avenir_tpu.models.text import WordCounter, tokenize
+
+
+def is_subsequence(cand, seq):
+    it = iter(seq)
+    return all(tok in it for tok in cand)
+
+
+def brute_force_gsp(seqs, support_threshold, max_len):
+    n = len(seqs)
+    vocab = sorted({t for s in seqs for t in s})
+    out = {}
+    # enumerate all token tuples up to max_len that appear as subsequences
+    def count(cand):
+        return sum(1 for s in seqs if is_subsequence(cand, s))
+    frontier = [(t,) for t in vocab]
+    k = 1
+    while frontier and k <= max_len:
+        freq = {c: count(c) / n for c in frontier if count(c) > support_threshold * n}
+        if not freq:
+            break
+        out[k] = freq
+        frontier = sorted({a + (t,) for a in freq for t in vocab})
+        k += 1
+    return out
+
+
+SEQS = [
+    ["login", "browse", "cart", "buy"],
+    ["login", "browse", "browse", "exit"],
+    ["login", "cart", "buy"],
+    ["browse", "cart", "exit"],
+    ["login", "browse", "cart", "buy"],
+    ["login", "browse", "exit"],
+]
+
+
+class TestGSPJoin:
+    def test_join_rule(self):
+        assert join_sequences(["a", "b"], ["b", "c"]) == ["a", "b", "c"]
+        assert join_sequences(["b", "c"], ["a", "b"]) == ["a", "b", "c"]
+        assert join_sequences(["a", "b"], ["c", "d"]) is None
+
+    def test_self_join(self):
+        assert self_join_sequence(["x", "x"]) == ["x", "x", "x"]
+        assert self_join_sequence(["x", "y"]) is None
+
+    def test_candidate_generation_complete(self):
+        freq = [("a", "b"), ("b", "c"), ("b", "b"), ("c", "a")]
+        cands = generate_sequence_candidates(freq)
+        assert ("a", "b", "c") in cands
+        assert ("a", "b", "b") in cands
+        assert ("b", "c", "a") in cands
+        assert ("b", "b", "c") in cands
+        assert ("b", "b", "b") in cands
+        # every candidate's prefix and suffix must be frequent
+        fs = set(freq)
+        for c in cands:
+            assert c[:-1] in fs and c[1:] in fs
+
+
+class TestGSPMiner:
+    def test_matches_brute_force(self):
+        ss = SequenceSet.from_token_rows(
+            [[f"s{i}"] + s for i, s in enumerate(SEQS)])
+        got = GSPMiner(support_threshold=0.3, max_length=3).mine(ss)
+        want = brute_force_gsp(SEQS, 0.3, 3)
+        # GSP prunes candidates whose sub-sequences are infrequent; brute
+        # force does not — on frequent sets they must agree
+        assert got.keys() == want.keys()
+        for k in want:
+            assert got[k] == pytest.approx(want[k])
+
+    def test_random_matches_brute_force(self, rng):
+        vocab = list("abcde")
+        seqs = [
+            [vocab[j] for j in rng.integers(0, 5, rng.integers(2, 8))]
+            for _ in range(120)
+        ]
+        ss = SequenceSet.from_token_rows([["id"] + s for s in seqs])
+        got = GSPMiner(0.15, max_length=3).mine(ss)
+        want = brute_force_gsp(seqs, 0.15, 3)
+        assert got.keys() == want.keys()
+        for k in want:
+            assert got[k] == pytest.approx(want[k])
+
+    def test_blocked_counting(self, rng):
+        seqs = [["a", "b", "a"], ["b", "a", "b"], ["a", "b"]] * 10
+        ss = SequenceSet.from_token_rows([["i"] + s for s in seqs])
+        a = GSPMiner(0.2, 3, block=4).mine(ss)
+        b = GSPMiner(0.2, 3, block=10**6).mine(ss)
+        assert a.keys() == b.keys()
+        for k in b:
+            assert a[k] == pytest.approx(b[k])
+
+    def test_subsequence_not_substring(self):
+        # "a..c" is a subsequence of "a b c" even though not contiguous
+        ss = SequenceSet.from_token_rows([["i", "a", "b", "c"]])
+        got = GSPMiner(0.0, max_length=2).mine(ss)
+        assert ("a", "c") in got[2]
+
+
+class TestPositionalCluster:
+    def test_dense_burst_scores_high(self):
+        # events bunched at t=100..110, sparse elsewhere
+        ts = np.concatenate([np.arange(100, 111), [0, 50, 200, 300]])
+        fired = np.ones(len(ts), bool)
+        an = EventLocalityAnalyzer(window_time_span=20, time_step=10,
+                                   score_threshold=0.3,
+                                   weighted_strategies={"numOccurence": 1.0})
+        hits = an.score_events(np.sort(ts), fired)
+        assert hits, "burst must be detected"
+        peak_t = max(hits, key=lambda h: h[1])[0]
+        assert 100 <= peak_t <= 130
+
+    def test_condition_filters_events(self):
+        rows = [[str(t), str(v)] for t, v in
+                [(0, 1), (10, 9), (12, 9), (14, 9), (16, 9), (50, 1)]]
+        an = EventLocalityAnalyzer(window_time_span=10, time_step=5,
+                                   score_threshold=0.2,
+                                   preferred_strategies=["numOccurence"],
+                                   min_occurence=3)
+        hits = positional_cluster(rows, an, quant_field_ordinal=1,
+                                  seq_num_field_ordinal=0,
+                                  condition=lambda v: v > 5)
+        assert hits
+        assert all(10 <= t <= 30 for t, _ in hits)
+        none = positional_cluster(rows, an, 1, 0, condition=lambda v: v > 100)
+        assert none == []
+
+    def test_all_cond_stricter_than_any(self):
+        ts = np.arange(0, 100, 7).astype(float)
+        fired = np.ones(len(ts), bool)
+        common = dict(window_time_span=30, time_step=10, score_threshold=0.1,
+                      preferred_strategies=["numOccurence", "maxInterval"],
+                      min_occurence=2, max_interval_max=5.0)
+        any_hits = EventLocalityAnalyzer(any_cond=True, **common
+                                         ).score_events(ts, fired)
+        all_hits = EventLocalityAnalyzer(any_cond=False, **common
+                                         ).score_events(ts, fired)
+        assert len(all_hits) <= len(any_hits)
+
+
+class TestWordCount:
+    def test_tokenize_standard_analyzer_like(self):
+        toks = tokenize("The QUICK brown-fox, and 42 dogs!")
+        assert toks == ["quick", "brown", "fox", "42", "dogs"]
+
+    def test_count_whole_lines(self):
+        wc = WordCounter(text_field_ordinal=-1)
+        counts = dict(wc.count(["red green red", "green red blue"]))
+        assert counts == {"red": 3, "green": 2, "blue": 1}
+
+    def test_count_csv_field(self):
+        wc = WordCounter(text_field_ordinal=1)
+        lines = ["id1,hello world", "id2,hello again"]
+        counts = wc.count(lines)
+        assert counts[0] == ("hello", 2)
+
+    def test_sorted_by_count_then_token(self):
+        wc = WordCounter()
+        out = wc.count(["y x z x y x"])
+        assert out == [("x", 3), ("y", 2), ("z", 1)]
+
+    def test_empty(self):
+        assert WordCounter().count([]) == []
+        assert WordCounter().count(["", "  "]) == []
