@@ -221,3 +221,76 @@ def dataset_distance_matrix(ds: Dataset, metric: str = "euclidean") -> np.ndarra
         metric=metric,
     )
     return np.asarray(d)
+
+
+# ---------------------------------------------------------------------------
+# cluster-tendency exploration (python/unsupv/cluster.py expl_* functions)
+# ---------------------------------------------------------------------------
+
+
+def _min_cross_distances(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """Distance from each row of `a` to its nearest row of `b`
+    (lib/support.py find_min_distances), as one device matmul-distance."""
+    sq_a = jnp.sum(a * a, axis=1)[:, None]
+    sq_b = jnp.sum(b * b, axis=1)[None, :]
+    d2 = jnp.maximum(sq_a + sq_b - 2.0 * (a @ b.T), 0.0)
+    return jnp.sqrt(jnp.min(d2, axis=1))
+
+
+def hopkins_statistic(x: np.ndarray, x_random: np.ndarray,
+                      sample_size: int, num_iters: int = 1,
+                      seed: int = 0) -> float:
+    """Hopkins cluster-tendency statistic (expl_hopkins,
+    unsupv/cluster.py:104-134): ~0.5 means no cluster structure, near 0
+    means clustered. Each iteration splits off `sample_size` real points
+    and `sample_size` uniform-random points, sums nearest-neighbor
+    distances to the remaining data, and averages
+    spl_sum / (ran_sum + spl_sum) over iterations."""
+    if sample_size >= len(x):
+        raise ValueError(f"sample_size {sample_size} must be < len(x) {len(x)}")
+    if sample_size > len(x_random):
+        raise ValueError(
+            f"sample_size {sample_size} exceeds len(x_random) {len(x_random)}")
+    rng = np.random.default_rng(seed)
+    xj = jnp.asarray(x, jnp.float32)
+    xr = jnp.asarray(x_random, jnp.float32)
+    stats = []
+    for _ in range(num_iters):
+        perm = rng.permutation(len(x))
+        spl, tra = xj[perm[:sample_size]], xj[perm[sample_size:]]
+        ran = xr[rng.permutation(len(x_random))[:sample_size]]
+        ran_sum = float(jnp.sum(_min_cross_distances(ran, tra)))
+        spl_sum = float(jnp.sum(_min_cross_distances(spl, tra)))
+        stats.append(spl_sum / max(ran_sum + spl_sum, 1e-30))
+    return float(np.mean(stats))
+
+
+def k_dist(x: np.ndarray, neighbor_index: int,
+           first_order_diff: bool = False) -> np.ndarray:
+    """Sorted distance-to-kth-neighbor curves for DBSCAN eps selection
+    (expl_kdist, unsupv/cluster.py:138-158). Returns [n, k] columns each
+    sorted ascending (or their first-order diffs [n-1, k])."""
+    xj = jnp.asarray(x, jnp.float32)
+    sq = jnp.sum(xj * xj, axis=1)
+    d2 = jnp.maximum(sq[:, None] + sq[None, :] - 2.0 * (xj @ xj.T), 0.0)
+    d = jnp.sqrt(d2.at[jnp.diag_indices(xj.shape[0])].set(jnp.inf))
+    # k smallest per row (excluding self), then sort each column
+    neg_top, _ = jax.lax.top_k(-d, neighbor_index)
+    dist = jnp.sort(-neg_top, axis=0)
+    out = np.asarray(dist)
+    return np.diff(out, axis=0) if first_order_diff else out
+
+
+def _scale_min_max(v: np.ndarray) -> np.ndarray:
+    lo, hi = v.min(), v.max()
+    return (v - lo) / (hi - lo) if hi > lo else np.zeros_like(v)
+
+
+def validity_index(under_partition: np.ndarray,
+                   over_partition: np.ndarray) -> np.ndarray:
+    """Cluster-count selection index (validity_index,
+    unsupv/cluster.py:168-172): min-max-scaled under-partition measure
+    (e.g. cohesion) + scaled over-partition measure (e.g. 1/separation);
+    minimize over candidate k."""
+    return (_scale_min_max(np.asarray(under_partition, np.float64))
+            + _scale_min_max(np.asarray(over_partition, np.float64)))

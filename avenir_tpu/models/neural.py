@@ -1,0 +1,143 @@
+"""Basic one-hidden-layer neural network classifier.
+
+Reference (python/supv/basic_nn.py, SURVEY §2.10): a numpy two-layer net —
+tanh hidden layer, softmax output, cross-entropy loss with L2 decay —
+trained by full-batch ("batch") or per-sample ("stochastic") gradient
+descent on scikit-learn moons data, with a held-out validation slice.
+
+TPU-first design: parameters live in a pytree; one jitted `lax.scan` runs
+the entire epoch loop on device (grads via `jax.grad` rather than
+hand-derived backprop). Batch mode scans full-batch steps; minibatch mode
+scans over reshaped [steps, B, D] batches. The moons generator is
+re-implemented in numpy (no sklearn dependency).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+Params = Dict[str, jnp.ndarray]
+
+
+def make_moons(n: int, noise: float = 0.2, seed: int = 0
+               ) -> Tuple[np.ndarray, np.ndarray]:
+    """Two interleaving half circles (sklearn.datasets.make_moons analog,
+    basic_nn.py:46)."""
+    rng = np.random.default_rng(seed)
+    n_out = n // 2
+    n_in = n - n_out
+    t_out = np.pi * rng.random(n_out)
+    t_in = np.pi * rng.random(n_in)
+    x = np.concatenate([
+        np.stack([np.cos(t_out), np.sin(t_out)], axis=1),
+        np.stack([1.0 - np.cos(t_in), 0.5 - np.sin(t_in)], axis=1),
+    ])
+    y = np.concatenate([np.zeros(n_out, np.int64), np.ones(n_in, np.int64)])
+    x += rng.normal(0.0, noise, x.shape)
+    perm = rng.permutation(n)
+    return x[perm].astype(np.float32), y[perm]
+
+
+def _init_params(key, n_in: int, n_hidden: int, n_out: int) -> Params:
+    k1, k2 = jax.random.split(key)
+    return {
+        "w1": jax.random.normal(k1, (n_in, n_hidden)) / jnp.sqrt(n_in),
+        "b1": jnp.zeros((n_hidden,)),
+        "w2": jax.random.normal(k2, (n_hidden, n_out)) / jnp.sqrt(n_hidden),
+        "b2": jnp.zeros((n_out,)),
+    }
+
+
+def _logits(params: Params, x: jnp.ndarray) -> jnp.ndarray:
+    h = jnp.tanh(x @ params["w1"] + params["b1"])
+    return h @ params["w2"] + params["b2"]
+
+
+def _loss(params: Params, x, y, reg: float) -> jnp.ndarray:
+    logp = jax.nn.log_softmax(_logits(params, x))
+    nll = -jnp.mean(jnp.take_along_axis(logp, y[:, None], axis=1))
+    l2 = sum(jnp.sum(w * w) for k, w in params.items() if k.startswith("w"))
+    return nll + reg * l2
+
+
+@partial(jax.jit, static_argnames=("epochs", "reg"))
+def _train_batch(params: Params, x, y, lr, epochs: int, reg: float):
+    grad = jax.grad(_loss)
+
+    def step(p, _):
+        g = grad(p, x, y, reg)
+        return jax.tree_util.tree_map(lambda w, gw: w - lr * gw, p, g), None
+
+    params, _ = jax.lax.scan(step, params, None, length=epochs)
+    return params
+
+
+@partial(jax.jit, static_argnames=("reg",))
+def _train_minibatch(params: Params, xb, yb, lr, reg: float):
+    """xb: [steps, B, D], yb: [steps, B] — scan over the step axis."""
+    grad = jax.grad(_loss)
+
+    def step(p, batch):
+        x, y = batch
+        g = grad(p, x, y, reg)
+        return jax.tree_util.tree_map(lambda w, gw: w - lr * gw, p, g), None
+
+    params, _ = jax.lax.scan(step, params, (xb, yb))
+    return params
+
+
+@dataclass
+class BasicNeuralNetwork:
+    """1-hidden-layer tanh classifier (basic_nn.py surface: hidden size,
+    iteration count, learning rate epsilon, training mode batch/stochastic)."""
+
+    n_hidden: int = 8
+    n_classes: int = 2
+    learning_rate: float = 0.01
+    iterations: int = 1000
+    reg: float = 0.0001
+    training_mode: str = "batch"        # batch / stochastic / minibatch
+    batch_size: int = 32
+    seed: int = 0
+
+    params: Optional[Params] = None
+
+    def fit(self, x: np.ndarray, y: np.ndarray) -> "BasicNeuralNetwork":
+        x = jnp.asarray(x, jnp.float32)
+        y = jnp.asarray(y, jnp.int32)
+        params = _init_params(jax.random.key(self.seed), x.shape[1],
+                              self.n_hidden, self.n_classes)
+        if self.training_mode == "batch":
+            params = _train_batch(params, x, y, self.learning_rate,
+                                  self.iterations, self.reg)
+        else:
+            n = x.shape[0]
+            bs = 1 if self.training_mode == "stochastic" else min(
+                self.batch_size, n)
+            rng = np.random.default_rng(self.seed)
+            # exactly `iterations` gradient steps, one sampled batch each
+            order = rng.integers(0, n, (self.iterations, bs))
+            xb = x[order.reshape(-1)].reshape(self.iterations, bs, x.shape[1])
+            yb = y[order.reshape(-1)].reshape(self.iterations, bs)
+            params = _train_minibatch(params, xb, yb, self.learning_rate,
+                                      self.reg)
+        self.params = params
+        return self
+
+    def predict_proba(self, x: np.ndarray) -> np.ndarray:
+        if self.params is None:
+            raise RuntimeError("model not fitted")
+        return np.asarray(jax.nn.softmax(
+            _logits(self.params, jnp.asarray(x, jnp.float32))))
+
+    def predict(self, x: np.ndarray) -> np.ndarray:
+        return np.asarray(self.predict_proba(x).argmax(axis=1))
+
+    def score(self, x: np.ndarray, y: np.ndarray) -> float:
+        return float(np.mean(self.predict(x) == np.asarray(y)))

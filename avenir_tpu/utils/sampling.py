@@ -1,0 +1,171 @@
+"""Distribution samplers + histogram for synthetic data generation.
+
+Reference (python/lib/sampler.py + stats.py, SURVEY §2.10): rejection
+samplers (Gaussian over mean±3σ, non-parametric over a binned histogram), a
+Metropolis-Hastings sampler with a Gaussian random-walk proposal (optionally
+a local/global mixture), and a Histogram container — the machinery behind
+every `resource/*.py` synthetic data generator.
+
+TPU-first design: samplers are vectorized — `sample(n)` draws n values in
+one shot from numpy Generator primitives (inverse-CDF for the histogram
+instead of scalar accept/reject loops); the Metropolis chain is a
+`lax.scan` so long chains run as one compiled program.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional, Sequence, Tuple
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+
+class Histogram:
+    """Binned distribution over [xmin, xmin + bin_width*(n-1)]
+    (stats.py Histogram)."""
+
+    def __init__(self, xmin: float, bin_width: float,
+                 values: Optional[Sequence[float]] = None,
+                 xmax: Optional[float] = None):
+        self.xmin = float(xmin)
+        self.bin_width = float(bin_width)
+        if values is not None:
+            self.bins = np.asarray(values, np.float64)
+            self.xmax = self.xmin + self.bin_width * (len(self.bins) - 1)
+        else:
+            self.xmax = float(xmax)
+            n = int((self.xmax - self.xmin) / self.bin_width) + 1
+            self.bins = np.zeros(n, np.float64)
+
+    @classmethod
+    def initialized(cls, xmin, bin_width, values) -> "Histogram":
+        return cls(xmin, bin_width, values=values)
+
+    @classmethod
+    def uninitialized(cls, xmin, xmax, bin_width) -> "Histogram":
+        return cls(xmin, bin_width, xmax=xmax)
+
+    def add(self, x: np.ndarray) -> None:
+        idx = np.clip(((np.asarray(x) - self.xmin) // self.bin_width)
+                      .astype(np.int64), 0, len(self.bins) - 1)
+        np.add.at(self.bins, idx, 1.0)
+
+    def value(self, x) -> np.ndarray:
+        idx = np.clip(((np.asarray(x) - self.xmin) // self.bin_width)
+                      .astype(np.int64), 0, len(self.bins) - 1)
+        return self.bins[idx]
+
+    def bounded(self, x):
+        return np.clip(x, self.xmin, self.xmax)
+
+    def min_max(self) -> Tuple[float, float]:
+        return self.xmin, self.xmax
+
+    def normalized(self) -> np.ndarray:
+        s = self.bins.sum()
+        return self.bins / s if s > 0 else self.bins
+
+
+@dataclass
+class GaussianSampler:
+    """Gaussian sampler truncated to mean±3σ (GaussianRejectSampler,
+    sampler.py:25 — same distribution, drawn by redraw instead of a scalar
+    accept/reject loop)."""
+
+    mean: float
+    std_dev: float
+    rng: np.random.Generator = field(
+        default_factory=lambda: np.random.default_rng())
+
+    def sample(self, n: Optional[int] = None):
+        shape = (n,) if n is not None else (64,)
+        lo, hi = self.mean - 3 * self.std_dev, self.mean + 3 * self.std_dev
+        out = self.rng.normal(self.mean, self.std_dev, shape)
+        bad = (out < lo) | (out > hi)
+        while bad.any():
+            out[bad] = self.rng.normal(self.mean, self.std_dev, bad.sum())
+            bad = (out < lo) | (out > hi)
+        return out if n is not None else float(out[0])
+
+
+@dataclass
+class NonParamSampler:
+    """Sampler over an arbitrary binned distribution (NonParamRejectSampler,
+    sampler.py:50) via inverse CDF on the histogram weights."""
+
+    xmin: float
+    bin_width: float
+    values: Sequence[float]
+    rng: np.random.Generator = field(
+        default_factory=lambda: np.random.default_rng())
+
+    def sample(self, n: Optional[int] = None):
+        p = np.asarray(self.values, np.float64)
+        p = p / p.sum()
+        k = self.rng.choice(len(p), size=n if n is not None else 1, p=p)
+        out = self.xmin + k * self.bin_width
+        return out if n is not None else float(out[0])
+
+
+class MetropolisSampler:
+    """Metropolis chain over a histogram target (MetropolitanSampler,
+    sampler.py:78): Gaussian random-walk proposal, optional local/global
+    mixture, thinning via `skip`. The whole chain is one `lax.scan`."""
+
+    def __init__(self, proposal_std: float, xmin: float, bin_width: float,
+                 values: Sequence[float], seed: int = 0,
+                 global_proposal_std: Optional[float] = None,
+                 mixture_threshold: float = 0.5):
+        self.target = Histogram.initialized(xmin, bin_width, values)
+        self.proposal_std = float(proposal_std)
+        self.global_proposal_std = global_proposal_std
+        self.mixture_threshold = float(mixture_threshold)
+        self.key = jax.random.key(seed)
+        self.cur = float(np.random.default_rng(seed).uniform(
+            self.target.xmin, self.target.xmax))
+        self.trans_count = 0
+
+    def set_mixture_proposal(self, global_std: float, threshold: float):
+        self.global_proposal_std = float(global_std)
+        self.mixture_threshold = float(threshold)
+
+    def sample(self, n: int = 1, skip: int = 1) -> np.ndarray:
+        """Draw n samples, advancing `skip` proposals per draw."""
+        bins = jnp.asarray(self.target.bins)
+        xmin, xmax = self.target.xmin, self.target.xmax
+        bw = self.target.bin_width
+        pstd = self.proposal_std
+        gstd = self.global_proposal_std
+        thr = self.mixture_threshold
+
+        def value(x):
+            idx = jnp.clip(((x - xmin) // bw).astype(jnp.int32),
+                           0, bins.shape[0] - 1)
+            return bins[idx]
+
+        def propose(key, x):
+            if gstd is None:
+                return x + pstd * jax.random.normal(key)
+            ku, kn = jax.random.split(key)
+            std = jnp.where(jax.random.uniform(ku) < thr, pstd, gstd)
+            return x + std * jax.random.normal(kn)
+
+        def one_step(carry, key):
+            x, fx, acc = carry
+            kp, ka = jax.random.split(key)
+            nxt = jnp.clip(propose(kp, x), xmin, xmax)
+            fn = value(nxt)
+            take = jax.random.uniform(ka) < fn / jnp.maximum(fx, 1e-30)
+            x2 = jnp.where(take, nxt, x)
+            return (x2, jnp.where(take, fn, fx), acc + take.astype(jnp.int32)), x2
+
+        keys = jax.random.split(self.key, n * skip + 1)
+        self.key = keys[0]
+        fx0 = jnp.maximum(value(jnp.asarray(self.cur)), 1e-30)
+        (x, _, acc), chain = jax.lax.scan(
+            one_step, (jnp.asarray(self.cur), fx0, jnp.asarray(0)), keys[1:])
+        self.cur = float(x)
+        self.trans_count += int(acc)
+        return np.asarray(chain)[skip - 1::skip][:n]

@@ -1,0 +1,104 @@
+"""Sampler + MCMC convergence diagnostic tests (reference python/lib)."""
+
+import numpy as np
+
+from avenir_tpu.utils.sampling import (
+    Histogram, GaussianSampler, NonParamSampler, MetropolisSampler)
+from avenir_tpu.utils.mcmc import (
+    GewekeConvergence, RafteryLewisConvergence, _norm_ppf)
+
+
+class TestHistogram:
+    def test_add_and_value(self):
+        h = Histogram.uninitialized(0.0, 10.0, 1.0)
+        h.add(np.array([0.5, 0.7, 5.2]))
+        assert h.value(0.6) == 2.0
+        assert h.value(5.0) == 1.0
+        assert h.min_max() == (0.0, 10.0)
+
+    def test_initialized_normalize(self):
+        h = Histogram.initialized(0.0, 1.0, [1.0, 3.0])
+        np.testing.assert_allclose(h.normalized(), [0.25, 0.75])
+
+
+class TestSamplers:
+    def test_gaussian_truncated(self):
+        s = GaussianSampler(10.0, 2.0, rng=np.random.default_rng(0))
+        x = s.sample(2000)
+        assert abs(x.mean() - 10.0) < 0.2
+        assert np.all(x >= 4.0) and np.all(x <= 16.0)
+
+    def test_nonparam_matches_weights(self):
+        s = NonParamSampler(0.0, 1.0, [1.0, 0.0, 3.0],
+                            rng=np.random.default_rng(1))
+        x = s.sample(4000)
+        assert set(np.unique(x)) <= {0.0, 2.0}
+        frac2 = np.mean(x == 2.0)
+        assert abs(frac2 - 0.75) < 0.05
+
+    def test_metropolis_targets_histogram(self):
+        # bimodal target: mass at bins 0-2 and 8-10 of width 1 from 0
+        values = [3, 2, 1, 0, 0, 0, 0, 0, 1, 2, 3]
+        m = MetropolisSampler(proposal_std=2.0, xmin=0.0, bin_width=1.0,
+                              values=values, seed=0)
+        chain = m.sample(4000, skip=2)
+        assert m.trans_count > 0
+        lo = np.mean(chain < 3.5)
+        mid = np.mean((chain > 3.5) & (chain < 7.5))
+        assert lo > mid            # samples concentrate in high-mass region
+
+    def test_metropolis_mixture_proposal(self):
+        m = MetropolisSampler(proposal_std=0.5, xmin=0.0, bin_width=1.0,
+                              values=[1, 2, 3, 2, 1], seed=1)
+        m.set_mixture_proposal(global_std=3.0, threshold=0.7)
+        chain = m.sample(500)
+        assert chain.shape == (500,)
+        assert np.all(chain >= 0.0) and np.all(chain <= 4.0)
+
+
+class TestGeweke:
+    def test_converged_chain_small_z(self):
+        rng = np.random.default_rng(2)
+        chain = rng.normal(0.0, 1.0, 5000)
+        g = GewekeConvergence(burn_in_sizes=[0, 500])
+        zs = g.calculate_zscores(chain)
+        assert len(zs) == 2
+        assert all(abs(z) < 3.0 for _, _, z in zs)
+        assert g.converged()
+
+    def test_trending_chain_large_z(self):
+        n = 5000
+        chain = np.linspace(0.0, 5.0, n) + np.random.default_rng(3).normal(
+            0, 0.1, n)
+        g = GewekeConvergence(burn_in_sizes=[0])
+        (_, _, z), = g.calculate_zscores(chain)
+        assert abs(z) > 5.0
+
+
+class TestRafteryLewis:
+    def test_iid_chain_sizes(self):
+        rng = np.random.default_rng(4)
+        chain = rng.normal(0, 1, 20000)
+        rl = RafteryLewisConvergence(quantile=0.025, accuracy=0.005,
+                                     confidence=0.95)
+        burn_in, n = rl.find_sample_size(chain)
+        assert burn_in >= 0
+        # for a nearly iid chain, required n should be near n_min
+        assert 0.2 * rl.n_min() < n < 20 * rl.n_min()
+
+    def test_correlated_chain_needs_more(self):
+        rng = np.random.default_rng(5)
+        # AR(1) with high autocorrelation
+        eps = rng.normal(0, 1, 20000)
+        chain = np.zeros(20000)
+        for i in range(1, 20000):
+            chain[i] = 0.95 * chain[i - 1] + eps[i]
+        rl = RafteryLewisConvergence()
+        _, n_corr = rl.find_sample_size(chain)
+        _, n_iid = rl.find_sample_size(rng.normal(0, 1, 20000))
+        assert n_corr > n_iid
+
+    def test_norm_ppf(self):
+        assert abs(_norm_ppf(0.975) - 1.959964) < 1e-4
+        assert abs(_norm_ppf(0.5)) < 1e-9
+        assert abs(_norm_ppf(0.025) + 1.959964) < 1e-4

@@ -1,0 +1,147 @@
+"""SVM + basic NN + cluster-tendency tests (reference python/ layer)."""
+
+import numpy as np
+import pytest
+
+from avenir_tpu.models.svm import (
+    SVMClassifier, BaggedSVM, kfold_validate, rfold_validate)
+from avenir_tpu.models.neural import BasicNeuralNetwork, make_moons
+from avenir_tpu.models.cluster import (
+    hopkins_statistic, k_dist, validity_index)
+
+
+def _blobs(n=120, seed=0):
+    rng = np.random.default_rng(seed)
+    half = n // 2
+    x = np.concatenate([
+        rng.normal(-2.0, 0.6, (half, 2)),
+        rng.normal(2.0, 0.6, (n - half, 2)),
+    ]).astype(np.float32)
+    y = np.concatenate([np.zeros(half, np.int64), np.ones(n - half, np.int64)])
+    perm = rng.permutation(n)
+    return x[perm], y[perm]
+
+
+class TestSVM:
+    def test_linear_separable(self):
+        x, y = _blobs()
+        m = SVMClassifier(kernel="linear", c=10.0, epochs=300).fit(x, y)
+        assert m.score(x, y) > 0.95
+
+    def test_rbf_moons(self):
+        x, y = make_moons(200, noise=0.1, seed=1)
+        m = SVMClassifier(kernel="rbf", gamma=2.0, c=10.0, epochs=400).fit(x, y)
+        assert m.score(x, y) > 0.9
+
+    def test_poly_runs(self):
+        x, y = _blobs(80)
+        m = SVMClassifier(kernel="poly", degree=2, gamma=0.5, c=5.0,
+                          epochs=200).fit(x, y)
+        assert m.score(x, y) > 0.8
+
+    def test_decision_function_sign_matches_predict(self):
+        x, y = _blobs(60)
+        m = SVMClassifier(kernel="linear", epochs=100).fit(x, y)
+        f = m.decision_function(x)
+        assert np.array_equal(m.predict(x), (f > 0).astype(np.int64))
+
+    def test_save_load_roundtrip(self, tmp_path):
+        x, y = _blobs(60)
+        m = SVMClassifier(kernel="rbf", gamma=1.0, epochs=100).fit(x, y)
+        p = str(tmp_path / "svm.npz")
+        m.save(p)
+        m2 = SVMClassifier.load(p)
+        np.testing.assert_array_equal(m.predict(x), m2.predict(x))
+
+    def test_unknown_kernel_raises(self):
+        with pytest.raises(ValueError):
+            SVMClassifier(kernel="sigmoid")
+
+    def test_support_indices_subset(self):
+        x, y = _blobs(60)
+        m = SVMClassifier(kernel="linear", c=10.0, epochs=300).fit(x, y)
+        sv = m.support_indices
+        assert 0 < len(sv) <= len(x)
+
+
+class TestSVMValidation:
+    def test_kfold_low_error_on_separable(self):
+        x, y = _blobs(150, seed=2)
+        rep = kfold_validate(SVMClassifier(kernel="linear", c=10.0,
+                                           epochs=200), x, y, nfold=5)
+        assert len(rep.fold_errors) == 5
+        assert rep.avg_error < 0.1
+        # error decomposes into fp + fn
+        assert rep.avg_error == pytest.approx(
+            rep.avg_fp_error + rep.avg_fn_error, abs=1e-9)
+
+    def test_rfold_and_cost(self):
+        x, y = _blobs(100, seed=3)
+        rep = rfold_validate(SVMClassifier(kernel="linear", c=10.0,
+                                           epochs=150), x, y,
+                             nfold=5, niter=3, seed=1)
+        assert len(rep.fold_errors) == 3
+        assert rep.cost(fp_cost=2.0, fn_cost=1.0) >= rep.avg_fn_error
+
+
+class TestBaggedSVM:
+    def test_bagging_majority_vote(self):
+        x, y = _blobs(120, seed=4)
+        ens = BaggedSVM(SVMClassifier(kernel="linear", c=10.0, epochs=150),
+                        num_estimators=5, sample_fraction=0.7,
+                        use_oob=True).fit(x, y, seed=0)
+        assert ens.score(x, y) > 0.9
+        assert ens.oob_score_ is not None and ens.oob_score_ > 0.8
+        assert ens.dual_coefs.shape == (5, len(x))
+
+
+class TestNeuralNetwork:
+    def test_batch_mode_learns_moons(self):
+        x, y = make_moons(300, noise=0.15, seed=5)
+        nn = BasicNeuralNetwork(n_hidden=16, learning_rate=0.5,
+                                iterations=800, training_mode="batch",
+                                seed=0).fit(x, y)
+        assert nn.score(x, y) > 0.9
+
+    def test_minibatch_mode(self):
+        x, y = make_moons(256, noise=0.15, seed=6)
+        nn = BasicNeuralNetwork(n_hidden=16, learning_rate=0.2,
+                                iterations=600, training_mode="minibatch",
+                                batch_size=32, seed=0).fit(x, y)
+        assert nn.score(x, y) > 0.85
+
+    def test_proba_normalized(self):
+        x, y = make_moons(100, noise=0.2, seed=7)
+        nn = BasicNeuralNetwork(iterations=50).fit(x, y)
+        p = nn.predict_proba(x)
+        np.testing.assert_allclose(p.sum(axis=1), 1.0, atol=1e-5)
+
+
+class TestClusterTendency:
+    def test_hopkins_detects_clusters(self):
+        rng = np.random.default_rng(8)
+        clustered = np.concatenate([
+            rng.normal(-5, 0.3, (100, 2)), rng.normal(5, 0.3, (100, 2))])
+        uniform_ref = rng.uniform(-6, 6, (200, 2))
+        h_clustered = hopkins_statistic(clustered, uniform_ref, 20,
+                                        num_iters=4, seed=0)
+        h_uniform = hopkins_statistic(uniform_ref, rng.uniform(-6, 6, (200, 2)),
+                                      20, num_iters=4, seed=0)
+        assert h_clustered < h_uniform
+        assert h_clustered < 0.3
+
+    def test_k_dist_sorted(self):
+        rng = np.random.default_rng(9)
+        x = rng.normal(0, 1, (50, 3))
+        d = k_dist(x, neighbor_index=3)
+        assert d.shape == (50, 3)
+        assert np.all(np.diff(d, axis=0) >= -1e-6)
+        diffs = k_dist(x, neighbor_index=3, first_order_diff=True)
+        assert diffs.shape == (49, 3)
+
+    def test_validity_index(self):
+        under = np.array([5.0, 3.0, 1.0, 0.5])    # cohesion falls with k
+        over = np.array([0.1, 0.3, 1.0, 4.0])     # over-split rises with k
+        v = validity_index(under, over)
+        assert v.shape == (4,)
+        assert v.argmin() in (1, 2)                # elbow in the middle
