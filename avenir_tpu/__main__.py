@@ -1,0 +1,13 @@
+"""CLI: `python -m avenir_tpu <jobName> --conf <props> IN... OUT`.
+
+The `hadoop jar avenir.jar <ToolClass> -Dconf.path=<props> IN OUT` surface
+(resource/detr.sh:52, knn.sh:76) without the JVM: job names or full
+reference Tool class names are accepted.
+"""
+
+import sys
+
+from avenir_tpu.runner import run_from_cli
+
+if __name__ == "__main__":
+    run_from_cli(sys.argv[1:])

@@ -101,6 +101,18 @@ class NaiveBayesModel:
         model.accumulate(codes, dataset.labels(), x_cont)
         return model
 
+    def merge(self, other: "NaiveBayesModel") -> "NaiveBayesModel":
+        """Combine sufficient statistics of two partial fits (counts are
+        additive — the same algebra that merges mesh shards via psum merges
+        input splits; replaces the reference's reducer-side summation)."""
+        if self.cont_params is not None or other.cont_params is not None:
+            raise ValueError("cannot merge models loaded from CSV "
+                             "(raw moments unavailable)")
+        self.post_counts = self.post_counts + other.post_counts
+        self.cont_moments = self.cont_moments + other.cont_moments
+        self.class_counts = self.class_counts + other.class_counts
+        return self
+
     # ----------------------------------------------------------- finishing
     def finish(self) -> Dict[str, jnp.ndarray]:
         """Derive the probability tables used by the jitted predictor.
@@ -320,3 +332,24 @@ class NaiveBayesPredictor:
         cm = ConfusionMatrix(self.model.class_values, pos_class=pos_class)
         cm.add(dataset.labels(), pred)
         return cm
+
+    def feature_prob(self, dataset: Dataset) -> np.ndarray:
+        """Per-row P(features | actual class): the bap.output.feature.prob.only
+        mode whose output the reference's KNN pipeline joins as
+        class-conditional weights (BayesianPredictor.java:262-286)."""
+        codes, _ = dataset.feature_codes(self.model.binned_fields)
+        y = dataset.labels()
+        logp = np.zeros(len(dataset), np.float64)
+        if codes.shape[1]:
+            lp = np.asarray(self.tables["log_post"])       # [F, K, B]
+            for f in range(codes.shape[1]):
+                logp += lp[f, y, codes[:, f]]
+        x_cont = dataset.feature_matrix(self.model.cont_fields)
+        if x_cont.shape[1]:
+            mean = np.asarray(self.tables["cont_mean"])    # [Fc, K]
+            std = np.asarray(self.tables["cont_std"])
+            for f in range(x_cont.shape[1]):
+                m, s = mean[f, y], std[f, y]
+                logp += (-0.5 * np.log(2 * np.pi) - np.log(s)
+                         - 0.5 * ((x_cont[:, f] - m) / s) ** 2)
+        return np.exp(logp)

@@ -199,22 +199,39 @@ class ValidationReport:
         return fp_cost * self.avg_fp_error + fn_cost * self.avg_fn_error
 
 
+def _folds_validate(model: SVMClassifier, x: np.ndarray, y: np.ndarray,
+                    vmasks: np.ndarray) -> ValidationReport:
+    """All folds in one device program: one Gram matrix shared across folds,
+    `vmap` of the trainer over train masks (the BaggedSVM pattern)."""
+    xj = jnp.asarray(x, jnp.float32)
+    yn = np.asarray(y)
+    ypm = jnp.asarray(np.where(yn > 0, 1.0, -1.0), jnp.float32)
+    gram = _kernel_matrix(xj, xj, model.kernel, model.gamma, model.degree,
+                          model.coef0)
+    train = jax.vmap(
+        lambda m: _train_kernel_primal(gram, ypm, m, model.c,
+                                       model.learning_rate, model.epochs))
+    ays, bs = train(jnp.asarray((~vmasks).astype(np.float32)))
+    f = np.asarray(gram @ ays.T + bs)                     # [n, folds]
+    yb = (yn > 0).astype(np.int64)
+    report = ValidationReport()
+    for i, vm in enumerate(vmasks):
+        pred = (f[vm, i] > 0.0).astype(np.int64)
+        report.fold_errors.append(_fold_errors(yb[vm], pred))
+    return report
+
+
 def kfold_validate(model: SVMClassifier, x: np.ndarray, y: np.ndarray,
                    nfold: int) -> ValidationReport:
     """Sequential k-fold (train_kfold_validation_ext, svm.py:53-99):
     validation window slides by len/nfold each fold."""
     n = len(x)
     length = n // nfold
-    report = ValidationReport()
+    vmasks = np.zeros((nfold, n), bool)
     for i in range(nfold):
         lo, hi = i * length, (i + 1) * length if i < nfold - 1 else n
-        vmask = np.zeros(n, bool)
-        vmask[lo:hi] = True
-        m = SVMClassifier(model.kernel, model.c, model.gamma, model.degree,
-                          model.coef0, model.learning_rate, model.epochs)
-        m.fit(x, y, sample_mask=(~vmask).astype(np.float32))
-        report.fold_errors.append(_fold_errors(y[vmask], m.predict(x[vmask])))
-    return report
+        vmasks[i, lo:hi] = True
+    return _folds_validate(model, x, y, vmasks)
 
 
 def rfold_validate(model: SVMClassifier, x: np.ndarray, y: np.ndarray,
@@ -224,16 +241,11 @@ def rfold_validate(model: SVMClassifier, x: np.ndarray, y: np.ndarray,
     rng = np.random.default_rng(seed)
     n = len(x)
     length = n // nfold
-    report = ValidationReport()
-    for _ in range(niter):
+    vmasks = np.zeros((niter, n), bool)
+    for i in range(niter):
         lo = int(rng.integers(0, n - length + 1))
-        vmask = np.zeros(n, bool)
-        vmask[lo:lo + length] = True
-        m = SVMClassifier(model.kernel, model.c, model.gamma, model.degree,
-                          model.coef0, model.learning_rate, model.epochs)
-        m.fit(x, y, sample_mask=(~vmask).astype(np.float32))
-        report.fold_errors.append(_fold_errors(y[vmask], m.predict(x[vmask])))
-    return report
+        vmasks[i, lo:lo + length] = True
+    return _folds_validate(model, x, y, vmasks)
 
 
 @dataclass

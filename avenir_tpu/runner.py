@@ -1,0 +1,708 @@
+"""Properties-driven job runner: the L6/L5 surface of the reference.
+
+The reference is driven as `hadoop jar avenir.jar <ToolClass>
+-Dconf.path=<props> IN OUT` from bash case-statement scripts
+(resource/detr.sh:52, resource/knn.sh:76); every job reads namespaced keys
+from one flat properties file (SURVEY §2.11, §5 config). This module keeps
+that surface: a registry of jobs addressed by the reference's job names /
+Tool class names, each reading the *same* config keys (`bad.*`, `nen.*`,
+`dtb.*`, `fia.*`, `mst.*`, ...) from the same properties files, plus a
+`Pipeline` that replaces the shell case statements.
+
+What changes is the execution: a "job" here is an in-process call into the
+jitted TPU kernels — no JVM spawn, no HDFS round trip between stages. Jobs
+that the reference chains through intermediate HDFS files (e.g. the 5-stage
+KNN pipeline, SURVEY §3.3) collapse into fused single jobs, but each stage
+name is still addressable for drop-in pipeline parity.
+
+Model/state files between iterative rounds stay plain files (SURVEY §5
+checkpoint/resume): DecisionPathList JSON, itemset CSVs per Apriori k,
+Markov matrix files, LR coefficient history.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from avenir_tpu.core.config import JobConfig, load_properties
+from avenir_tpu.core.dataset import Dataset
+from avenir_tpu.core.schema import FeatureSchema
+from avenir_tpu.utils.metrics import ConfusionMatrix
+
+
+@dataclass
+class JobResult:
+    """What a job hands back to the driver: Hadoop-counter-style counters
+    (the reference's "Validation:*" groups, BayesianPredictor.java:170-180)
+    plus produced file paths and an optional in-memory payload."""
+
+    name: str
+    counters: Dict[str, float] = field(default_factory=dict)
+    outputs: List[str] = field(default_factory=list)
+    payload: object = None
+
+    def __repr__(self) -> str:
+        return f"JobResult({self.name}, counters={self.counters}, outputs={self.outputs})"
+
+
+JobFn = Callable[[JobConfig, List[str], str], JobResult]
+
+# registry key (job name or Tool class alias) -> (canonical name, prefix, fn)
+_REGISTRY: Dict[str, Tuple[str, str, JobFn]] = {}
+
+
+def job(name: str, prefix: str, *aliases: str):
+    """Register a job under its pipeline name + reference Tool class name."""
+
+    def deco(fn: JobFn) -> JobFn:
+        for key in (name, *aliases):
+            _REGISTRY[key] = (name, prefix, fn)
+        return fn
+
+    return deco
+
+
+def job_names() -> List[str]:
+    return sorted(_REGISTRY)
+
+
+def run_job(name: str, conf, inputs: Sequence[str], output: str = "") -> JobResult:
+    """Run a registered job. `conf` is a properties file path, a dict, or a
+    JobConfig; the job sees it scoped under its reference prefix."""
+    if name not in _REGISTRY:
+        raise KeyError(
+            f"unknown job {name!r}; known: {', '.join(job_names())}"
+        )
+    canonical, prefix, fn = _REGISTRY[name]
+    if isinstance(conf, str):
+        cfg = JobConfig(load_properties(conf), prefix)
+    elif isinstance(conf, dict):
+        cfg = JobConfig(conf, prefix)
+    else:
+        cfg = conf.scoped(prefix)
+    cfg.props["__job_name__"] = canonical
+    if output:
+        parent = os.path.dirname(os.path.abspath(output))
+        os.makedirs(parent, exist_ok=True)
+    return fn(cfg, list(inputs), output)
+
+
+# ---------------------------------------------------------------- helpers
+def _out_file(output: str, part: str = "part-r-00000") -> str:
+    """Output path contract: a directory (Hadoop-style `part-r-00000`
+    inside) when the path ends with '/' or already is a directory, else a
+    plain file."""
+    if output.endswith(os.sep) or os.path.isdir(output):
+        os.makedirs(output, exist_ok=True)
+        return os.path.join(output, part)
+    parent = os.path.dirname(os.path.abspath(output))
+    os.makedirs(parent, exist_ok=True)
+    return output
+
+
+def _schema(cfg: JobConfig) -> FeatureSchema:
+    return FeatureSchema.from_file(cfg.assert_get("feature.schema.file.path"))
+
+
+def _dataset(path: str, cfg: JobConfig, keep_raw: bool = False) -> Dataset:
+    return Dataset.from_csv(path, _schema(cfg), delim=cfg.field_delim_regex,
+                            keep_raw=keep_raw)
+
+
+def _read_lines(path: str) -> List[str]:
+    with open(path) as fh:
+        return [ln.rstrip("\n") for ln in fh if ln.strip()]
+
+
+def _read_sequences(path: str, delim: str, skip: int,
+                    class_ord: Optional[int] = None):
+    """Rows -> (ids, sequences, labels). First `skip` fields are meta
+    (id/class); `class_ord` points into the full row."""
+    ids, seqs, labels = [], [], []
+    for ln in _read_lines(path):
+        toks = [t.strip() for t in ln.split(delim)]
+        ids.append(toks[0] if skip > 0 else "")
+        labels.append(toks[class_ord] if class_ord is not None else None)
+        seqs.append(toks[skip:])
+    return ids, seqs, labels
+
+
+def _validate(class_values: Sequence[str], actual: np.ndarray,
+              predicted: np.ndarray, pos_class: int) -> Dict[str, float]:
+    """ConfusionMatrix.counters() — the reference's "Validation" Hadoop
+    counter group (BayesianPredictor.java:170-180, int-percent scaled)."""
+    cm = ConfusionMatrix(class_values, pos_class=pos_class)
+    cm.add(actual, predicted)
+    return cm.counters()
+
+
+# =================================================================== bayesian
+@job("bayesianDistr", "bad", "org.avenir.bayesian.BayesianDistribution")
+def bayesian_distribution(cfg: JobConfig, inputs: List[str], output: str) -> JobResult:
+    """NB sufficient-stats training -> CSV model file (SURVEY §3.1)."""
+    from avenir_tpu.models.naive_bayes import NaiveBayesModel
+
+    model = None
+    rows = 0
+    for path in inputs:
+        ds = _dataset(path, cfg)
+        rows += len(ds)
+        part = NaiveBayesModel.fit(ds)
+        model = part if model is None else model.merge(part)
+    out = _out_file(output)
+    model.save(out, delim=cfg.field_delim)
+    return JobResult("bayesianDistr", {"Distribution Data:Records": rows},
+                     [out], model)
+
+
+@job("bayesianPredictor", "bap", "org.avenir.bayesian.BayesianPredictor")
+def bayesian_predictor(cfg: JobConfig, inputs: List[str], output: str) -> JobResult:
+    """Map-only NB posterior prediction (SURVEY §3.2). With
+    `bap.output.feature.prob.only=true` emits per-row feature posterior
+    P(features|actual class) — the quantity the KNN class-conditional
+    pipeline joins in (BayesianPredictor.java:262-286)."""
+    from avenir_tpu.models.naive_bayes import NaiveBayesModel, NaiveBayesPredictor
+
+    schema = _schema(cfg)
+    model = NaiveBayesModel.load(cfg.assert_get("bayesian.model.file.path"),
+                                 schema, delim=cfg.field_delim)
+    pred = NaiveBayesPredictor(model)
+    prob_only = cfg.get_bool("output.feature.prob.only", False)
+    validate = cfg.get_bool("validation.mode", False)
+    delim = cfg.field_delim
+    out = _out_file(output)
+    counters: Dict[str, float] = {}
+    cls_vals = schema.class_values()
+    actual: List[np.ndarray] = []
+    predicted: List[np.ndarray] = []
+    with open(out, "w") as fh:
+        for path in inputs:
+            ds = Dataset.from_csv(path, schema, delim=cfg.field_delim_regex,
+                                  keep_raw=True)
+            if prob_only:
+                probs = pred.feature_prob(ds)
+                for rid, p in zip(ds.ids(), probs):
+                    fh.write(f"{rid}{delim}{p:.6g}\n")
+            else:
+                codes, post = pred.predict(ds)
+                for raw, c, row_post in zip(ds.raw_rows, codes, post):
+                    # row_post is the reference's int-percent-scaled
+                    # unnormalized posterior; normalize across classes for
+                    # the appended confidence field
+                    tot = float(np.sum(row_post)) or 1.0
+                    prob = int(np.rint(100.0 * row_post[int(c)] / tot))
+                    fh.write(delim.join(raw + [cls_vals[int(c)], str(prob)]) + "\n")
+                if validate:
+                    actual.append(ds.labels())
+                    predicted.append(codes)
+    if actual:
+        pos = cfg.get("positive.class.value")
+        pi = cls_vals.index(pos) if pos else 1
+        counters = _validate(cls_vals, np.concatenate(actual),
+                             np.concatenate(predicted), pi)
+    return JobResult("bayesianPredictor", counters, [out])
+
+
+# ======================================================================== knn
+@job("nearestNeighbor", "nen", "org.avenir.knn.NearestNeighbor")
+def nearest_neighbor(cfg: JobConfig, inputs: List[str], output: str) -> JobResult:
+    """Fused KNN: inputs = [train CSV, test CSV]. Replaces stages (1)-(5)
+    of resource/knn.sh — all-pairs distance, NB feature-posterior weighting
+    and the secondary-sorted top-k vote run as one device program
+    (SURVEY §3.3). Key names follow knn.properties (incl. the reference's
+    `class.condtion.weighted` spelling, NearestNeighbor.java:92)."""
+    from avenir_tpu.models.knn import NearestNeighborClassifier
+
+    train_path, test_path = inputs[0], inputs[-1]
+    schema = _schema(cfg)
+    delim = cfg.field_delim_regex
+    train = Dataset.from_csv(train_path, schema, delim=delim)
+    test = Dataset.from_csv(test_path, schema, delim=delim, keep_raw=True)
+    clf = NearestNeighborClassifier(
+        train,
+        top_match_count=cfg.get_int("top.match.count", 5),
+        kernel_function=cfg.get("kernel.function", "none"),
+        kernel_param=cfg.get_float("kernel.param", 1.0),
+        class_cond_weighted=cfg.get_bool("class.condtion.weighted", False)
+        or cfg.get_bool("class.condition.weighted", False),
+        inverse_distance_weighted=cfg.get_bool("inverse.distance.weighted", False),
+        decision_threshold=cfg.get_float("decision.threshold", -1.0),
+        positive_class=cfg.get("positive.class.value"),
+    )
+    codes, scores = clf.predict(test)
+    out = _out_file(output)
+    out_delim = cfg.field_delim
+    cls_vals = schema.class_values()
+    with_distr = cfg.get_bool("output.class.distr", False)
+    with open(out, "w") as fh:
+        for i, (rid, c) in enumerate(zip(test.ids(), codes)):
+            fields = [str(rid), cls_vals[int(c)]]
+            if with_distr:
+                tot = float(np.sum(scores[i])) or 1.0
+                fields += [f"{cls_vals[j]}:{scores[i][j] / tot:.3f}"
+                           for j in range(len(cls_vals))]
+            fh.write(out_delim.join(fields) + "\n")
+    counters: Dict[str, float] = {}
+    if cfg.get_bool("validation.mode", False):
+        counters = _validate(cls_vals, test.labels(), codes,
+                             clf.positive_class)
+    return JobResult("nearestNeighbor", counters, [out])
+
+
+# ======================================================================= tree
+def _tree_builder(cfg: JobConfig, schema: FeatureSchema):
+    from avenir_tpu.models.tree import DecisionTreeBuilder
+
+    strategy = cfg.get("path.stopping.strategy", "maxDepth")
+    return DecisionTreeBuilder(
+        schema,
+        split_algorithm=cfg.get("split.algorithm", "entropy"),
+        max_depth=cfg.get_int("max.depth.limit", 3),
+        min_info_gain=cfg.get_float("min.info.gain.limit", -1.0),
+        min_population=cfg.get_int("min.population.limit", -1),
+        stopping_strategy=strategy,
+        attr_selection_strategy=cfg.get("split.attribute.selection.strategy",
+                                        "notUsedYet"),
+    )
+
+
+@job("decTree", "dtb", "org.avenir.tree.DecisionTreeBuilder", "decisionTree")
+def decision_tree(cfg: JobConfig, inputs: List[str], output: str) -> JobResult:
+    """Decision-tree build; the reference's per-level MR iteration with
+    decPathIn/decPathOut file rotation (resource/detr.sh:34-54) runs as an
+    internal device loop, but the DecisionPathList JSON still lands at
+    `dtb.decision.file.path.out` for checkpoint parity."""
+    schema = _schema(cfg)
+    ds = _dataset(inputs[0], cfg)
+    builder = _tree_builder(cfg, schema)
+    paths = builder.fit(ds)
+    out = cfg.get("decision.file.path.out") or _out_file(output, "decPathOut.txt")
+    paths.save(out)
+    return JobResult("decTree", {"Tree:Paths": len(paths.paths)}, [out], paths)
+
+
+@job("randomForest", "dtb", "org.avenir.tree.RandomForestBuilder")
+def random_forest(cfg: JobConfig, inputs: List[str], output: str) -> JobResult:
+    from avenir_tpu.models.tree import RandomForestBuilder
+
+    schema = _schema(cfg)
+    ds = _dataset(inputs[0], cfg)
+    forest = RandomForestBuilder(
+        schema,
+        num_trees=cfg.get_int("num.trees", 10),
+        sampling=cfg.get("sub.sampling.strategy", "withReplace"),
+        sample_rate=cfg.get_float("sub.sampling.rate", 0.7),
+        split_algorithm=cfg.get("split.algorithm", "entropy"),
+        max_depth=cfg.get_int("max.depth.limit", 3),
+        stopping_strategy=cfg.get("path.stopping.strategy", "maxDepth"),
+    ).fit(ds)
+    outs = []
+    if output:
+        os.makedirs(output, exist_ok=True)
+        for t, tree in enumerate(forest.trees):
+            p = os.path.join(output, f"tree-{t:03d}.json")
+            tree.save(p)
+            outs.append(p)
+    return JobResult("randomForest", {"Tree:Trees": len(forest.trees)},
+                     outs, forest)
+
+
+# ==================================================================== explore
+@job("mutualInformation", "mut", "org.avenir.explore.MutualInformation")
+def mutual_information_job(cfg: JobConfig, inputs: List[str], output: str) -> JobResult:
+    from avenir_tpu.models.explore import MutualInformationAnalyzer
+
+    ds = _dataset(inputs[0], cfg)
+    mi = MutualInformationAnalyzer(ds)
+    algos = cfg.get_list("mutual.info.score.algorithms", [])
+    out = _out_file(output)
+    delim = cfg.field_delim
+    with open(out, "w") as fh:
+        if cfg.get_bool("output.mutual.info", True):
+            for f, fld in enumerate(mi.fields):
+                fh.write(f"featureClassMI{delim}{fld.ordinal}{delim}"
+                         f"{mi.feature_class_mi[f]:.6f}\n")
+        for algo in algos:
+            scores = mi.score(algo,
+                              cfg.get_float("redundancy.factor", 1.0))
+            for ordinal, s in scores:
+                fh.write(f"{algo}{delim}{ordinal}{delim}{s:.6f}\n")
+    return JobResult("mutualInformation",
+                     {"Basic:Records": len(ds)}, [out], mi)
+
+
+@job("ruleEvaluator", "rue", "org.avenir.explore.RuleEvaluator")
+def rule_evaluator(cfg: JobConfig, inputs: List[str], output: str) -> JobResult:
+    """rue.rule.<name> definitions `cond1 & cond2 => cons` evaluated for
+    support/confidence (RuleEvaluator.java:48)."""
+    from avenir_tpu.models.explore import Rule
+
+    ds = _dataset(inputs[0], cfg)
+    names = cfg.assert_list("rule.names")
+    out = _out_file(output)
+    delim = cfg.field_delim
+    results = {}
+    with open(out, "w") as fh:
+        for name in names:
+            expr = cfg.assert_get(f"rule.{name}")
+            cond_part, cons_part = expr.split("=>")
+            cond_delim = cfg.get("cond.delim", "&")
+            rule = Rule(
+                [c.strip() for c in cond_part.split(cond_delim) if c.strip()],
+                [c.strip() for c in cons_part.split(cond_delim) if c.strip()],
+            )
+            res = rule.evaluate(ds)
+            results[name] = res
+            fh.write(f"{name}{delim}{res['support']:.6f}{delim}"
+                     f"{res['confidence']:.6f}\n")
+    return JobResult("ruleEvaluator", {}, [out], results)
+
+
+# ================================================================ association
+@job("frequentItemsApriori", "fia",
+     "org.avenir.association.FrequentItemsApriori", "apriori")
+def apriori_job(cfg: JobConfig, inputs: List[str], output: str) -> JobResult:
+    """All k-rounds internal; per-k itemset files written like the
+    reference's per-round outputs (FrequentItemsApriori.java:123-126)."""
+    from avenir_tpu.models.association import FrequentItemsApriori, TransactionSet
+
+    delim = cfg.field_delim_regex
+    skip = cfg.get_int("skip.field.count", 1)
+    rows = [[t.strip() for t in ln.split(delim)]
+            for path in inputs for ln in _read_lines(path)]
+    tset = TransactionSet.from_rows(
+        rows, trans_id_ord=cfg.get_int("tans.id.ord", 0),
+        skip_field_count=skip,
+        marker=cfg.get("infreq.item.marker"))
+    miner = FrequentItemsApriori(
+        support_threshold=cfg.assert_float("support.threshold"),
+        max_length=cfg.get_int("item.set.length", 3),
+    )
+    levels = miner.mine(tset)
+    outs = []
+    os.makedirs(output or ".", exist_ok=True)
+    for k, isl in enumerate(levels, start=1):
+        p = os.path.join(output, f"itemsets-{k}.txt")
+        isl.save(p, delim=cfg.field_delim)
+        outs.append(p)
+    return JobResult("frequentItemsApriori",
+                     {"Apriori:MaxLength": len(levels)}, outs, levels)
+
+
+@job("associationRuleMiner", "arm",
+     "org.avenir.association.AssociationRuleMiner")
+def rule_miner_job(cfg: JobConfig, inputs: List[str], output: str) -> JobResult:
+    from avenir_tpu.models.association import AssociationRuleMiner, ItemSetList
+
+    miner = AssociationRuleMiner(
+        conf_threshold=cfg.assert_float("conf.threshold"),
+        max_ante_size=cfg.get_int("max.ante.size", 3),
+    )
+    levels = []
+    for k, path in enumerate(inputs, start=1):
+        levels.append(ItemSetList.load(path, k, delim=cfg.field_delim))
+    rules = miner.mine(levels)
+    out = _out_file(output)
+    delim = cfg.field_delim
+    with open(out, "w") as fh:
+        for r in rules:
+            fh.write(f"{':'.join(r.antecedent)}{delim}{':'.join(r.consequent)}"
+                     f"{delim}{r.confidence:.6f}{delim}{r.support:.6f}\n")
+    return JobResult("associationRuleMiner", {"Rules:Count": len(rules)},
+                     [out], rules)
+
+
+# ===================================================================== markov
+@job("markovStateTransitionModel", "mst",
+     "org.avenir.markov.MarkovStateTransitionModel")
+def markov_model_job(cfg: JobConfig, inputs: List[str], output: str) -> JobResult:
+    from avenir_tpu.models.markov import MarkovStateTransitionModel
+
+    states = cfg.assert_list("model.states")
+    class_ord = cfg.get_int("class.label.field.ord")
+    skip = cfg.get_int("skip.field.count", 1)
+    class_labels = cfg.get_list("class.labels")
+    model = MarkovStateTransitionModel(
+        states, scale=cfg.get_int("trans.prob.scale", 1000),
+        class_labels=class_labels,
+    )
+    for path in inputs:
+        _, seqs, labels = _read_sequences(path, cfg.field_delim_regex,
+                                          skip, class_ord)
+        model.fit(seqs, labels if class_labels else None)
+    out = _out_file(output)
+    model.save(out, delim=cfg.field_delim)
+    return JobResult("markovStateTransitionModel", {}, [out], model)
+
+
+@job("markovModelClassifier", "mmc",
+     "org.avenir.markov.MarkovModelClassifier")
+def markov_classifier_job(cfg: JobConfig, inputs: List[str], output: str) -> JobResult:
+    from avenir_tpu.models.markov import (MarkovModelClassifier,
+                                          MarkovStateTransitionModel)
+
+    model = MarkovStateTransitionModel.load(
+        cfg.assert_get("mm.model.path"), delim=cfg.field_delim)
+    pos, neg = cfg.assert_list("class.labels")
+    clf = MarkovModelClassifier(
+        model, pos, neg,
+        threshold=cfg.get_float("log.odds.threshold", 0.0))
+    skip = cfg.get_int("skip.field.count", 1)
+    class_ord = cfg.get_int("class.label.field.ord") \
+        if cfg.get_bool("validation.mode", False) else None
+    out = _out_file(output)
+    delim = cfg.field_delim
+    counters: Dict[str, float] = {}
+    actual, predicted = [], []
+    with open(out, "w") as fh:
+        for path in inputs:
+            ids, seqs, labels = _read_sequences(path, cfg.field_delim_regex,
+                                                skip, class_ord)
+            cls, scores = clf.predict(seqs)
+            for rid, c, s in zip(ids, cls, scores):
+                fh.write(f"{rid}{delim}{c}{delim}{s:.6f}\n")
+            if class_ord is not None:
+                actual += labels
+                predicted += list(cls)
+    if actual:
+        lab = [pos, neg]
+        counters = _validate(
+            lab, np.array([lab.index(a) for a in actual]),
+            np.array([lab.index(p) for p in predicted]), 0)
+    return JobResult("markovModelClassifier", counters, [out])
+
+
+@job("hiddenMarkovModelBuilder", "hmmb",
+     "org.avenir.markov.HiddenMarkovModelBuilder")
+def hmm_builder_job(cfg: JobConfig, inputs: List[str], output: str) -> JobResult:
+    """Fully-tagged input: `obs<sub.field.delim>state` tokens after the skip
+    fields (HiddenMarkovModelBuilder.java:136-153)."""
+    from avenir_tpu.models.markov import HiddenMarkovModelBuilder
+
+    states = cfg.assert_list("model.states")
+    obs = cfg.assert_list("model.observations")
+    sub = cfg.get("sub.field.delim", ":")
+    skip = cfg.get_int("skip.field.count", 1)
+    builder = HiddenMarkovModelBuilder(states, obs)
+    state_seqs, obs_seqs = [], []
+    for path in inputs:
+        _, seqs, _ = _read_sequences(path, cfg.field_delim_regex, skip)
+        for seq in seqs:
+            pairs = [tok.split(sub) for tok in seq]
+            obs_seqs.append([p[0] for p in pairs])
+            state_seqs.append([p[1] for p in pairs])
+    hmm = builder.fit(state_seqs, obs_seqs)
+    out = _out_file(output)
+    hmm.save(out, delim=cfg.field_delim)
+    return JobResult("hiddenMarkovModelBuilder", {}, [out], hmm)
+
+
+@job("viterbiStatePredictor", "vsp",
+     "org.avenir.markov.ViterbiStatePredictor")
+def viterbi_job(cfg: JobConfig, inputs: List[str], output: str) -> JobResult:
+    from avenir_tpu.models.markov import HiddenMarkovModel, ViterbiDecoder
+
+    hmm = HiddenMarkovModel.load(cfg.assert_get("hmm.model.path"),
+                                 delim=cfg.field_delim)
+    decoder = ViterbiDecoder(hmm)
+    skip = 1 if cfg.get_int("id.field.ordinal", 0) >= 0 else 0
+    out = _out_file(output)
+    delim = cfg.field_delim
+    with open(out, "w") as fh:
+        for path in inputs:
+            ids, seqs, _ = _read_sequences(path, cfg.field_delim_regex, skip)
+            decoded = decoder.decode(seqs)
+            for rid, states in zip(ids, decoded):
+                fh.write(delim.join([rid] + list(states)) + "\n")
+    return JobResult("viterbiStatePredictor", {}, [out])
+
+
+@job("probabilisticSuffixTree", "pstg",
+     "org.avenir.markov.ProbabilisticSuffixTreeGenerator")
+def pst_job(cfg: JobConfig, inputs: List[str], output: str) -> JobResult:
+    from avenir_tpu.models.markov import ProbabilisticSuffixTree
+
+    skip = cfg.get_int("skip.field.count", 1)
+    seqs = []
+    for path in inputs:
+        _, ss, _ = _read_sequences(path, cfg.field_delim_regex, skip)
+        seqs += ss
+    symbols = sorted({s for seq in seqs for s in seq})
+    pst = ProbabilisticSuffixTree(
+        symbols, max_depth=cfg.get_int("max.seq.length", 3)).fit(seqs)
+    out = _out_file(output)
+    delim = cfg.field_delim
+    with open(out, "w") as fh:
+        for ctx in sorted(pst.counts):
+            counts = pst.counts[ctx]
+            total = float(counts.sum()) or 1.0
+            for si, sym in enumerate(pst.symbols):
+                if counts[si] > 0:
+                    fh.write(f"{''.join(ctx) or '$'}{delim}{sym}{delim}"
+                             f"{counts[si] / total:.6f}\n")
+    return JobResult("probabilisticSuffixTree", {}, [out], pst)
+
+
+# ============================================================ regress / discr
+@job("logisticRegression", "lrj",
+     "org.avenir.regress.LogisticRegressionJob")
+def logistic_regression_job(cfg: JobConfig, inputs: List[str], output: str) -> JobResult:
+    """In-process epochs replace the driver loop of SURVEY §3.6; the
+    coefficient history still appends to `coeff.file.path` and the result
+    counters carry the reference's CONVERGED(100)/NOT_CONVERGED(101) exit
+    status (LogisticRegressionJob.java:95-119)."""
+    from avenir_tpu.models.regress import LogisticRegression
+
+    ds = _dataset(inputs[0], cfg)
+    lr = LogisticRegression(
+        iteration_limit=cfg.get_int("iteration.limit", 10),
+        convergence_criteria=cfg.get("convergence.criteria", "iterLimit"),
+        convergence_threshold=cfg.get_float("convergence.threshold", 5.0),
+        pos_class=cfg.get("positive.class.value"),
+    ).fit(ds)
+    coeff_path = cfg.get("coeff.file.path") or _out_file(output, "coeff.txt")
+    lr.save_coeff_history(coeff_path, delim=cfg.field_delim)
+    return JobResult(
+        "logisticRegression",
+        {"Regression:ExitStatus": lr.check_convergence()}, [coeff_path], lr)
+
+
+@job("fisherDiscriminant", "fid",
+     "org.avenir.discriminant.FisherDiscriminant")
+def fisher_job(cfg: JobConfig, inputs: List[str], output: str) -> JobResult:
+    from avenir_tpu.models.discriminant import FisherDiscriminant
+
+    ds = _dataset(inputs[0], cfg)
+    fd = FisherDiscriminant().fit(ds)
+    out = _out_file(output)
+    fd.save(out, delim=cfg.field_delim)
+    return JobResult("fisherDiscriminant", {}, [out], fd)
+
+
+# ======================================================================= text
+@job("wordCounter", "wco", "org.avenir.text.WordCounter")
+def word_counter_job(cfg: JobConfig, inputs: List[str], output: str) -> JobResult:
+    from avenir_tpu.models.text import WordCounter
+
+    wc = WordCounter(
+        text_field_ordinal=cfg.get_int("text.field.ordinal", -1),
+        delim=cfg.field_delim_regex,
+    )
+    counts: Dict[str, int] = {}
+    for path in inputs:
+        for word, c in wc.count(_read_lines(path)):
+            counts[word] = counts.get(word, 0) + c
+    out = _out_file(output)
+    delim = cfg.field_delim
+    with open(out, "w") as fh:
+        for word in sorted(counts):
+            fh.write(f"{word}{delim}{counts[word]}\n")
+    return JobResult("wordCounter", {"Words:Unique": len(counts)}, [out])
+
+
+# ==================================================================== bandits
+@job("greedyRandomBandit", "grb", "org.avenir.reinforce.GreedyRandomBandit")
+@job("auerDeterministic", "aue", "org.avenir.reinforce.AuerDeterministic")
+@job("randomFirstGreedyBandit", "rfg",
+     "org.avenir.reinforce.RandomFirstGreedyBandit")
+@job("softMaxBandit", "smb", "org.avenir.reinforce.SoftMaxBandit")
+def bandit_job(cfg: JobConfig, inputs: List[str], output: str) -> JobResult:
+    """One decision round of a batch bandit: input = group item stats rows
+    `group,item,count,reward` (chombo RunningAggregator output the tutorial
+    loops back, resource/price_optimize_tutorial.txt:55-82); output = the
+    selected items per group for the round."""
+    from avenir_tpu.models.bandits import GroupBanditData, make_bandit_job
+
+    # job name = the registry key the caller used (one impl serves all four)
+    name = cfg.props.get("__job_name__", "greedyRandomBandit")
+    batch = cfg.get_int("global.batch.size", 1)
+    kw = {}
+    if name == "greedyRandomBandit":
+        kw = {
+            "random_selection_prob": cfg.get_float("random.selection.prob", 0.1),
+            "prob_reduction_algorithm": cfg.get("prob.reduction.algorithm",
+                                                "linear"),
+            "prob_reduction_constant": cfg.get_float("prob.reduction.constant",
+                                                     1.0),
+            "auer_greedy_constant": cfg.get_float("auer.greedy.constant", 1.0),
+            "selection_unique": cfg.get_bool("selection.unique", False),
+        }
+    elif name == "softMaxBandit":
+        kw = {"temp_constant": cfg.get_float("temp.constant", 1.0)}
+    round_num = cfg.get_int("current.round.num", 1)
+    data = GroupBanditData.from_rows(
+        [[t.strip() for t in ln.split(cfg.field_delim_regex)]
+         for p in inputs for ln in _read_lines(p)],
+        count_ord=cfg.get_int("count.ordinal", 2),
+        reward_ord=cfg.get_int("reward.ordinal", 3),
+    )
+    bj = make_bandit_job(name, batch, **kw)
+    sel = bj.select(data, round_num)
+    rows = data.selections_to_rows(
+        sel, output_decision_count=cfg.get_bool("output.decision.count", False))
+    out = _out_file(output)
+    delim = cfg.field_delim
+    with open(out, "w") as fh:
+        for row in rows:
+            fh.write(delim.join(row) + "\n")
+    return JobResult(name, {"Bandit:Groups": len(data.group_ids)}, [out], sel)
+
+
+# =================================================================== pipeline
+@dataclass
+class Stage:
+    name: str
+    job: str
+    inputs: List[str]
+    output: str
+    conf_overrides: Dict[str, str] = field(default_factory=dict)
+
+
+class Pipeline:
+    """Replaces the resource/*.sh case-statement drivers: ordered named
+    stages over one shared properties file; stage outputs feed later stage
+    inputs by path (e.g. the knn.sh 5-stage flow, SURVEY §3.3). Run all
+    stages or a single named one — the same way the shell scripts were
+    invoked per-stage by hand."""
+
+    def __init__(self, conf, stages: Sequence[Stage]):
+        self.props = (load_properties(conf) if isinstance(conf, str)
+                      else dict(conf))
+        self.stages = list(stages)
+        self.results: Dict[str, JobResult] = {}
+
+    def run(self, only: Optional[str] = None) -> Dict[str, JobResult]:
+        for st in self.stages:
+            if only is not None and st.name != only:
+                continue
+            props = dict(self.props)
+            props.update(st.conf_overrides)
+            self.results[st.name] = run_job(st.job, props, st.inputs,
+                                            st.output)
+        return self.results
+
+
+def run_from_cli(argv: Sequence[str]) -> JobResult:
+    """`python -m avenir_tpu <jobName> --conf <props> IN... OUT` — the
+    `hadoop jar avenir.jar <class> -Dconf.path=<props> IN OUT` surface."""
+    import argparse
+
+    ap = argparse.ArgumentParser(prog="avenir_tpu")
+    ap.add_argument("jobname", help="job name or reference Tool class")
+    ap.add_argument("--conf", required=False, default=None,
+                    help="properties file (the -Dconf.path analog)")
+    ap.add_argument("paths", nargs="*", help="input paths... output path")
+    args = ap.parse_args(argv)
+    props = load_properties(args.conf) if args.conf else {}
+    short = args.jobname.rsplit(".", 1)[-1]
+    name = args.jobname if args.jobname in _REGISTRY else short[0].lower() + short[1:]
+    inputs, output = args.paths[:-1], (args.paths[-1] if args.paths else "")
+    res = run_job(name, props, inputs, output)
+    print(json.dumps({"job": res.name, "counters": res.counters,
+                      "outputs": res.outputs}))
+    return res

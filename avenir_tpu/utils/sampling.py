@@ -80,7 +80,7 @@ class GaussianSampler:
         default_factory=lambda: np.random.default_rng())
 
     def sample(self, n: Optional[int] = None):
-        shape = (n,) if n is not None else (64,)
+        shape = (n,) if n is not None else (1,)
         lo, hi = self.mean - 3 * self.std_dev, self.mean + 3 * self.std_dev
         out = self.rng.normal(self.mean, self.std_dev, shape)
         bad = (out < lo) | (out > hi)

@@ -1,0 +1,378 @@
+"""Job runner / pipeline / CLI surface tests (SURVEY §2.11 driver layer)."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from avenir_tpu.core.dataset import Dataset
+from avenir_tpu.data import generate_churn, churn_schema, generate_elearn, elearn_schema
+from avenir_tpu.runner import Pipeline, Stage, job_names, run_from_cli, run_job
+
+
+def ds_to_csv(ds: Dataset) -> str:
+    """Render a Dataset back to reference-style CSV text."""
+    lines = []
+    for i in range(len(ds)):
+        toks = []
+        for fld in ds.schema.fields:
+            col = ds.column(fld.ordinal)
+            if fld.is_categorical:
+                toks.append(fld.decode_value(int(col[i])))
+            elif fld.is_numeric:
+                v = float(col[i])
+                toks.append(str(int(v)) if v == int(v) else f"{v:.4f}")
+            else:
+                toks.append(str(col[i]))
+        lines.append(",".join(toks))
+    return "\n".join(lines) + "\n"
+
+
+@pytest.fixture(scope="module")
+def churn_files(tmp_path_factory):
+    d = tmp_path_factory.mktemp("churn")
+    schema_path = str(d / "churn.json")
+    churn_schema().save(schema_path)
+    train = str(d / "train.csv")
+    test = str(d / "test.csv")
+    with open(train, "w") as fh:
+        fh.write(generate_churn(800, seed=3, as_csv=True))
+    with open(test, "w") as fh:
+        fh.write(generate_churn(200, seed=4, as_csv=True))
+    return {"dir": str(d), "schema": schema_path, "train": train, "test": test}
+
+
+@pytest.fixture(scope="module")
+def elearn_files(tmp_path_factory):
+    d = tmp_path_factory.mktemp("elearn")
+    schema_path = str(d / "elearn.json")
+    elearn_schema().save(schema_path)
+    train = str(d / "train.csv")
+    test = str(d / "test.csv")
+    with open(train, "w") as fh:
+        fh.write(ds_to_csv(generate_elearn(400, seed=5)))
+    with open(test, "w") as fh:
+        fh.write(ds_to_csv(generate_elearn(100, seed=6)))
+    return {"dir": str(d), "schema": schema_path, "train": train, "test": test}
+
+
+def test_job_registry_has_reference_names():
+    names = job_names()
+    for expected in [
+        "bayesianDistr", "bayesianPredictor", "nearestNeighbor", "decTree",
+        "randomForest", "mutualInformation", "frequentItemsApriori",
+        "associationRuleMiner", "markovStateTransitionModel",
+        "markovModelClassifier", "hiddenMarkovModelBuilder",
+        "viterbiStatePredictor", "probabilisticSuffixTree",
+        "logisticRegression", "fisherDiscriminant", "greedyRandomBandit",
+        "ruleEvaluator", "wordCounter",
+        # reference Tool class names resolve too
+        "org.avenir.bayesian.BayesianDistribution",
+        "org.avenir.knn.NearestNeighbor",
+    ]:
+        assert expected in names, expected
+
+
+def test_nb_train_predict_jobs(churn_files, tmp_path):
+    model_out = str(tmp_path / "distr") + os.sep
+    props = {"bad.feature.schema.file.path": churn_files["schema"]}
+    res = run_job("bayesianDistr", props, [churn_files["train"]], model_out)
+    assert res.counters["Distribution Data:Records"] == 800
+    model_file = res.outputs[0]
+    assert os.path.basename(model_file) == "part-r-00000"
+
+    pred_out = str(tmp_path / "pred.txt")
+    props = {
+        "bap.feature.schema.file.path": churn_files["schema"],
+        "bap.bayesian.model.file.path": model_file,
+        "bap.validation.mode": "true",
+        "bap.positive.class.value": "closed",
+    }
+    res = run_job("bayesianPredictor", props, [churn_files["test"]], pred_out)
+    assert res.counters["Validation:Accuracy"] > 70
+    lines = open(pred_out).read().splitlines()
+    assert len(lines) == 200
+    # appended fields: predicted class value + int percent prob
+    toks = lines[0].split(",")
+    assert toks[-2] in ("open", "closed")
+    assert 0 <= int(toks[-1]) <= 100
+
+
+def test_nb_feature_prob_only_mode(churn_files, tmp_path):
+    model_out = str(tmp_path / "model.csv")
+    props = {"bad.feature.schema.file.path": churn_files["schema"]}
+    run_job("bayesianDistr", props, [churn_files["train"]], model_out)
+    out = str(tmp_path / "pprob.txt")
+    props = {
+        "bap.feature.schema.file.path": churn_files["schema"],
+        "bap.bayesian.model.file.path": model_out,
+        "bap.output.feature.prob.only": "true",
+    }
+    run_job("bayesianPredictor", props, [churn_files["test"]], out)
+    lines = open(out).read().splitlines()
+    assert len(lines) == 200
+    probs = [float(ln.split(",")[1]) for ln in lines]
+    assert all(0.0 <= p <= 1.0 for p in probs)
+
+
+def test_knn_job_validates(elearn_files, tmp_path):
+    out = str(tmp_path / "knn.txt")
+    props = {
+        "nen.feature.schema.file.path": elearn_files["schema"],
+        "nen.top.match.count": "5",
+        "nen.kernel.function": "none",
+        "nen.validation.mode": "true",
+        "nen.output.class.distr": "true",
+        "nen.class.condtion.weighted": "false",
+    }
+    res = run_job("nearestNeighbor", props,
+                  [elearn_files["train"], elearn_files["test"]], out)
+    assert res.counters["Validation:Accuracy"] > 60
+    line = open(out).read().splitlines()[0].split(",")
+    assert len(line) >= 3  # id, class, class distr fields
+
+
+def test_tree_jobs(churn_files, tmp_path):
+    from avenir_tpu.models.tree import DecisionPathList
+
+    dec_out = str(tmp_path / "decPathOut.txt")
+    props = {
+        "dtb.feature.schema.file.path": churn_files["schema"],
+        "dtb.decision.file.path.out": dec_out,
+        "dtb.split.algorithm": "giniIndex",
+        "dtb.max.depth.limit": "2",
+    }
+    res = run_job("decTree", props, [churn_files["train"]], "")
+    assert os.path.exists(dec_out)
+    loaded = DecisionPathList.load(dec_out)
+    assert len(loaded.paths) == res.counters["Tree:Paths"] > 1
+
+    rf_dir = str(tmp_path / "forest")
+    props = {
+        "dtb.feature.schema.file.path": churn_files["schema"],
+        "dtb.num.trees": "3",
+        "dtb.max.depth.limit": "2",
+    }
+    res = run_job("randomForest", props, [churn_files["train"]], rf_dir)
+    assert len(res.outputs) == 3
+    assert all(os.path.exists(p) for p in res.outputs)
+
+
+def test_mutual_information_job(churn_files, tmp_path):
+    out = str(tmp_path / "mi.txt")
+    props = {
+        "mut.feature.schema.file.path": churn_files["schema"],
+        "mut.mutual.info.score.algorithms":
+            "mutual.info.maximization,min.redundancy.max.relevance",
+    }
+    run_job("mutualInformation", props, [churn_files["train"]], out)
+    lines = open(out).read().splitlines()
+    kinds = {ln.split(",")[0] for ln in lines}
+    assert "featureClassMI" in kinds
+    assert "min.redundancy.max.relevance" in kinds
+
+
+def test_rule_evaluator_job(churn_files, tmp_path):
+    out = str(tmp_path / "rules.txt")
+    props = {
+        "rue.feature.schema.file.path": churn_files["schema"],
+        "rue.rule.names": "r1",
+        "rue.rule.r1": "3 eq high => 6 eq closed",
+    }
+    res = run_job("ruleEvaluator", props, [churn_files["train"]], out)
+    r1 = res.payload["r1"]
+    assert 0.0 <= r1["support"] <= 1.0
+    assert 0.0 <= r1["confidence"] <= 1.0
+
+
+def test_apriori_and_rule_miner_jobs(tmp_path):
+    rng = np.random.default_rng(0)
+    trans_path = str(tmp_path / "trans.csv")
+    with open(trans_path, "w") as fh:
+        for i in range(120):
+            items = {"milk"} if rng.random() < 0.8 else set()
+            if "milk" in items and rng.random() < 0.75:
+                items.add("bread")
+            if rng.random() < 0.3:
+                items.add("beer")
+            if items:
+                fh.write(f"T{i}," + ",".join(sorted(items)) + "\n")
+    iset_dir = str(tmp_path / "itemsets")
+    props = {"fia.support.threshold": "0.2", "fia.item.set.length": "2",
+             "fia.skip.field.count": "1"}
+    res = run_job("frequentItemsApriori", props, [trans_path], iset_dir)
+    assert len(res.outputs) >= 2
+
+    rules_out = str(tmp_path / "rules.txt")
+    props = {"arm.conf.threshold": "0.5"}
+    res = run_job("associationRuleMiner", props, res.outputs, rules_out)
+    pairs = {(r.antecedent, r.consequent) for r in res.payload}
+    assert (("milk",), ("bread",)) in pairs
+
+
+def test_markov_jobs(tmp_path):
+    rng = np.random.default_rng(1)
+    states = ["L", "M", "H"]
+    # class T walks upward, class F walks downward
+    def walk(up: bool, n: int):
+        s, out = 1, []
+        for _ in range(n):
+            p = [0.1, 0.3, 0.6] if up else [0.6, 0.3, 0.1]
+            s = int(np.clip(s + rng.choice([-1, 0, 1], p=[p[0], p[1], p[2]]), 0, 2))
+            out.append(states[s])
+        return out
+
+    data_path = str(tmp_path / "seq.csv")
+    with open(data_path, "w") as fh:
+        for i in range(160):
+            up = i % 2 == 0
+            fh.write(f"c{i},{'T' if up else 'F'}," + ",".join(walk(up, 12)) + "\n")
+
+    model_out = str(tmp_path / "mst.txt")
+    props = {
+        "mst.model.states": "L,M,H",
+        "mst.class.label.field.ord": "1",
+        "mst.skip.field.count": "2",
+        "mst.class.labels": "T,F",
+    }
+    run_job("markovStateTransitionModel", props, [data_path], model_out)
+    assert os.path.exists(model_out)
+
+    cls_out = str(tmp_path / "mmc.txt")
+    props = {
+        "mmc.mm.model.path": model_out,
+        "mmc.class.labels": "T,F",
+        "mmc.skip.field.count": "2",
+        "mmc.class.label.field.ord": "1",
+        "mmc.validation.mode": "true",
+    }
+    res = run_job("markovModelClassifier", props, [data_path], cls_out)
+    assert res.counters["Validation:Accuracy"] > 80
+
+
+def test_hmm_and_viterbi_jobs(tmp_path):
+    rng = np.random.default_rng(2)
+    states, obs = ["A", "B"], ["x", "y"]
+    tagged_path = str(tmp_path / "tagged.csv")
+    with open(tagged_path, "w") as fh:
+        for i in range(100):
+            s = rng.integers(0, 2)
+            toks = []
+            for _ in range(10):
+                s = s if rng.random() < 0.8 else 1 - s
+                o = s if rng.random() < 0.9 else 1 - s
+                toks.append(f"{obs[o]}:{states[s]}")
+            fh.write(f"e{i}," + ",".join(toks) + "\n")
+
+    hmm_out = str(tmp_path / "hmm.txt")
+    props = {
+        "hmmb.model.states": "A,B",
+        "hmmb.model.observations": "x,y",
+        "hmmb.skip.field.count": "1",
+    }
+    run_job("hiddenMarkovModelBuilder", props, [tagged_path], hmm_out)
+
+    # untagged observation sequences for decoding
+    obs_path = str(tmp_path / "obs.csv")
+    with open(obs_path, "w") as fh:
+        fh.write("q0," + ",".join(["x"] * 6) + "\n")
+        fh.write("q1," + ",".join(["y"] * 6) + "\n")
+    vit_out = str(tmp_path / "vit.txt")
+    props = {"vsp.hmm.model.path": hmm_out, "vsp.id.field.ordinal": "0"}
+    run_job("viterbiStatePredictor", props, [obs_path], vit_out)
+    lines = open(vit_out).read().splitlines()
+    assert lines[0].split(",")[1:] == ["A"] * 6
+    assert lines[1].split(",")[1:] == ["B"] * 6
+
+
+def test_pst_job(tmp_path):
+    seq_path = str(tmp_path / "pst.csv")
+    with open(seq_path, "w") as fh:
+        for i in range(30):
+            fh.write(f"s{i},a,b,a,b,a,b\n")
+    out = str(tmp_path / "pst.txt")
+    props = {"pstg.skip.field.count": "1", "pstg.max.seq.length": "2"}
+    res = run_job("probabilisticSuffixTree", props, [seq_path], out)
+    # after context 'a' the next symbol is always 'b'
+    assert abs(res.payload.cond_prob(["a"], "b") - 1.0) < 1e-6
+
+
+def test_lr_and_fisher_jobs(elearn_files, tmp_path):
+    coeff = str(tmp_path / "coeff.txt")
+    props = {
+        "lrj.feature.schema.file.path": elearn_files["schema"],
+        "lrj.coeff.file.path": coeff,
+        "lrj.iteration.limit": "8",
+    }
+    res = run_job("logisticRegression", props, [elearn_files["train"]], "")
+    assert res.counters["Regression:ExitStatus"] in (100, 101)
+    assert len(open(coeff).read().splitlines()) >= 2
+
+    fd_out = str(tmp_path / "fisher.txt")
+    props = {"fid.feature.schema.file.path": elearn_files["schema"]}
+    run_job("fisherDiscriminant", props, [elearn_files["train"]], fd_out)
+    assert os.path.exists(fd_out)
+
+
+def test_bandit_job(tmp_path):
+    stats_path = str(tmp_path / "stats.csv")
+    with open(stats_path, "w") as fh:
+        for g in ["g1", "g2"]:
+            fh.write(f"{g},itemA,10,5.0\n{g},itemB,10,1.0\n")
+    out = str(tmp_path / "select.txt")
+    props = {
+        "grb.global.batch.size": "2",
+        "grb.current.round.num": "50",
+        "grb.random.selection.prob": "0.0",
+    }
+    res = run_job("greedyRandomBandit", props, [stats_path], out)
+    lines = open(out).read().splitlines()
+    assert len(lines) == 4
+    # with no exploration the greedy pick is the high-reward item
+    assert all(ln.split(",")[1] == "itemA" for ln in lines)
+
+
+def test_word_counter_job(tmp_path):
+    p = str(tmp_path / "text.csv")
+    with open(p, "w") as fh:
+        fh.write("d1,the quick brown fox jumps\n")
+        fh.write("d2,the lazy dog sleeps\n")
+    out = str(tmp_path / "wc.txt")
+    res = run_job("wordCounter", {"wco.text.field.ordinal": "1"}, [p], out)
+    counts = dict(ln.split(",") for ln in open(out).read().splitlines())
+    assert counts["quick"] == "1"
+    assert res.counters["Words:Unique"] > 4
+
+
+def test_pipeline_knn_stages(churn_files, tmp_path):
+    """The knn.sh multi-stage flow as a Pipeline: NB distr -> predictor."""
+    model_out = str(tmp_path / "distr.csv")
+    pred_out = str(tmp_path / "pred.txt")
+    props = {
+        "bad.feature.schema.file.path": churn_files["schema"],
+        "bap.feature.schema.file.path": churn_files["schema"],
+        "bap.bayesian.model.file.path": model_out,
+        "bap.validation.mode": "true",
+        "bap.positive.class.value": "closed",
+    }
+    pipe = Pipeline(props, [
+        Stage("bayesianDistr", "bayesianDistr", [churn_files["train"]], model_out),
+        Stage("bayesianPred", "bayesianPredictor", [churn_files["test"]], pred_out),
+    ])
+    results = pipe.run()
+    assert results["bayesianPred"].counters["Validation:Accuracy"] > 70
+
+
+def test_cli_surface(churn_files, tmp_path, capsys):
+    out = str(tmp_path / "model.csv")
+    conf = str(tmp_path / "cli.properties")
+    with open(conf, "w") as fh:
+        fh.write(f"bad.feature.schema.file.path={churn_files['schema']}\n")
+    res = run_from_cli([
+        "org.avenir.bayesian.BayesianDistribution", "--conf", conf,
+        churn_files["train"], out,
+    ])
+    assert os.path.exists(out)
+    printed = json.loads(capsys.readouterr().out)
+    assert printed["job"] == "bayesianDistr"
