@@ -185,6 +185,26 @@ class Dataset:
         return [f for f in self.schema.feature_fields if f.num_bins() > 0]
 
     # ------------------------------------------------------------- utilities
+    def to_csv(self, delim: str = ",") -> str:
+        """Render rows back to reference-style CSV text (categorical codes
+        decoded to their cardinality values). Uses raw rows when kept."""
+        if self.raw_rows is not None:
+            return "\n".join(delim.join(r) for r in self.raw_rows) + "\n"
+        lines = []
+        for i in range(self.n_rows):
+            toks = []
+            for fld in self.schema.fields:
+                col = self.columns[fld.ordinal]
+                if fld.is_categorical:
+                    toks.append(fld.decode_value(int(col[i])))
+                elif fld.is_numeric:
+                    v = float(col[i])
+                    toks.append(str(int(v)) if v == int(v) else f"{v:.6g}")
+                else:
+                    toks.append(str(col[i]))
+            lines.append(delim.join(toks))
+        return "\n".join(lines) + "\n"
+
     def take(self, idx: np.ndarray) -> "Dataset":
         """Row subset (numpy fancy index) — used by samplers and CV splits."""
         cols = {o: c[idx] for o, c in self.columns.items()}

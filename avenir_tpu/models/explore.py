@@ -22,7 +22,9 @@ import jax.numpy as jnp
 
 from avenir_tpu.core.dataset import Dataset
 from avenir_tpu.core.schema import FeatureField
-from avenir_tpu.ops.infotheory import bits_entropy, entropy, gini, mutual_information
+from avenir_tpu.ops.infotheory import (bits_entropy, entropy, gini,
+                                       mutual_information,
+                                       weighted_split_score)
 from avenir_tpu.ops.reduce import cross_count
 
 _EPS = 1e-12
@@ -178,6 +180,82 @@ class MutualInformationAnalyzer:
             "double.input.symmetric.relevance": self.disr,
             "min.redundancy.max.relevance": self.mrmr,
         }[algorithm]()
+
+
+# ---------------------------------------------------------------------------
+# candidate-split class partition stats
+# ---------------------------------------------------------------------------
+class ClassPartitionGenerator:
+    """Candidate-split class-histogram stats — the older two-job tree flow's
+    first stage (explore/ClassPartitionGenerator.java:61, cpg.* keys).
+
+    For every candidate split of the requested attributes, one device
+    segment_sum produces the [segment, class] histogram; the split stat is
+    computed per cpg.split.algorithm: `entropy` / `giniIndex` (weighted
+    child info content, lower = better) or `hellingerDistance`
+    (AttributeSplitStat.java:228-283, higher = better, binary class only).
+    """
+
+    def __init__(self, ds: Dataset, attributes: Optional[Sequence[int]] = None,
+                 algorithm: str = "giniIndex", cat_partition_cap: int = 128):
+        from avenir_tpu.models.tree import enumerate_splits
+
+        self.ds = ds
+        self.algorithm = algorithm
+        splits = enumerate_splits(ds.schema, cat_partition_cap)
+        if attributes is not None:
+            attrs = set(attributes)
+            splits = [s for s in splits if s.attribute in attrs]
+        self.splits = splits
+        self.k = ds.schema.num_classes()
+        self.histograms = self._histograms()
+
+    def _histograms(self) -> List[np.ndarray]:
+        """Per split: [n_segments, k] class counts, all splits in one
+        device reduction."""
+        import jax.ops
+
+        labels = jnp.asarray(self.ds.labels())
+        out = []
+        if not self.splits:
+            return out
+        smax = max(s.n_segments for s in self.splits)
+        seg = np.stack(
+            [s.segment_of(np.asarray(self.ds.column(s.attribute)))
+             for s in self.splits], axis=1,
+        ).astype(np.int32)                                   # [n, NS]
+        key = (jnp.asarray(seg) * self.k + labels[:, None]
+               + (jnp.arange(len(self.splits)) * smax * self.k)[None, :])
+        flat = jax.ops.segment_sum(
+            jnp.ones(key.size, jnp.float32), key.reshape(-1),
+            num_segments=len(self.splits) * smax * self.k)
+        hists = np.asarray(flat).reshape(len(self.splits), smax, self.k)
+        for i, s in enumerate(self.splits):
+            out.append(hists[i, : s.n_segments])
+        return out
+
+    def split_stats(self) -> List[Tuple[object, float]]:
+        """(CandidateSplit, stat) per candidate, computed per algorithm."""
+        out = []
+        for s, h in zip(self.splits, self.histograms):
+            if self.algorithm == "hellingerDistance":
+                if self.k != 2:
+                    raise ValueError("Hellinger distance algorithm is only "
+                                     "valid for binary valued class attributes")
+                tot = np.maximum(h.sum(axis=0), _EPS)        # per-class totals
+                d = np.sqrt(h[:, 0] / tot[0]) - np.sqrt(h[:, 1] / tot[1])
+                stat = float(np.sqrt((d * d).sum()))
+            else:
+                stat = float(weighted_split_score(jnp.asarray(h), self.algorithm))
+            out.append((s, stat))
+        return out
+
+    def best_split(self):
+        """(CandidateSplit, stat): max stat for Hellinger, min info content
+        for entropy/gini."""
+        stats = self.split_stats()
+        pick = max if self.algorithm == "hellingerDistance" else min
+        return pick(stats, key=lambda t: t[1])
 
 
 # ---------------------------------------------------------------------------

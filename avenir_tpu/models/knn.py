@@ -180,19 +180,15 @@ class NearestNeighborClassifier:
 
         # class-conditional weighting: P(features_i | class_i) per train row,
         # the quantity jobs (2)-(4) of the reference pipeline compute + join
-        # (BayesianPredictor bap.output.feature.prob.only=true mode)
+        # (BayesianPredictor bap.output.feature.prob.only=true mode) — the
+        # same NaiveBayesPredictor.feature_prob the file-based job emits
         post = np.ones((pad,), np.float32)
         if class_cond_weighted:
+            from avenir_tpu.models.naive_bayes import NaiveBayesPredictor
+
             model = nb_model if nb_model is not None else NaiveBayesModel.fit(train)
-            tables = model.finish()
-            codes, _ = train.feature_codes(model.binned_fields)
-            if codes.shape[1]:
-                lp = np.asarray(tables["log_post"])       # [F, K, B]
-                y = train.labels()
-                logp = np.zeros(len(train), np.float64)
-                for f in range(codes.shape[1]):
-                    logp += lp[f, y, codes[:, f]]
-                post[: len(train)] = np.exp(logp).astype(np.float32)
+            post[: len(train)] = NaiveBayesPredictor(model).feature_prob(
+                train).astype(np.float32)
         self.train_post = jnp.asarray(post)
 
     # ------------------------------------------------------------- neighbors

@@ -36,6 +36,7 @@ from __future__ import annotations
 import itertools
 import json
 import math
+import os
 from dataclasses import dataclass, field
 from functools import partial
 from typing import Dict, List, Optional, Sequence, Tuple
@@ -596,3 +597,48 @@ class RandomForestBuilder:
         cm = ConfusionMatrix(self.class_values, pos_class=pos_class)
         cm.add(ds.labels(), self.predict(ds))
         return cm
+
+
+class DataPartitioner:
+    """Physically partition rows by the best candidate split — the dap.* MR
+    job (tree/DataPartitioner.java:59-131): pick the top split of the given
+    (or best) attribute, then write each segment's rows to
+    `<base>/split=<splitId>/segment=<j>/data` files for the next pipeline
+    stage."""
+
+    def __init__(self, schema: FeatureSchema, algorithm: str = "giniIndex",
+                 split_attribute: Optional[int] = None,
+                 cat_partition_cap: int = 128):
+        self.schema = schema
+        self.algorithm = algorithm
+        self.split_attribute = split_attribute
+        self.cat_partition_cap = cat_partition_cap
+
+    def best_split(self, ds: Dataset) -> Tuple[CandidateSplit, float]:
+        from avenir_tpu.models.explore import ClassPartitionGenerator
+
+        attrs = ([self.split_attribute]
+                 if self.split_attribute is not None else None)
+        cpg = ClassPartitionGenerator(ds, attributes=attrs,
+                                      algorithm=self.algorithm,
+                                      cat_partition_cap=self.cat_partition_cap)
+        return cpg.best_split()
+
+    def partition(self, ds: Dataset, base_path: str,
+                  delim: str = ",") -> List[str]:
+        """Returns the written `.../segment=j/data` file paths in segment
+        order (empty segments still get an empty file, as one reducer per
+        segment would)."""
+        split, _ = self.best_split(ds)
+        seg = split.segment_of(np.asarray(ds.column(split.attribute)))
+        paths = []
+        for j in range(split.n_segments):
+            d = os.path.join(base_path, f"split={split.split_id}",
+                             f"segment={j}")
+            os.makedirs(d, exist_ok=True)
+            p = os.path.join(d, "data")
+            sub = ds.take(np.nonzero(seg == j)[0])
+            with open(p, "w") as fh:
+                fh.write(sub.to_csv(delim) if len(sub) else "")
+            paths.append(p)
+        return paths

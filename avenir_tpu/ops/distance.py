@@ -35,6 +35,8 @@ def pairwise_distance(
     cat_bins: Optional[Tuple[int, ...]] = None,
     num_ranges: Optional[jnp.ndarray] = None,
     metric: str = "manhattan",
+    num_weights: Optional[jnp.ndarray] = None,
+    cat_weights: Optional[Tuple[float, ...]] = None,
 ) -> jnp.ndarray:
     """Dense [nq, nt] mixed-attribute distance block.
 
@@ -44,20 +46,27 @@ def pairwise_distance(
     num_ranges: [Dn] normalization ranges (max-min per schema); defaults 1.
     metric: 'manhattan' (SameTypeSimilarity-style avg per-attribute distance)
             or 'euclidean' (sqrt of mean squared per-attribute distance).
+    num_weights/cat_weights: per-attribute weights (the distance-schema
+    weighting of chombo InterRecordDistance); default 1 each.
 
-    The result is the *average* per-attribute distance in [0, 1]-ish space,
-    matching the reference's attribute-averaged semantics.
+    The result is the weight-averaged per-attribute distance in [0, 1]-ish
+    space, matching the reference's attribute-averaged semantics.
     """
     nq = q_num.shape[0] if q_num is not None and q_num.ndim == 2 else q_cat.shape[0]
     nt = t_num.shape[0] if t_num is not None and t_num.ndim == 2 else t_cat.shape[0]
     d_total = jnp.zeros((nq, nt), dtype=jnp.float32)
-    n_attr = 0
+    w_total = 0.0
 
     if q_num is not None and q_num.shape[-1] > 0:
         dn = q_num.shape[-1]
         rng = num_ranges if num_ranges is not None else jnp.ones((dn,), jnp.float32)
-        qs = q_num / jnp.maximum(rng, 1e-9)
-        ts = t_num / jnp.maximum(rng, 1e-9)
+        w = (num_weights if num_weights is not None
+             else jnp.ones((dn,), jnp.float32))
+        # weight folds into the feature scaling: w*|q-t| for L1 needs a w
+        # factor, w*(q-t)^2 for L2 a sqrt(w) factor
+        scale = (jnp.sqrt(w) if metric == "euclidean" else w) / jnp.maximum(rng, 1e-9)
+        qs = q_num * scale
+        ts = t_num * scale
         if metric == "euclidean":
             # ||q-t||^2 = ||q||^2 + ||t||^2 - 2 q.t — one MXU matmul
             sq = jnp.sum(qs * qs, axis=1)[:, None] + jnp.sum(ts * ts, axis=1)[None, :]
@@ -68,26 +77,28 @@ def pairwise_distance(
             d_total = d_total + jnp.sum(
                 jnp.abs(qs[:, None, :] - ts[None, :, :]), axis=-1
             )
-        n_attr += dn
+        w_total = w_total + jnp.sum(w)
 
     if q_cat is not None and q_cat.shape[-1] > 0:
         dc = q_cat.shape[-1]
         assert cat_bins is not None and len(cat_bins) == dc
-        # mismatch count = dc - sum_f [q_f == t_f]; equality via one-hot matmul
+        cw = cat_weights if cat_weights is not None else (1.0,) * dc
+        # weighted mismatch = sum_f w_f - sum_f w_f [q_f == t_f]; equality
+        # via one-hot matmul
         matches = jnp.zeros((nq, nt), dtype=jnp.float32)
         for f in range(dc):
             qo = jax.nn.one_hot(q_cat[:, f], cat_bins[f], dtype=jnp.float32)
             to = jax.nn.one_hot(t_cat[:, f], cat_bins[f], dtype=jnp.float32)
-            matches = matches + qo @ to.T
+            matches = matches + cw[f] * (qo @ to.T)
         # per-attribute categorical distance is 0/1, so d_f^2 == d_f and the
         # mismatch count is the right contribution for both metrics
-        d_total = d_total + (dc - matches)
-        n_attr += dc
+        d_total = d_total + (sum(cw) - matches)
+        w_total = w_total + sum(cw)
 
-    n_attr = max(n_attr, 1)
+    w_total = jnp.maximum(w_total, 1e-9)
     if metric == "euclidean":
-        return jnp.sqrt(d_total / n_attr)
-    return d_total / n_attr
+        return jnp.sqrt(d_total / w_total)
+    return d_total / w_total
 
 
 def pad_train(

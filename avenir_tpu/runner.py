@@ -29,7 +29,8 @@ from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
-from avenir_tpu.core.config import JobConfig, load_properties
+from avenir_tpu.core.config import (JobConfig, MissingConfigError,
+                                    load_properties)
 from avenir_tpu.core.dataset import Dataset
 from avenir_tpu.core.schema import FeatureSchema
 from avenir_tpu.utils.metrics import ConfusionMatrix
@@ -254,6 +255,74 @@ def nearest_neighbor(cfg: JobConfig, inputs: List[str], output: str) -> JobResul
     return JobResult("nearestNeighbor", counters, [out])
 
 
+# ================================================================= similarity
+def _similarity_schema(cfg: JobConfig) -> FeatureSchema:
+    """Accept any of the three reference key spellings for the schema:
+    sifarish `sts.same.schema.file.path`, spark `rich.attr.schema.path`,
+    or the framework-wide `feature.schema.file.path`."""
+    for key in ("feature.schema.file.path", "same.schema.file.path",
+                "rich.attr.schema.path"):
+        path = cfg.get(key)
+        if path:
+            return FeatureSchema.from_file(path)
+    raise MissingConfigError(
+        f"missing schema config param: {cfg.prefix}.feature.schema.file.path")
+
+
+@job("recordSimilarity", "sts", "sameTypeSimilarity",
+     "org.avenir.spark.similarity.RecordSimilarity")
+def record_similarity_job(cfg: JobConfig, inputs: List[str], output: str) -> JobResult:
+    """All-pairs record distance file (the sifarish SameTypeSimilarity stage
+    of resource/knn.sh:44-57 / RecordSimilarity.scala:34). One input =
+    intra-set i<j pairs; two inputs (or sts.inter.set.matching=true) =
+    cross-set pairs. Output rows: id1,id2,scaled-int-distance."""
+    from avenir_tpu.models.similarity import RecordSimilarity
+
+    schema = _similarity_schema(cfg)
+    delim = cfg.field_delim_regex
+    sim = RecordSimilarity(
+        metric=cfg.get("distance.metric", "manhattan"),
+        scale=cfg.get_int("distance.scale", 1000),
+        num_weights=cfg.get_float_list("num.attribute.weights"),
+        cat_weights=cfg.get_float_list("cat.attribute.weights"),
+    )
+    out = _out_file(output)
+    inter = cfg.get_bool("inter.set.matching", len(inputs) > 1)
+    if inter:
+        base = Dataset.from_csv(inputs[0], schema, delim=delim)
+        other = Dataset.from_csv(inputs[-1], schema, delim=delim)
+        n = sim.save(sim.inter(base, other), out, delim=cfg.field_delim,
+                     id_first=cfg.get_bool("output.id.first", True))
+    else:
+        ds = Dataset.from_csv(inputs[0], schema, delim=delim)
+        n = sim.save(sim.intra(ds), out, delim=cfg.field_delim,
+                     id_first=cfg.get_bool("output.id.first", True))
+    return JobResult("recordSimilarity", {"Similarity:Pairs": n}, [out])
+
+
+@job("groupedRecordSimilarity", "grs",
+     "org.avenir.spark.similarity.GroupedRecordSimilarity")
+def grouped_similarity_job(cfg: JobConfig, inputs: List[str], output: str) -> JobResult:
+    from avenir_tpu.models.similarity import GroupedRecordSimilarity
+
+    schema = _similarity_schema(cfg)
+    ds = Dataset.from_csv(inputs[0], schema, delim=cfg.field_delim_regex)
+    sim = GroupedRecordSimilarity(
+        [int(o) for o in cfg.assert_list("group.field.ordinals")],
+        metric=cfg.get("distance.metric", "manhattan"),
+        scale=cfg.get_int("distance.scale", 1000),
+    )
+    out = _out_file(output)
+    delim = cfg.field_delim
+    n = 0
+    with open(out, "w") as fh:
+        for key, id1, id2, d in sim.grouped_intra(ds):
+            sd = int(round(d * sim.scale))
+            fh.write(delim.join([*key, id1, id2, str(sd)]) + "\n")
+            n += 1
+    return JobResult("groupedRecordSimilarity", {"Similarity:Pairs": n}, [out])
+
+
 # ======================================================================= tree
 def _tree_builder(cfg: JobConfig, schema: FeatureSchema):
     from avenir_tpu.models.tree import DecisionTreeBuilder
@@ -310,6 +379,78 @@ def random_forest(cfg: JobConfig, inputs: List[str], output: str) -> JobResult:
             outs.append(p)
     return JobResult("randomForest", {"Tree:Trees": len(forest.trees)},
                      outs, forest)
+
+
+@job("classPartitionGenerator", "cpg",
+     "org.avenir.explore.ClassPartitionGenerator")
+def class_partition_job(cfg: JobConfig, inputs: List[str], output: str) -> JobResult:
+    """Candidate-split class-histogram stats (cpg.* keys; the reference's
+    two-job tree flow stage, ClassPartitionGenerator.java:61)."""
+    from avenir_tpu.models.explore import ClassPartitionGenerator
+
+    ds = _dataset(inputs[0], cfg)
+    attrs = cfg.get_int_list("split.attributes")
+    cpg = ClassPartitionGenerator(
+        ds, attributes=attrs,
+        algorithm=cfg.get("split.algorithm", cfg.get("algorithm", "giniIndex")),
+    )
+    out = _out_file(output)
+    delim = cfg.field_delim
+    with open(out, "w") as fh:
+        for s, stat in cpg.split_stats():
+            fh.write(f"{s.attribute}{delim}{s.split_id}{delim}{stat:.6f}\n")
+    return JobResult("classPartitionGenerator",
+                     {"Splits:Candidates": len(cpg.splits)}, [out], cpg)
+
+
+@job("dataPartitioner", "dap", "org.avenir.tree.DataPartitioner")
+def data_partitioner_job(cfg: JobConfig, inputs: List[str], output: str) -> JobResult:
+    from avenir_tpu.models.tree import DataPartitioner
+
+    # keep_raw: partition output must pass rows through byte-identical
+    # (reconstruction would reformat numerics and break on missing values)
+    ds = _dataset(inputs[0], cfg, keep_raw=True)
+    dp = DataPartitioner(
+        _schema(cfg),
+        algorithm=cfg.get("split.algorithm", "giniIndex"),
+        split_attribute=cfg.get_int("split.attribute"),
+    )
+    base = cfg.get("project.base.path") or output
+    paths = dp.partition(ds, base, delim=cfg.field_delim)
+    return JobResult("dataPartitioner", {"Partition:Segments": len(paths)},
+                     paths)
+
+
+@job("contTimeStateTransitionStats", "cts",
+     "org.avenir.spark.markov.ContTimeStateTransitionStats")
+def ctmc_stats_job(cfg: JobConfig, inputs: List[str], output: str) -> JobResult:
+    """CTMC statistics by uniformization (ContTimeStateTransitionStats.scala:34).
+    `cts.state.trans.file.path` holds the rate matrix rows; input rows are
+    `id,initState[,endState]`; `cts.state.trans.stat` picks stateDwellTime
+    (target = cts.target.states[0]) or StateTransitionCount (targets[0:2])."""
+    from avenir_tpu.models.markov import ContTimeStateTransitionStats
+
+    states = cfg.assert_list("state.values")
+    rates = np.loadtxt(cfg.assert_get("state.trans.file.path"),
+                       delimiter=cfg.field_delim_regex, ndmin=2)
+    stats = ContTimeStateTransitionStats(
+        rates, states, cfg.assert_float("time.horizon"))
+    stat_kind = cfg.get("state.trans.stat", "stateDwellTime")
+    targets = cfg.assert_list("target.states")
+    out = _out_file(output)
+    delim = cfg.field_delim
+    with open(out, "w") as fh:
+        for path in inputs:
+            for ln in _read_lines(path):
+                toks = [t.strip() for t in ln.split(cfg.field_delim_regex)]
+                rid, init = toks[0], toks[1]
+                end = toks[2] if len(toks) > 2 else None
+                if stat_kind == "stateDwellTime":
+                    v = stats.dwell_time(init, targets[0], end)
+                else:
+                    v = stats.transition_count(init, targets[0], targets[1], end)
+                fh.write(f"{rid}{delim}{v:.6f}\n")
+    return JobResult("contTimeStateTransitionStats", {}, [out], stats)
 
 
 # ==================================================================== explore

@@ -1,0 +1,204 @@
+"""ClassPartitionGenerator, DataPartitioner, CTMC stats, tabular utils."""
+
+import math
+import os
+
+import numpy as np
+import pytest
+
+from avenir_tpu.core.dataset import Dataset
+from avenir_tpu.core.schema import FeatureSchema
+from avenir_tpu.models.explore import ClassPartitionGenerator
+from avenir_tpu.models.markov import ContTimeStateTransitionStats
+from avenir_tpu.models.tree import DataPartitioner
+from avenir_tpu.runner import run_job
+from avenir_tpu.utils.tabular import (
+    ClassAttributeCounter,
+    ContingencyMatrix,
+    CostSchema,
+    StateTransitionProbability,
+)
+
+
+@pytest.fixture(scope="module")
+def split_schema():
+    return FeatureSchema.from_json({
+        "fields": [
+            {"name": "id", "ordinal": 0, "id": True, "dataType": "string"},
+            {"name": "color", "ordinal": 1, "dataType": "categorical",
+             "cardinality": ["red", "blue"], "feature": True},
+            {"name": "size", "ordinal": 2, "dataType": "int", "feature": True,
+             "min": 0, "max": 10, "bucketWidth": 2, "maxSplit": 2,
+             "splitScanInterval": 2},
+            {"name": "label", "ordinal": 3, "dataType": "categorical",
+             "cardinality": ["no", "yes"]},
+        ]
+    })
+
+
+@pytest.fixture(scope="module")
+def split_ds(split_schema):
+    # label is exactly color: color separates perfectly, size is noise
+    rows = []
+    rng = np.random.default_rng(0)
+    for i in range(80):
+        color = "red" if i % 2 == 0 else "blue"
+        label = "yes" if color == "red" else "no"
+        rows.append([f"r{i}", color, str(int(rng.integers(0, 10))), label])
+    return Dataset.from_rows(rows, split_schema)
+
+
+def test_cpg_best_split_finds_separator(split_ds):
+    cpg = ClassPartitionGenerator(split_ds, algorithm="giniIndex")
+    best, stat = cpg.best_split()
+    assert best.attribute == 1          # the perfectly-separating attribute
+    assert stat == pytest.approx(0.0, abs=1e-6)
+    # histograms: each segment is pure
+    h = cpg.histograms[cpg.splits.index(best)]
+    assert (h > 0).sum() == 2
+
+
+def test_cpg_hellinger(split_ds):
+    cpg = ClassPartitionGenerator(split_ds, attributes=[1],
+                                  algorithm="hellingerDistance")
+    best, stat = cpg.best_split()
+    # perfect separation: sqrt((1-0)^2 + (0-1)^2) = sqrt(2)
+    assert stat == pytest.approx(math.sqrt(2.0), abs=1e-6)
+
+
+def test_cpg_hellinger_requires_binary(split_schema):
+    schema3 = FeatureSchema.from_json({
+        "fields": [
+            {"name": "f", "ordinal": 0, "dataType": "categorical",
+             "cardinality": ["a", "b"], "feature": True},
+            {"name": "label", "ordinal": 1, "dataType": "categorical",
+             "cardinality": ["x", "y", "z"]},
+        ]
+    })
+    ds = Dataset.from_rows(
+        [["a", "x"], ["b", "y"], ["a", "z"], ["b", "x"]], schema3)
+    cpg = ClassPartitionGenerator(ds, algorithm="hellingerDistance")
+    with pytest.raises(ValueError, match="binary"):
+        cpg.split_stats()
+
+
+def test_data_partitioner(split_ds, tmp_path):
+    dp = DataPartitioner(split_ds.schema, split_attribute=1)
+    paths = dp.partition(split_ds, str(tmp_path / "parts"))
+    assert len(paths) == 2
+    assert all("segment=" in p and p.endswith("data") for p in paths)
+    total = 0
+    for p in paths:
+        lines = [ln for ln in open(p).read().splitlines() if ln.strip()]
+        colors = {ln.split(",")[1] for ln in lines}
+        assert len(colors) == 1          # each segment holds one color only
+        total += len(lines)
+    assert total == len(split_ds)
+
+
+def test_data_partitioner_job(split_ds, tmp_path):
+    schema_path = str(tmp_path / "schema.json")
+    split_ds.schema.save(schema_path)
+    data = str(tmp_path / "rows.csv")
+    with open(data, "w") as fh:
+        fh.write(split_ds.to_csv())
+    props = {"dap.feature.schema.file.path": schema_path,
+             "dap.split.attribute": "1"}
+    res = run_job("dataPartitioner", props, [data], str(tmp_path / "out"))
+    assert res.counters["Partition:Segments"] == 2
+
+
+# ------------------------------------------------------------------- CTMC
+def test_ctmc_dwell_time_matches_analytic():
+    # 2-state chain: rate 0->1 = a, 1->0 = b
+    a, b, T = 1.0, 0.5, 2.0
+    rates = np.array([[0.0, a], [b, 0.0]])
+    stats = ContTimeStateTransitionStats(rates, ["s0", "s1"], T)
+    lam = a + b
+    expected = (a / lam) * (T - (1 - math.exp(-lam * T)) / lam)
+    got = stats.dwell_time("s0", "s1")
+    assert got == pytest.approx(expected, rel=0.02)
+
+
+def test_ctmc_transition_count_matches_analytic():
+    a, b, T = 1.0, 0.5, 2.0
+    rates = np.array([[0.0, a], [b, 0.0]])
+    stats = ContTimeStateTransitionStats(rates, ["s0", "s1"], T)
+    lam = a + b
+    # E[#(0->1)] = a * expected dwell in state 0
+    dwell0 = (b / lam) * T + (a / lam) * (1 - math.exp(-lam * T)) / lam
+    got = stats.transition_count("s0", "s0", "s1")
+    assert got == pytest.approx(a * dwell0, rel=0.05)
+
+
+def test_ctmc_job(tmp_path):
+    rates_path = str(tmp_path / "rates.csv")
+    np.savetxt(rates_path, np.array([[0.0, 1.0], [0.5, 0.0]]), delimiter=",")
+    data = str(tmp_path / "init.csv")
+    with open(data, "w") as fh:
+        fh.write("e0,s0\ne1,s1\n")
+    out = str(tmp_path / "ctmc.txt")
+    props = {
+        "cts.state.values": "s0,s1",
+        "cts.time.horizon": "2.0",
+        "cts.state.trans.file.path": rates_path,
+        "cts.state.trans.stat": "stateDwellTime",
+        "cts.target.states": "s1",
+    }
+    res = run_job("contTimeStateTransitionStats", props, [data], out)
+    lines = open(out).read().splitlines()
+    assert len(lines) == 2
+    d0 = float(lines[0].split(",")[1])
+    d1 = float(lines[1].split(",")[1])
+    assert d1 > d0 > 0  # starting in the target state dwells longer
+
+
+# ---------------------------------------------------------------- tabular
+def test_state_transition_probability():
+    stp = StateTransitionProbability(["A", "B"], scale=100)
+    stp.add("A", "A", 3)
+    stp.add("A", "B", 1)
+    stp.add("B", "B", 2)
+    m = stp.normalize_rows()
+    assert m.dtype == np.int64
+    assert list(m[0]) == [75, 25]
+    assert list(m[1]) == [0, 100]
+    assert stp.prob("A", "B") == pytest.approx(0.25)
+    assert "75,25" in stp.serialize()
+
+
+def test_contingency_matrix_cramer():
+    m = ContingencyMatrix(2, 2)
+    for _ in range(10):
+        m.add(0, 0)
+        m.add(1, 1)
+    # perfect association in a 2x2 -> chi2 = n, cramer index = 1
+    assert m.cramer_index() == pytest.approx(1.0)
+    text = m.serialize()
+    m2 = ContingencyMatrix.deserialize(text, 2, 2)
+    assert np.array_equal(m.table, m2.table)
+
+
+def test_cost_schema(tmp_path):
+    path = str(tmp_path / "cost.json")
+    import json
+    with open(path, "w") as fh:
+        json.dump({"attributes": [
+            {"ordinal": 2, "numAttrCost": 1.5},
+            {"ordinal": 4, "catAttrCost": {"poor,good": 10.0}},
+        ]}, fh)
+    cs = CostSchema.from_file(path)
+    assert cs.find_cost(2, 4.0) == pytest.approx(6.0)
+    assert cs.find_cost(4, "poor", "good") == pytest.approx(10.0)
+    assert cs.find_cost(4, "good", "poor") == 0.0  # unspecified -> 0
+    with pytest.raises(ValueError):
+        cs.find_cost(99, 1.0)
+
+
+def test_class_attribute_counter():
+    c = ClassAttributeCounter()
+    c.add(3, 2)
+    c.add(1, 0)
+    assert (c.pos_count, c.neg_count, c.total) == (4, 2, 6)
+    c.update(7, 7)
+    assert c.total == 14
