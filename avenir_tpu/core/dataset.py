@@ -190,18 +190,23 @@ class Dataset:
         decoded to their cardinality values). Uses raw rows when kept."""
         if self.raw_rows is not None:
             return "\n".join(delim.join(r) for r in self.raw_rows) + "\n"
+        # tokens land at their declared ordinals; gaps (fields present in
+        # the file but undeclared in the schema, e.g. call_hangup's area
+        # code) become empty tokens so the row re-parses against the schema
+        width = max(f.ordinal for f in self.schema.fields) + 1
         lines = []
         for i in range(self.n_rows):
-            toks = []
+            toks = [""] * width
             for fld in self.schema.fields:
                 col = self.columns[fld.ordinal]
                 if fld.is_categorical:
-                    toks.append(fld.decode_value(int(col[i])))
+                    tok = fld.decode_value(int(col[i]))
                 elif fld.is_numeric:
                     v = float(col[i])
-                    toks.append(str(int(v)) if v == int(v) else f"{v:.6g}")
+                    tok = str(int(v)) if v == int(v) else f"{v:.6g}"
                 else:
-                    toks.append(str(col[i]))
+                    tok = str(col[i])
+                toks[fld.ordinal] = tok
             lines.append(delim.join(toks))
         return "\n".join(lines) + "\n"
 

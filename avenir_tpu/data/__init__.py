@@ -3,8 +3,12 @@
 seedable numpy generators that return Datasets directly."""
 
 from avenir_tpu.data.generators import (
+    call_hangup_schema,
     churn_schema,
-    generate_churn,
     elearn_schema,
+    generate_call_hangup,
+    generate_churn,
     generate_elearn,
+    generate_event_sequences,
+    generate_price_opt,
 )

@@ -106,3 +106,101 @@ def generate_elearn(n: int, num_numeric: int = 6, seed: int = 11) -> Dataset:
         for i in range(n)
     ]
     return Dataset.from_rows(rows, schema)
+
+
+def call_hangup_schema() -> FeatureSchema:
+    """resource/call_hangup.json mirror (same ordinals; ordinal 2 = area
+    code is present in rows but undeclared, exactly as the reference skips
+    it). The class field gets its cardinality declared (deviation: the
+    reference file omits it and lets the job infer)."""
+    return FeatureSchema.from_json({
+        "fields": [
+            {"name": "id", "ordinal": 0, "id": True, "dataType": "string"},
+            {"name": "customer type", "ordinal": 1, "dataType": "categorical",
+             "feature": True, "maxSplit": 2,
+             "cardinality": ["business", "residence"]},
+            {"name": "issue", "ordinal": 3, "dataType": "categorical",
+             "feature": True, "maxSplit": 2,
+             "cardinality": ["internet", "cable", "billing", "other"]},
+            {"name": "time of day", "ordinal": 4, "dataType": "categorical",
+             "feature": True, "maxSplit": 2, "cardinality": ["AM", "PM"]},
+            {"name": "hold time", "ordinal": 5, "dataType": "int",
+             "feature": True, "bucketWidth": 60, "min": 0, "max": 600,
+             "splitScanInterval": 60},
+            {"name": "hungup", "ordinal": 6, "dataType": "categorical",
+             "cardinality": ["F", "T"]},
+        ]
+    })
+
+
+def generate_call_hangup(n: int, seed: int = 13,
+                         as_csv: bool = False) -> "Dataset | str":
+    """resource/call_hangup.py behavior: Gaussian hold times by time of
+    day (AM mean 500/80, PM 400/60), hangup likely above a threshold."""
+    rng = np.random.default_rng(seed)
+    schema = call_hangup_schema()
+    rows = []
+    for i in range(n):
+        cust = "business" if rng.random() < 0.4 else "residence"
+        issue = ["internet", "billing", "other"][rng.integers(0, 3)] \
+            if cust == "business" else \
+            ["internet", "cable", "billing", "other"][rng.integers(0, 4)]
+        tod = "AM" if rng.random() < 0.5 else "PM"
+        mean, std = (500.0, 80.0) if tod == "AM" else (400.0, 60.0)
+        hold = float(np.clip(rng.normal(mean, std), 0, 599))
+        threshold = 420.0
+        if hold > threshold:
+            hungup = "T" if rng.random() < 0.8 else "F"
+        else:
+            hungup = "F" if rng.random() < 0.9 else "T"
+        area = str(rng.choice([408, 607, 336, 646, 206]))
+        rows.append([f"{rng.integers(10**9, 10**10)}", cust, area, issue,
+                     tod, str(int(hold)), hungup])
+    if as_csv:
+        return "\n".join(",".join(r) for r in rows) + "\n"
+    return Dataset.from_rows(rows, schema)
+
+
+def generate_price_opt(num_products: int = 10, seed: int = 17
+                       ) -> List[List[str]]:
+    """resource/price_opt.py behavior: per product a price ladder whose
+    revenue rises to a halfway peak then falls — the group bandit round
+    input rows (group=product, item=price, count, avgReward)."""
+    rng = np.random.default_rng(seed)
+    rows: List[List[str]] = []
+    for _ in range(num_products):
+        prod = str(rng.integers(1_000_000, 8_000_000))
+        num_price = int(rng.integers(6, 12))
+        price = int(rng.integers(10, 80))
+        delta = int(rng.integers(2, 4))
+        rev = float(rng.integers(10_000, 30_000))
+        rev_delta = float(rng.integers(500, 1_500))
+        half = num_price // 2 + int(rng.integers(-2, 2))
+        for p in range(num_price):
+            rows.append([prod, str(price), "1", f"{rev:.0f}"])
+            price += delta
+            rev += (rev_delta if p < half else -rev_delta) + float(
+                rng.integers(-20, 20))
+    return rows
+
+
+def generate_event_sequences(n: int, states: Optional[List[str]] = None,
+                             mean_len: int = 10, seed: int = 19
+                             ) -> List[List[str]]:
+    """resource/event_seq.rb-style event sequences: per entity a Markov
+    walk over event states with a sticky diagonal."""
+    rng = np.random.default_rng(seed)
+    states = states or ["login", "browse", "cart", "buy", "logout"]
+    s = len(states)
+    trans = np.full((s, s), 0.5 / (s - 1))
+    np.fill_diagonal(trans, 0.5)
+    seqs = []
+    for i in range(n):
+        length = max(2, int(rng.poisson(mean_len)))
+        cur = int(rng.integers(0, s))
+        seq = [states[cur]]
+        for _ in range(length - 1):
+            cur = int(rng.choice(s, p=trans[cur]))
+            seq.append(states[cur])
+        seqs.append(seq)
+    return seqs

@@ -211,28 +211,24 @@ class ClassPartitionGenerator:
         self.histograms = self._histograms()
 
     def _histograms(self) -> List[np.ndarray]:
-        """Per split: [n_segments, k] class counts, all splits in one
-        device reduction."""
-        import jax.ops
+        """Per split: [n_segments, k] class counts — the tree level
+        histogram kernel with a single root leaf."""
+        from avenir_tpu.models.tree import _level_histogram
 
-        labels = jnp.asarray(self.ds.labels())
-        out = []
         if not self.splits:
-            return out
+            return []
+        n = len(self.ds)
         smax = max(s.n_segments for s in self.splits)
         seg = np.stack(
             [s.segment_of(np.asarray(self.ds.column(s.attribute)))
              for s in self.splits], axis=1,
-        ).astype(np.int32)                                   # [n, NS]
-        key = (jnp.asarray(seg) * self.k + labels[:, None]
-               + (jnp.arange(len(self.splits)) * smax * self.k)[None, :])
-        flat = jax.ops.segment_sum(
-            jnp.ones(key.size, jnp.float32), key.reshape(-1),
-            num_segments=len(self.splits) * smax * self.k)
-        hists = np.asarray(flat).reshape(len(self.splits), smax, self.k)
-        for i, s in enumerate(self.splits):
-            out.append(hists[i, : s.n_segments])
-        return out
+        ).astype(np.int8)                                    # [n, NS]
+        hists = np.asarray(_level_histogram(
+            jnp.zeros(n, jnp.int32), jnp.asarray(seg),
+            jnp.asarray(self.ds.labels()), jnp.ones(n, jnp.float32),
+            1, len(self.splits), smax, self.k,
+        ))[0]                                                # [NS, smax, k]
+        return [hists[i, : s.n_segments] for i, s in enumerate(self.splits)]
 
     def split_stats(self) -> List[Tuple[object, float]]:
         """(CandidateSplit, stat) per candidate, computed per algorithm."""

@@ -446,15 +446,27 @@ class ContTimeStateTransitionStats:
              if end is not None else np.ones(self.limit + 1))
         return a, b
 
+    def _end_prob(self, init_state: str, end_state: str) -> float:
+        """P(X_T = end | X_0 = init): the conditioning normalizer."""
+        path = self.powers[:, self._sindex(init_state), self._sindex(end_state)]
+        return float(np.maximum(np.sum(path * self.pois), _EPS))
+
     def dwell_time(self, init_state: str, target_state: str,
                    end_state: Optional[str] = None) -> float:
         """Expected time spent in target_state over the horizon, starting
-        from init_state (optionally conditioned on ending in end_state) —
-        the "stateDwellTime" statistic (:161-192)."""
+        from init_state; with end_state, the expectation conditioned on
+        ending there — the "stateDwellTime" statistic (:161-192).
+
+        Deviation from the reference: it returns the unnormalized joint
+        E[dwell * 1{X_T=end}]; dividing by P(X_T=end | init) yields the
+        conditional expectation this method documents."""
         a, b = self._ab(init_state, target_state, end_state)
         inner = np.convolve(a, b)[: self.limit + 1]     # sum_{j<=i} a_j b_{i-j}
         i = np.arange(self.limit + 1, dtype=np.float64)
-        return float(np.sum(self.horizon / (i + 1.0) * inner * self.pois))
+        raw = float(np.sum(self.horizon / (i + 1.0) * inner * self.pois))
+        if end_state is not None:
+            raw /= self._end_prob(init_state, end_state)
+        return raw
 
     def transition_count(self, init_state: str, from_state: str,
                          to_state: str, end_state: Optional[str] = None
@@ -476,7 +488,11 @@ class ContTimeStateTransitionStats:
         # inner[i] = sum_{j<=i-1} a_j b_{i-1-j}: one uniformized step spent
         # on the from->to jump itself
         inner = np.concatenate([[0.0], conv[: self.limit]]) * step_pr
-        return float(np.sum(inner * self.pois))
+        raw = float(np.sum(inner * self.pois))
+        if end_state is not None:
+            # conditional, not joint — same deviation note as dwell_time
+            raw /= self._end_prob(init_state, end_state)
+        return raw
 
 
 def generate_markov_sequences(

@@ -142,18 +142,22 @@ class GroupedRecordSimilarity(RecordSimilarity):
 
 
 # --------------------------------------------------------------- dist files
-def read_distance_file(path: str, delim: str = ",", scale: int = 1000
-                       ) -> Dict[Tuple[str, str], float]:
+def read_distance_file(path: str, delim: str = ",", scale: int = 1000,
+                       id_first: bool = True) -> Dict[Tuple[str, str], float]:
     """Load a distance file back into a symmetric pair->distance map — the
     EntityDistanceMapFileAccessor role (util/EntityDistanceMapFileAccessor.java:42)
-    that feeds AgglomerativeGraphical clustering."""
+    that feeds AgglomerativeGraphical clustering. `id_first` must match the
+    layout the file was written with (save(..., id_first=...))."""
     out: Dict[Tuple[str, str], float] = {}
     with open(path) as fh:
         for ln in fh:
             toks = [t.strip() for t in ln.rstrip("\n").split(delim)]
             if len(toks) < 3:
                 continue
-            id1, id2, sd = toks[0], toks[1], float(toks[2])
+            if id_first:
+                id1, id2, sd = toks[0], toks[1], float(toks[2])
+            else:
+                sd, id1, id2 = float(toks[0]), toks[1], toks[2]
             d = sd / scale
             out[(id1, id2)] = d
             out[(id2, id1)] = d

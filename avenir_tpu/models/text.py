@@ -62,3 +62,118 @@ class WordCounter:
         inv = list(vocab)
         return sorted(((inv[i], int(c)) for i, c in enumerate(counts)),
                       key=lambda kv: (-kv[1], kv[0]))
+
+
+class TextNaiveBayes:
+    """Free-text Naive Bayes — the reference's text-input mode of
+    BayesianDistribution (mapText, BayesianDistribution.java:186-195:
+    rows are `text,classVal`; each Lucene token contributes a
+    (classVal, token) count) with the matching multinomial predictor.
+
+    TPU design: tokens dictionary-encode on host (string work), then both
+    training counts and prediction scores are device work — counting is a
+    segment_sum over class*V+token keys; scoring is one bag-of-words
+    [n, V] x log P[V, K] matmul on the MXU."""
+
+    def __init__(self, laplace: float = 1.0, drop_stop_words: bool = True):
+        self.laplace = laplace
+        self.drop_stop = drop_stop_words
+        self.vocab: Dict[str, int] = {}
+        self.class_values: List[str] = []
+        self.log_prob: Optional[np.ndarray] = None      # [V, K]
+        self.log_prior: Optional[np.ndarray] = None     # [K]
+
+    def _encode(self, texts: Sequence[str], grow: bool):
+        doc_ids, tok_ids = [], []
+        for d, text in enumerate(texts):
+            for tok in tokenize(text, self.drop_stop):
+                if tok not in self.vocab:
+                    if not grow:
+                        continue            # unseen test token: skip
+                    self.vocab[tok] = len(self.vocab)
+                doc_ids.append(d)
+                tok_ids.append(self.vocab[tok])
+        return (np.asarray(doc_ids, np.int32), np.asarray(tok_ids, np.int32))
+
+    def fit(self, texts: Sequence[str], labels: Sequence[str]) -> "TextNaiveBayes":
+        import jax
+        import jax.numpy as jnp
+
+        self.class_values = sorted(set(labels))
+        cidx = {v: i for i, v in enumerate(self.class_values)}
+        y = np.asarray([cidx[v] for v in labels], np.int32)
+        doc_ids, tok_ids = self._encode(texts, grow=True)
+        v, k = len(self.vocab), len(self.class_values)
+        # (class, token) counts in one device reduction
+        key = jnp.asarray(tok_ids) * k + jnp.asarray(y[doc_ids])
+        counts = np.asarray(jax.ops.segment_sum(
+            jnp.ones(len(tok_ids), jnp.float32), key, num_segments=v * k
+        )).reshape(v, k)
+        smoothed = counts + self.laplace
+        self.log_prob = np.log(smoothed / smoothed.sum(axis=0, keepdims=True))
+        class_counts = np.bincount(y, minlength=k).astype(np.float64)
+        self.log_prior = np.log(np.maximum(class_counts / len(y), 1e-30))
+        return self
+
+    def _bow(self, texts: Sequence[str]) -> np.ndarray:
+        doc_ids, tok_ids = self._encode(texts, grow=False)
+        bow = np.zeros((len(texts), len(self.vocab)), np.float32)
+        np.add.at(bow, (doc_ids, tok_ids), 1.0)
+        return bow
+
+    def scores(self, texts: Sequence[str]) -> np.ndarray:
+        """[n, K] log posterior scores: bag-of-words matmul."""
+        import jax.numpy as jnp
+
+        bow = jnp.asarray(self._bow(texts))
+        return np.asarray(bow @ jnp.asarray(self.log_prob, jnp.float32)
+                          + jnp.asarray(self.log_prior, jnp.float32)[None, :])
+
+    def predict(self, texts: Sequence[str]) -> List[str]:
+        s = self.scores(texts)
+        return [self.class_values[i] for i in s.argmax(axis=1)]
+
+    # ------------------------------------------------------------- file IO
+    def save(self, path: str, delim: str = ",") -> None:
+        """Model CSV in the reference's count-row spirit:
+        a `#params` header (laplace, stop-word setting), then
+        classVal,token,logProb rows + prior rows."""
+        inv = {i: t for t, i in self.vocab.items()}
+        with open(path, "w") as fh:
+            fh.write(f"#params{delim}{self.laplace}{delim}"
+                     f"{str(self.drop_stop).lower()}\n")
+            for ki, cv in enumerate(self.class_values):
+                fh.write(f"{cv}{delim}{delim}{self.log_prior[ki]:.6f}\n")
+                for vi in range(len(inv)):
+                    fh.write(f"{cv}{delim}{inv[vi]}{delim}"
+                             f"{self.log_prob[vi, ki]:.6f}\n")
+
+    @classmethod
+    def load(cls, path: str, delim: str = ",") -> "TextNaiveBayes":
+        m = cls()
+        rows = []
+        with open(path) as fh:
+            for ln in fh:
+                toks = ln.rstrip("\n").split(delim)
+                if toks and toks[0] == "#params":
+                    m.laplace = float(toks[1])
+                    m.drop_stop = toks[2] == "true"
+                    continue
+                if len(toks) == 3:
+                    rows.append(toks)
+        m.class_values = sorted({r[0] for r in rows})
+        cidx = {v: i for i, v in enumerate(m.class_values)}
+        vocab_rows = [r for r in rows if r[1] != ""]
+        m.vocab = {}
+        for r in vocab_rows:
+            if r[1] not in m.vocab:
+                m.vocab[r[1]] = len(m.vocab)
+        v, k = len(m.vocab), len(m.class_values)
+        m.log_prob = np.zeros((v, k))
+        m.log_prior = np.zeros(k)
+        for cv, tok, val in rows:
+            if tok == "":
+                m.log_prior[cidx[cv]] = float(val)
+            else:
+                m.log_prob[m.vocab[tok], cidx[cv]] = float(val)
+        return m

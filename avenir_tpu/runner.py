@@ -145,7 +145,26 @@ def _validate(class_values: Sequence[str], actual: np.ndarray,
 # =================================================================== bayesian
 @job("bayesianDistr", "bad", "org.avenir.bayesian.BayesianDistribution")
 def bayesian_distribution(cfg: JobConfig, inputs: List[str], output: str) -> JobResult:
-    """NB sufficient-stats training -> CSV model file (SURVEY §3.1)."""
+    """NB sufficient-stats training -> CSV model file (SURVEY §3.1).
+    `bad.tabular.input=false` switches to the free-text mode: rows are
+    `text,classVal`, each token contributes a (classVal, token) count
+    (BayesianDistribution.mapText, :186-195)."""
+    out = _out_file(output)
+    if not cfg.get_bool("tabular.input", True):
+        from avenir_tpu.models.text import TextNaiveBayes
+
+        texts, labels = [], []
+        for path in inputs:
+            for ln in _read_lines(path):
+                text, _, cls = ln.rpartition(cfg.field_delim_regex)
+                texts.append(text)
+                labels.append(cls.strip())
+        tmodel = TextNaiveBayes().fit(texts, labels)
+        tmodel.save(out, delim=cfg.field_delim)
+        return JobResult("bayesianDistr",
+                         {"Distribution Data:Records": len(texts)},
+                         [out], tmodel)
+
     from avenir_tpu.models.naive_bayes import NaiveBayesModel
 
     model = None
@@ -155,7 +174,6 @@ def bayesian_distribution(cfg: JobConfig, inputs: List[str], output: str) -> Job
         rows += len(ds)
         part = NaiveBayesModel.fit(ds)
         model = part if model is None else model.merge(part)
-    out = _out_file(output)
     model.save(out, delim=cfg.field_delim)
     return JobResult("bayesianDistr", {"Distribution Data:Records": rows},
                      [out], model)

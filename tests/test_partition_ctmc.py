@@ -131,6 +131,23 @@ def test_ctmc_transition_count_matches_analytic():
     assert got == pytest.approx(a * dwell0, rel=0.05)
 
 
+def test_ctmc_conditional_is_normalized():
+    """Conditioning on an end state is a proper conditional expectation:
+    averaging over end states weighted by their probabilities recovers the
+    unconditional dwell."""
+    a, b, T = 1.0, 0.5, 2.0
+    rates = np.array([[0.0, a], [b, 0.0]])
+    stats = ContTimeStateTransitionStats(rates, ["s0", "s1"], T)
+    uncond = stats.dwell_time("s0", "s1")
+    mix = sum(
+        stats._end_prob("s0", e) * stats.dwell_time("s0", "s1", e)
+        for e in ["s0", "s1"]
+    )
+    assert mix == pytest.approx(uncond, rel=1e-6)
+    # conditioning must change the value (end in target -> longer dwell)
+    assert stats.dwell_time("s0", "s1", "s1") > uncond
+
+
 def test_ctmc_job(tmp_path):
     rates_path = str(tmp_path / "rates.csv")
     np.savetxt(rates_path, np.array([[0.0, 1.0], [0.5, 0.0]]), delimiter=",")
