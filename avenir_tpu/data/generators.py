@@ -192,6 +192,8 @@ def generate_event_sequences(n: int, states: Optional[List[str]] = None,
     rng = np.random.default_rng(seed)
     states = states or ["login", "browse", "cart", "buy", "logout"]
     s = len(states)
+    if s < 2:
+        raise ValueError("need at least 2 event states")
     trans = np.full((s, s), 0.5 / (s - 1))
     np.fill_diagonal(trans, 0.5)
     seqs = []

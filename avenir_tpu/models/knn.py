@@ -36,7 +36,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
-from avenir_tpu.core.dataset import Dataset
+from avenir_tpu.core.dataset import Dataset, pad_rows
 from avenir_tpu.core.schema import FeatureSchema
 from avenir_tpu.models.naive_bayes import NaiveBayesModel
 from avenir_tpu.ops.distance import blocked_topk_neighbors, pad_train
@@ -103,6 +103,7 @@ class NeighborIndex:
         metric: str = "manhattan",
         block: int = 4096,
         approx: bool = False,
+        use_pallas: Optional[bool] = None,
     ):
         self.schema = train.schema
         # the reference takes "the first topMatchCount values" — a train set
@@ -113,9 +114,33 @@ class NeighborIndex:
         self.block = min(block, max(len(train), 1))
 
         x_num, ranges, x_cat, bins = _extract(train)
-        t_num, t_cat, n_valid = pad_train(x_num, x_cat, self.block)
+        # the fused pallas kernel serves the numeric-only case on real TPU
+        # (the flop-heavy sifarish role); mixed categorical stays on jnp
+        from avenir_tpu.ops.pallas_knn import pallas_available
+
+        if use_pallas:
+            # explicit opt-in still requires the kernel's preconditions
+            if x_cat is not None or x_num.shape[1] == 0:
+                raise ValueError(
+                    "pallas KNN kernel handles numeric-only features; "
+                    "this schema has categorical features")
+            if metric not in ("euclidean", "manhattan"):
+                raise ValueError(f"pallas KNN kernel: unsupported metric {metric!r}")
+        self.use_pallas = (
+            use_pallas if use_pallas is not None
+            else (pallas_available() and x_cat is None and x_num.shape[1] > 0
+                  and metric in ("euclidean", "manhattan") and not approx)
+        )
+        if self.use_pallas:
+            # pre-normalize by ranges once; pad to the kernel block
+            # (256x8192 f32 tile = 8 MB VMEM, the measured sweet spot)
+            x_num = x_num / np.maximum(ranges, 1e-9)
+            self.block = max(128, min(pad_rows(len(train), 128), 8192))
+            t_num, x_cat, n_valid = pad_train(x_num, None, self.block)
+        else:
+            t_num, x_cat, n_valid = pad_train(x_num, x_cat, self.block)
         self.t_num = jnp.asarray(t_num) if t_num is not None else None
-        self.t_cat = jnp.asarray(t_cat) if t_cat is not None else None
+        self.t_cat = jnp.asarray(x_cat) if x_cat is not None else None
         self.cat_bins = bins
         self.ranges = jnp.asarray(ranges) if ranges.size else None
         self.n_valid = n_valid
@@ -126,6 +151,20 @@ class NeighborIndex:
     def neighbors(self, test: Dataset) -> Tuple[jnp.ndarray, jnp.ndarray]:
         """(dist [nq,k], train index [nq,k]); unfillable slots are (+inf, -1)."""
         q_num, _, q_cat, _ = _extract(test)
+        if self.use_pallas:
+            from avenir_tpu.ops.pallas_knn import knn_topk_pallas
+
+            q = q_num / np.maximum(np.asarray(self.ranges), 1e-9)
+            bq = 256
+            nq = q.shape[0]
+            pad = (-nq) % bq
+            if pad:
+                q = np.concatenate([q, np.zeros((pad, q.shape[1]), q.dtype)])
+            dist, idx = knn_topk_pallas(
+                jnp.asarray(q), self.t_num, k=self.k, block_q=bq,
+                block_t=self.block, metric=self.metric,
+                n_valid=self.n_valid)
+            return dist[:nq], idx[:nq]
         return blocked_topk_neighbors(
             jnp.asarray(q_num) if self.t_num is not None else None,
             self.t_num,

@@ -155,8 +155,12 @@ def bayesian_distribution(cfg: JobConfig, inputs: List[str], output: str) -> Job
 
         texts, labels = [], []
         for path in inputs:
-            for ln in _read_lines(path):
-                text, _, cls = ln.rpartition(cfg.field_delim_regex)
+            for lineno, ln in enumerate(_read_lines(path), start=1):
+                text, sep, cls = ln.rpartition(cfg.field_delim_regex)
+                if not sep:
+                    raise ValueError(
+                        f"{path}:{lineno}: text-mode row has no "
+                        f"{cfg.field_delim_regex!r} delimiter (want text,classVal)")
                 texts.append(text)
                 labels.append(cls.strip())
         tmodel = TextNaiveBayes().fit(texts, labels)

@@ -97,16 +97,22 @@ def bench_knn():
     import jax.numpy as jnp
     from avenir_tpu.models.knn import _vote
     from avenir_tpu.ops.distance import blocked_topk_neighbors
+    from avenir_tpu.ops.pallas_knn import knn_topk_pallas, pallas_available
 
     rng = np.random.default_rng(2)
     q = jnp.asarray(rng.normal(size=(KNN_QUERIES, KNN_DIM)).astype(np.float32))
     t = jnp.asarray(rng.normal(size=(KNN_TRAIN, KNN_DIM)).astype(np.float32))
     t_labels = jnp.asarray(rng.integers(0, 2, KNN_TRAIN).astype(np.int32))
+    use_pallas = pallas_available()
 
     def step():
-        dist, idx = blocked_topk_neighbors(
-            q, t, k=KNN_K, block=KNN_BLOCK, metric="euclidean"
-        )
+        if use_pallas:
+            # fused VMEM distance-tile + iterative-min top-k kernel
+            dist, idx = knn_topk_pallas(q, t, k=KNN_K, metric="euclidean")
+        else:
+            dist, idx = blocked_topk_neighbors(
+                q, t, k=KNN_K, block=KNN_BLOCK, metric="euclidean"
+            )
         scores = _vote(dist, t_labels[idx], jnp.ones_like(dist),
                        "gaussian", 30.0, 2, False, False)
         return scores
