@@ -53,12 +53,28 @@ class Dataset:
         schema: FeatureSchema,
         delim: str = ",",
         keep_raw: bool = False,
+        engine: str = "auto",
     ) -> "Dataset":
         """Parse CSV lines (a path, a text blob, or an iterable of lines)
         into columns. Unknown categorical values raise — the schema declares
         the full cardinality, same contract as the reference. A string is
         treated as a file path if such a file exists, otherwise as content
-        (content must contain a newline or the delimiter)."""
+        (content must contain a newline or the delimiter).
+
+        engine: 'auto' uses the native C++ parser (avenir_tpu/native) when
+        built and applicable (path/blob source, single-char delimiter, no
+        keep_raw), 'native' requires it, 'python' forces the row parser."""
+        native_ok = (not keep_raw and isinstance(source, str)
+                     and len(delim.encode()) == 1)
+        if engine == "native" and not native_ok:
+            raise ValueError(
+                "engine='native' requires a path/blob source, a single-byte "
+                "delimiter, and keep_raw=False")
+        if engine in ("auto", "native") and native_ok:
+            ds = cls._from_csv_native(source, schema, delim,
+                                      required=engine == "native")
+            if ds is not None:
+                return ds
         if isinstance(source, str):
             if os.path.exists(source):
                 lines: Iterable[str] = open(source, "r")
@@ -80,6 +96,45 @@ class Dataset:
         if hasattr(lines, "close") and lines is not source:
             lines.close()
         return cls.from_rows(rows, schema, keep_raw=keep_raw)
+
+    @classmethod
+    def _from_csv_native(cls, source: str, schema: FeatureSchema,
+                         delim: str, required: bool) -> Optional["Dataset"]:
+        """Native one-pass columnar parse; None when unavailable/inapplicable
+        (caller falls through to the Python parser)."""
+        from avenir_tpu.native.ingest import native_available, parse_csv_native
+
+        if not native_available():
+            if required:
+                raise RuntimeError("native CSV ingest unavailable")
+            return None
+        if os.path.exists(source):
+            with open(source, "rb") as fh:
+                data = fh.read()
+        elif "\n" in source or delim in source or source == "":
+            data = source.encode()
+        else:
+            raise FileNotFoundError(f"no such CSV file: {source!r}")
+        numeric = [f.ordinal for f in schema.fields if f.is_numeric]
+        categorical = [(f.ordinal, f.cardinality)
+                       for f in schema.fields if f.is_categorical]
+        strings = [f.ordinal for f in schema.fields
+                   if not f.is_numeric and not f.is_categorical]
+        try:
+            n, columns = parse_csv_native(data, delim, numeric, categorical,
+                                          strings)
+        except ValueError as e:
+            # align the error text with the Python parser (field name)
+            msg = str(e)
+            for fld in schema.fields:
+                if msg.endswith(f"ordinal {fld.ordinal}") or \
+                        f"ordinal {fld.ordinal} " in msg:
+                    raise ValueError(
+                        msg.split(" not in ")[0]
+                        + f" not in declared cardinality of field {fld.name!r}"
+                    ) from None
+            raise
+        return cls(schema, columns, n)
 
     @classmethod
     def from_rows(

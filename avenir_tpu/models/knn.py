@@ -120,12 +120,20 @@ class NeighborIndex:
 
         if use_pallas:
             # explicit opt-in still requires the kernel's preconditions
+            if not pallas_available():
+                raise RuntimeError(
+                    "pallas KNN kernel needs a TPU backend "
+                    "(jax.default_backend() != 'tpu')")
             if x_cat is not None or x_num.shape[1] == 0:
                 raise ValueError(
                     "pallas KNN kernel handles numeric-only features; "
                     "this schema has categorical features")
             if metric not in ("euclidean", "manhattan"):
                 raise ValueError(f"pallas KNN kernel: unsupported metric {metric!r}")
+            if approx:
+                raise ValueError(
+                    "pallas KNN kernel is exact; approx=True needs the "
+                    "jnp path (approx_min_k)")
         self.use_pallas = (
             use_pallas if use_pallas is not None
             else (pallas_available() and x_cat is None and x_num.shape[1] > 0

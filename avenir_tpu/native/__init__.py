@@ -1,0 +1,1 @@
+from avenir_tpu.native.ingest import native_available, parse_csv_native

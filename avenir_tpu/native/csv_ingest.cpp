@@ -1,0 +1,237 @@
+// Fast columnar CSV ingest for avenir_tpu.
+//
+// The reference's ingest is the Hadoop InputFormat + per-mapper
+// line.split() (e.g. bayesian/BayesianDistribution.java:137); the TPU
+// framework replaces HDFS splits with host CSV -> device arrays, and this
+// library makes that host step native: one pass over the byte buffer
+// producing float32 numeric columns and dictionary-encoded int32
+// categorical columns directly (no Python string objects per field).
+//
+// Exposed via ctypes (no pybind11 in the image); see
+// avenir_tpu/native/ingest.py for the Python contract.
+
+#include <cstdint>
+#include <cstdlib>
+#include <cstring>
+#include <string>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+// Trim ASCII whitespace in [b, e).
+inline void trim(const char*& b, const char*& e) {
+    while (b < e && (*b == ' ' || *b == '\t' || *b == '\r')) ++b;
+    while (e > b && (e[-1] == ' ' || e[-1] == '\t' || e[-1] == '\r')) --e;
+}
+
+struct Vocab {
+    std::unordered_map<std::string, int32_t> index;
+};
+
+}  // namespace
+
+extern "C" {
+
+// Count non-empty lines.
+int64_t csv_count_rows(const char* buf, int64_t len) {
+    int64_t rows = 0;
+    const char* p = buf;
+    const char* end = buf + len;
+    while (p < end) {
+        const char* nl = static_cast<const char*>(memchr(p, '\n', end - p));
+        const char* line_end = nl ? nl : end;
+        const char* b = p;
+        const char* e = line_end;
+        trim(b, e);
+        if (e > b) ++rows;
+        p = nl ? nl + 1 : end;
+    }
+    return rows;
+}
+
+// Parse the buffer in one pass.
+//
+// num_ords / n_num: field ordinals to parse as float32 into num_out
+//   (column-major: num_out[c * n_rows + r]); empty/invalid tokens -> NaN.
+// cat_ords / n_cat: field ordinals to dictionary-encode into cat_out
+//   (column-major int32). The vocabulary for categorical column c is
+//   vocab_blob[vocab_off[vc] .. ] holding vocab_counts[c] zero-terminated
+//   strings back to back (vc = running string index). Unknown values
+//   write -1 and the row/ordinal of the first failure into err_row/err_ord.
+// id_ord >= 0: copy that field's bytes into id_out separated by '\n'
+//   (caller sizes id_out via csv_column_bytes); id_len receives the
+//   written length.
+//
+// Returns the number of parsed rows, or -1 on unknown categorical value.
+int64_t csv_parse(const char* buf, int64_t len, char delim, int32_t max_ord,
+                  const int32_t* num_ords, int32_t n_num, float* num_out,
+                  const int32_t* cat_ords, int32_t n_cat,
+                  const char* vocab_blob, const int32_t* vocab_counts,
+                  int32_t* cat_out, int64_t n_rows,
+                  int64_t* err_row, int32_t* err_ord) {
+    // ordinal -> (kind, slot): kind 0 none, 1 numeric, 2 categorical
+    std::vector<int8_t> kind(max_ord + 1, 0);
+    std::vector<int32_t> slot(max_ord + 1, -1);
+    for (int32_t i = 0; i < n_num; ++i) {
+        kind[num_ords[i]] = 1;
+        slot[num_ords[i]] = i;
+    }
+    std::vector<Vocab> vocabs(n_cat);
+    const char* vp = vocab_blob;
+    for (int32_t c = 0; c < n_cat; ++c) {
+        kind[cat_ords[c]] = 2;
+        slot[cat_ords[c]] = c;
+        for (int32_t v = 0; v < vocab_counts[c]; ++v) {
+            std::string s(vp);
+            vocabs[c].index.emplace(std::move(s), v);
+            vp += strlen(vp) + 1;
+        }
+    }
+
+    const char* p = buf;
+    const char* end = buf + len;
+    int64_t row = 0;
+    while (p < end && row < n_rows) {
+        const char* nl = static_cast<const char*>(memchr(p, '\n', end - p));
+        const char* line_end = nl ? nl : end;
+        {
+            const char* b = p;
+            const char* e = line_end;
+            trim(b, e);
+            if (e <= b) {  // blank line
+                p = nl ? nl + 1 : end;
+                continue;
+            }
+        }
+        int32_t ord = 0;
+        const char* fb = p;
+        for (const char* q = p; q <= line_end; ++q) {
+            if (q == line_end || *q == delim) {
+                if (ord <= max_ord && kind[ord]) {
+                    const char* b = fb;
+                    const char* e = q;
+                    trim(b, e);
+                    if (kind[ord] == 1) {
+                        float v;
+                        if (e == b) {
+                            v = __builtin_nanf("");
+                        } else {
+                            char* endp = nullptr;
+                            std::string tok(b, e - b);
+                            v = strtof(tok.c_str(), &endp);
+                            if (endp == tok.c_str() || *endp != '\0') {
+                                // invalid non-empty numeric: fail fast like
+                                // the Python parser's float() (-2 status)
+                                *err_row = row;
+                                *err_ord = ord;
+                                return -2;
+                            }
+                        }
+                        num_out[static_cast<int64_t>(slot[ord]) * n_rows + row] = v;
+                    } else {
+                        std::string tok(b, e - b);
+                        auto& vc = vocabs[slot[ord]];
+                        auto it = vc.index.find(tok);
+                        if (it == vc.index.end()) {
+                            *err_row = row;
+                            *err_ord = ord;
+                            return -1;
+                        }
+                        cat_out[static_cast<int64_t>(slot[ord]) * n_rows + row] =
+                            it->second;
+                    }
+                }
+                ++ord;
+                fb = q + 1;
+            }
+        }
+        ++row;
+        p = nl ? nl + 1 : end;
+    }
+    return row;
+}
+
+// Total bytes needed by csv_extract_column's output (tokens + '\n' each).
+int64_t csv_column_bytes(const char* buf, int64_t len, char delim,
+                         int32_t ordinal) {
+    int64_t total = 0;
+    const char* p = buf;
+    const char* end = buf + len;
+    while (p < end) {
+        const char* nl = static_cast<const char*>(memchr(p, '\n', end - p));
+        const char* line_end = nl ? nl : end;
+        const char* b = p;
+        const char* e = line_end;
+        trim(b, e);
+        if (e > b) {
+            int32_t ord = 0;
+            const char* fb = p;
+            bool found = false;
+            for (const char* q = p; q <= line_end; ++q) {
+                if (q == line_end || *q == delim) {
+                    if (ord == ordinal) {
+                        const char* tb = fb;
+                        const char* te = q;
+                        trim(tb, te);
+                        total += (te - tb) + 1;
+                        found = true;
+                        break;
+                    }
+                    ++ord;
+                    fb = q + 1;
+                }
+            }
+            if (!found) total += 1;  // short row: empty token keeps alignment
+        }
+        p = nl ? nl + 1 : end;
+    }
+    return total;
+}
+
+// Extract one column's tokens, '\n'-separated, into out (cap bytes).
+// Returns bytes written, or -1 if cap is too small.
+int64_t csv_extract_column(const char* buf, int64_t len, char delim,
+                           int32_t ordinal, char* out, int64_t cap) {
+    int64_t w = 0;
+    const char* p = buf;
+    const char* end = buf + len;
+    while (p < end) {
+        const char* nl = static_cast<const char*>(memchr(p, '\n', end - p));
+        const char* line_end = nl ? nl : end;
+        const char* b = p;
+        const char* e = line_end;
+        trim(b, e);
+        if (e > b) {
+            int32_t ord = 0;
+            const char* fb = p;
+            bool found = false;
+            for (const char* q = p; q <= line_end; ++q) {
+                if (q == line_end || *q == delim) {
+                    if (ord == ordinal) {
+                        const char* tb = fb;
+                        const char* te = q;
+                        trim(tb, te);
+                        int64_t n = te - tb;
+                        if (w + n + 1 > cap) return -1;
+                        memcpy(out + w, tb, n);
+                        w += n;
+                        out[w++] = '\n';
+                        found = true;
+                        break;
+                    }
+                    ++ord;
+                    fb = q + 1;
+                }
+            }
+            if (!found) {  // short row: empty token keeps row alignment
+                if (w + 1 > cap) return -1;
+                out[w++] = '\n';
+            }
+        }
+        p = nl ? nl + 1 : end;
+    }
+    return w;
+}
+
+}  // extern "C"

@@ -1,0 +1,158 @@
+"""ctypes binding for the native CSV ingest (csv_ingest.cpp).
+
+The shared library builds lazily with g++ on first use (no pybind11 in the
+image; plain `extern "C"` + ctypes per the environment constraints) and is
+cached next to the source. Everything degrades to the Python parser when a
+compiler is unavailable — `native_available()` gates the fast path.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_DIR, "csv_ingest.cpp")
+_LIB = os.path.join(_DIR, "libcsv_ingest.so")
+
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_build_failed = False
+
+
+def _build() -> Optional[ctypes.CDLL]:
+    global _build_failed
+    if not os.path.exists(_LIB) or (
+        os.path.getmtime(_LIB) < os.path.getmtime(_SRC)
+    ):
+        try:
+            subprocess.run(
+                ["g++", "-O3", "-march=native", "-shared", "-fPIC",
+                 "-o", _LIB, _SRC],
+                check=True, capture_output=True, timeout=120,
+            )
+        except (OSError, subprocess.SubprocessError):
+            _build_failed = True
+            return None
+    try:
+        lib = ctypes.CDLL(_LIB)
+    except OSError:
+        # corrupt / wrong-arch cached .so: degrade to the Python parser
+        _build_failed = True
+        return None
+    c_char_p = ctypes.c_char_p
+    i64, i32 = ctypes.c_int64, ctypes.c_int32
+    p_i32 = np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS")
+    p_f32 = np.ctypeslib.ndpointer(np.float32, flags="C_CONTIGUOUS")
+    p_i64 = ctypes.POINTER(i64)
+
+    lib.csv_count_rows.restype = i64
+    lib.csv_count_rows.argtypes = [c_char_p, i64]
+    lib.csv_parse.restype = i64
+    lib.csv_parse.argtypes = [
+        c_char_p, i64, ctypes.c_char, i32,
+        p_i32, i32, p_f32,
+        p_i32, i32, c_char_p, p_i32, p_i32, i64,
+        p_i64, ctypes.POINTER(i32),
+    ]
+    lib.csv_column_bytes.restype = i64
+    lib.csv_column_bytes.argtypes = [c_char_p, i64, ctypes.c_char, i32]
+    lib.csv_extract_column.restype = i64
+    lib.csv_extract_column.argtypes = [c_char_p, i64, ctypes.c_char, i32,
+                                       ctypes.c_char_p, i64]
+    return lib
+
+
+def _get_lib() -> Optional[ctypes.CDLL]:
+    global _lib
+    if _lib is None and not _build_failed:
+        with _lock:
+            if _lib is None and not _build_failed:
+                _lib = _build()
+    return _lib
+
+
+def native_available() -> bool:
+    return _get_lib() is not None
+
+
+def parse_csv_native(
+    data: bytes,
+    delim: str,
+    numeric_ordinals: List[int],
+    categorical: List[Tuple[int, List[str]]],   # (ordinal, cardinality)
+    string_ordinals: List[int],
+) -> Tuple[int, Dict[int, np.ndarray]]:
+    """One native pass: (n_rows, {ordinal: column array}).
+
+    Numeric columns come back float32 (missing -> NaN), categorical int32
+    codes against the given cardinalities (unknown value raises ValueError,
+    matching the Python parser's contract), string/id columns as numpy
+    object arrays."""
+    lib = _get_lib()
+    if lib is None:
+        raise RuntimeError("native CSV ingest unavailable (no g++?)")
+    d = delim.encode()[0:1]
+    n = int(lib.csv_count_rows(data, len(data)))
+    columns: Dict[int, np.ndarray] = {}
+
+    num_ords = np.asarray(numeric_ordinals, np.int32)
+    cat_ords = np.asarray([o for o, _ in categorical], np.int32)
+    vocab_blob = b"".join(
+        v.encode() + b"\0" for _, card in categorical for v in card
+    )
+    vocab_counts = np.asarray([len(card) for _, card in categorical], np.int32)
+    all_ords = list(numeric_ordinals) + [o for o, _ in categorical] + list(
+        string_ordinals)
+    max_ord = max(all_ords) if all_ords else 0
+
+    # prefill sentinels: rows shorter than the schema leave numeric NaN
+    # (matching the Python parser) and categorical -1 (checked below)
+    num_out = np.full((len(num_ords), n), np.nan, np.float32)
+    cat_out = np.full((len(cat_ords), n), -1, np.int32)
+    err_row = ctypes.c_int64(-1)
+    err_ord = ctypes.c_int32(-1)
+    got = int(lib.csv_parse(
+        data, len(data), d, np.int32(max_ord),
+        num_ords, len(num_ords), num_out,
+        cat_ords, len(cat_ords), vocab_blob, vocab_counts, cat_out,
+        np.int64(n), ctypes.byref(err_row), ctypes.byref(err_ord),
+    ))
+    if got < 0:
+        # recover the offending token for the standard error message
+        bad = _extract_column(lib, data, d, int(err_ord.value))
+        tok = bad[err_row.value] if err_row.value < len(bad) else "?"
+        if got == -2:
+            raise ValueError(
+                f"could not convert string to float: {tok!r} at ordinal "
+                f"{err_ord.value}")
+        raise ValueError(
+            f"value {tok!r} not in declared cardinality of ordinal "
+            f"{err_ord.value}")
+    for i, o in enumerate(numeric_ordinals):
+        columns[o] = num_out[i]
+    for i, (o, _) in enumerate(categorical):
+        if (cat_out[i] < 0).any():
+            row = int(np.argmax(cat_out[i] < 0))
+            raise ValueError(
+                f"value '' not in declared cardinality of ordinal {o} "
+                f"(row {row} is short)")
+        columns[o] = cat_out[i]
+    for o in string_ordinals:
+        columns[o] = np.array(_extract_column(lib, data, d, o), dtype=object)
+    return got, columns
+
+
+def _extract_column(lib, data: bytes, d: bytes, ordinal: int) -> List[str]:
+    cap = int(lib.csv_column_bytes(data, len(data), d, np.int32(ordinal)))
+    buf = ctypes.create_string_buffer(max(cap, 1))
+    w = int(lib.csv_extract_column(data, len(data), d, np.int32(ordinal),
+                                   buf, np.int64(cap)))
+    if w <= 0:
+        return []
+    return buf.raw[:w].decode().split("\n")[:-1]

@@ -1,0 +1,137 @@
+"""Native C++ CSV ingest vs the Python parser (parity + error contract)."""
+
+import numpy as np
+import pytest
+
+from avenir_tpu.core.dataset import Dataset
+from avenir_tpu.data import churn_schema, generate_churn
+from avenir_tpu.native import native_available
+
+pytestmark = pytest.mark.skipif(
+    not native_available(), reason="g++ unavailable; native ingest not built")
+
+
+def parse_both(csv_text, schema, **kw):
+    py = Dataset.from_csv(csv_text, schema, engine="python", **kw)
+    nat = Dataset.from_csv(csv_text, schema, engine="native", **kw)
+    return py, nat
+
+
+def test_columns_match_python_parser():
+    schema = churn_schema()
+    csv_text = generate_churn(500, seed=9, as_csv=True)
+    py, nat = parse_both(csv_text, schema)
+    assert len(py) == len(nat) == 500
+    for fld in schema.fields:
+        a, b = py.column(fld.ordinal), nat.column(fld.ordinal)
+        if fld.is_numeric:
+            np.testing.assert_allclose(a, b, rtol=1e-6)
+        else:
+            assert list(a) == list(b), fld.name
+    np.testing.assert_array_equal(py.labels(), nat.labels())
+    codes_p, bins_p = py.feature_codes()
+    codes_n, bins_n = nat.feature_codes()
+    assert bins_p == bins_n
+    np.testing.assert_array_equal(codes_p, codes_n)
+
+
+def test_file_path_source(tmp_path):
+    schema = churn_schema()
+    p = str(tmp_path / "churn.csv")
+    with open(p, "w") as fh:
+        fh.write(generate_churn(100, seed=10, as_csv=True))
+    nat = Dataset.from_csv(p, schema, engine="native")
+    py = Dataset.from_csv(p, schema, engine="python")
+    assert len(nat) == len(py) == 100
+    assert list(nat.ids()) == list(py.ids())
+
+
+def test_unknown_categorical_raises_with_field_name():
+    schema = churn_schema()
+    bad = "C1,low,med,low,good,50,open\nC2,BOGUS,med,low,good,50,open\n"
+    with pytest.raises(ValueError, match="minUsed"):
+        Dataset.from_csv(bad, schema, engine="native")
+    with pytest.raises(ValueError, match="minUsed"):
+        Dataset.from_csv(bad, schema, engine="python")
+
+
+def test_short_row_raises():
+    schema = churn_schema()
+    bad = "C1,low,med\n"
+    with pytest.raises(ValueError):
+        Dataset.from_csv(bad, schema, engine="native")
+
+
+def test_missing_numeric_is_nan():
+    schema = churn_schema()
+    csv_text = "C1,low,med,low,good,,open\n"
+    nat = Dataset.from_csv(csv_text, schema, engine="native")
+    assert np.isnan(nat.column(5)[0])
+
+
+def test_blank_lines_and_crlf():
+    schema = churn_schema()
+    csv_text = "C1,low,med,low,good,50,open\r\n\n  \nC2,high,low,med,poor,10,closed\r\n"
+    py, nat = parse_both(csv_text, schema)
+    assert len(py) == len(nat) == 2
+    assert list(nat.ids()) == ["C1", "C2"]
+
+
+def test_gapped_ordinals():
+    from avenir_tpu.data import call_hangup_schema, generate_call_hangup
+
+    schema = call_hangup_schema()
+    csv_text = generate_call_hangup(200, seed=11, as_csv=True)
+    py, nat = parse_both(csv_text, schema)
+    for fld in schema.fields:
+        a, b = py.column(fld.ordinal), nat.column(fld.ordinal)
+        if fld.is_numeric:
+            np.testing.assert_allclose(a, b)
+        else:
+            assert list(a) == list(b)
+
+
+def test_short_row_keeps_string_column_alignment():
+    """A row shorter than a string ordinal must yield an empty token, not
+    shift later rows' ids."""
+    from avenir_tpu.core.schema import FeatureSchema
+    schema = FeatureSchema.from_json({"fields": [
+        {"name": "a", "ordinal": 0, "dataType": "double", "feature": True},
+        {"name": "id", "ordinal": 2, "id": True, "dataType": "string"},
+    ]})
+    csv_text = "1,x,id1\n2,y\n3,z,id3\n"
+    nat = Dataset.from_csv(csv_text, schema, engine="native")
+    assert list(nat.ids()) == ["id1", "", "id3"]
+    py = Dataset.from_csv(csv_text, schema, engine="python")
+    assert list(py.ids()) == list(nat.ids())
+
+
+def test_invalid_numeric_raises_like_python():
+    from avenir_tpu.core.schema import FeatureSchema
+    schema = FeatureSchema.from_json({"fields": [
+        {"name": "x", "ordinal": 0, "dataType": "double", "feature": True},
+        {"name": "y", "ordinal": 1, "dataType": "string"},
+    ]})
+    bad = "1.5,ok\nabc,ok\n"
+    with pytest.raises(ValueError, match="float"):
+        Dataset.from_csv(bad, schema, engine="native")
+    with pytest.raises(ValueError):
+        Dataset.from_csv(bad, schema, engine="python")
+
+
+def test_native_required_contract_errors():
+    schema = churn_schema()
+    csv_text = generate_churn(5, seed=1, as_csv=True)
+    with pytest.raises(ValueError, match="native"):
+        Dataset.from_csv(csv_text, schema, engine="native", keep_raw=True)
+    with pytest.raises(ValueError, match="native"):
+        Dataset.from_csv(csv_text.splitlines(), schema, engine="native")
+
+
+def test_auto_engine_used_by_default(tmp_path):
+    """auto engine gives identical datasets to python on a normal file."""
+    schema = churn_schema()
+    csv_text = generate_churn(50, seed=12, as_csv=True)
+    auto = Dataset.from_csv(csv_text, schema)
+    py = Dataset.from_csv(csv_text, schema, engine="python")
+    np.testing.assert_array_equal(auto.labels(), py.labels())
