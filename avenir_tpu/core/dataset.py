@@ -64,6 +64,9 @@ class Dataset:
         engine: 'auto' uses the native C++ parser (avenir_tpu/native) when
         built and applicable (path/blob source, single-char delimiter, no
         keep_raw), 'native' requires it, 'python' forces the row parser."""
+        if engine not in ("auto", "native", "python"):
+            raise ValueError(f"unknown CSV engine {engine!r} "
+                             "(want auto, native, or python)")
         native_ok = (not keep_raw and isinstance(source, str)
                      and len(delim.encode()) == 1)
         if engine == "native" and not native_ok:
@@ -124,15 +127,17 @@ class Dataset:
             n, columns = parse_csv_native(data, delim, numeric, categorical,
                                           strings)
         except ValueError as e:
-            # align the error text with the Python parser (field name)
+            # align cardinality errors with the Python parser (field name);
+            # other ValueErrors (e.g. invalid numerics) pass through as-is
             msg = str(e)
-            for fld in schema.fields:
-                if msg.endswith(f"ordinal {fld.ordinal}") or \
-                        f"ordinal {fld.ordinal} " in msg:
-                    raise ValueError(
-                        msg.split(" not in ")[0]
-                        + f" not in declared cardinality of field {fld.name!r}"
-                    ) from None
+            if " not in declared cardinality" in msg:
+                for fld in schema.fields:
+                    if msg.endswith(f"ordinal {fld.ordinal}") or \
+                            f"ordinal {fld.ordinal} " in msg:
+                        raise ValueError(
+                            msg.split(" not in ")[0]
+                            + f" not in declared cardinality of field "
+                            f"{fld.name!r}") from None
             raise
         return cls(schema, columns, n)
 

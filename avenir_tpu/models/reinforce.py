@@ -132,6 +132,98 @@ class ReinforcementLearner:
     def _random_action(self) -> Action:
         return self.actions[int(self.rng.integers(len(self.actions)))]
 
+    # ----------------------------------------------------- checkpoint state
+    _STATE_SKIP = {"actions", "action_index", "reward_stats", "rng", "config"}
+
+    @staticmethod
+    def _encode_state(v):
+        """JSON-safe recursive encoding; int dict keys (histogram bins)
+        get an explicit marker so decode restores them as ints, not the
+        strings JSON would silently make them."""
+        if isinstance(v, dict):
+            enc = {str(k): ReinforcementLearner._encode_state(x)
+                   for k, x in v.items()}
+            if v and all(isinstance(k, int) for k in v):
+                return {"__intkeys__": enc}
+            return enc
+        if isinstance(v, (list, tuple)):
+            return [ReinforcementLearner._encode_state(x) for x in v]
+        return v
+
+    @staticmethod
+    def _decode_state(v):
+        if isinstance(v, dict):
+            if set(v) == {"__intkeys__"}:
+                return {int(k): ReinforcementLearner._decode_state(x)
+                        for k, x in v["__intkeys__"].items()}
+            if set(v) == {"__ndarray__", "dtype"}:
+                return np.asarray(v["__ndarray__"], dtype=v["dtype"])
+            return {k: ReinforcementLearner._decode_state(x)
+                    for k, x in v.items()}
+        if isinstance(v, list):
+            return [ReinforcementLearner._decode_state(x) for x in v]
+        return v
+
+    def save_state(self, path: str) -> None:
+        """Checkpoint the learner to JSON: per-action trial/reward counts,
+        reward stats, and every numeric attribute of the concrete learner
+        (weights, preferences, decayed epsilons, ...). The reference keeps
+        this state only inside the Storm bolt's JVM (SURVEY §5 — Redis
+        holds queues, not models); a file checkpoint makes the streaming
+        loop resumable."""
+        import json
+
+        extra = {}
+        for k, v in self.__dict__.items():
+            if k in self._STATE_SKIP:
+                continue
+            if isinstance(v, np.ndarray):
+                extra[k] = {"__ndarray__": v.tolist(), "dtype": str(v.dtype)}
+            else:
+                # anything JSON-representable is state worth carrying:
+                # scalars, lists, and the dict-valued evidence the samplers
+                # keep (reward_samples, histograms, epoch counts, ...)
+                enc = self._encode_state(v)
+                try:
+                    json.dumps(enc)
+                except (TypeError, ValueError):
+                    continue
+                extra[k] = enc
+        state = {
+            "learner": type(self).__name__,
+            "actions": [[a.id, a.trial_count, a.total_reward]
+                        for a in self.actions],
+            "reward_stats": {aid: [st.count, st.total]
+                             for aid, st in self.reward_stats.items()},
+            "extra": extra,
+        }
+        with open(path, "w") as fh:
+            json.dump(state, fh)
+
+    def load_state(self, path: str) -> "ReinforcementLearner":
+        """Restore a checkpoint written by save_state into this (same-type,
+        same-action-set) learner."""
+        import json
+
+        with open(path) as fh:
+            state = json.load(fh)
+        if state["learner"] != type(self).__name__:
+            raise ValueError(
+                f"checkpoint is for {state['learner']}, not {type(self).__name__}")
+        by_id = {a[0]: a for a in state["actions"]}
+        for a in self.actions:
+            if a.id not in by_id:
+                raise ValueError(f"checkpoint missing action {a.id!r}")
+            _, a.trial_count, a.total_reward = by_id[a.id]
+        self.reward_stats = {}
+        for aid, (count, total) in state["reward_stats"].items():
+            st = _Stat()
+            st.count, st.total = count, total
+            self.reward_stats[aid] = st
+        for k, v in state["extra"].items():
+            self.__dict__[k] = self._decode_state(v)
+        return self
+
 
 # ---------------------------------------------------------------------------
 # Learners

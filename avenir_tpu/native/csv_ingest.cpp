@@ -59,11 +59,10 @@ int64_t csv_count_rows(const char* buf, int64_t len) {
 //   vocab_blob[vocab_off[vc] .. ] holding vocab_counts[c] zero-terminated
 //   strings back to back (vc = running string index). Unknown values
 //   write -1 and the row/ordinal of the first failure into err_row/err_ord.
-// id_ord >= 0: copy that field's bytes into id_out separated by '\n'
-//   (caller sizes id_out via csv_column_bytes); id_len receives the
-//   written length.
+// String/id columns are extracted separately via csv_extract_column.
 //
-// Returns the number of parsed rows, or -1 on unknown categorical value.
+// Returns the number of parsed rows, -1 on unknown categorical value, or
+// -2 on an invalid non-empty numeric token (err_row/err_ord locate it).
 int64_t csv_parse(const char* buf, int64_t len, char delim, int32_t max_ord,
                   const int32_t* num_ords, int32_t n_num, float* num_out,
                   const int32_t* cat_ords, int32_t n_cat,

@@ -1,0 +1,143 @@
+"""Tracing / profiling / running stats.
+
+The reference has no tracing or profiling at all — only log4j debug flags
+and Hadoop counters (SURVEY §5: "New framework: jax.profiler traces +
+per-phase wall clock; this is green-field"). This module is that
+green-field piece:
+
+- PhaseTimer: named per-phase wall-clock accounting for multi-stage jobs
+  (the timing analog of the reference's per-job Hadoop counter groups).
+- trace(): context manager around jax.profiler for TensorBoard-readable
+  device traces of a region.
+- RunningStats: mergeable count/mean/variance/min/max accumulator (the
+  chombo SimpleStat role, SURVEY §0 dependency table) — moments add, so
+  shard results combine exactly like the device psum path.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import math
+import time
+from dataclasses import dataclass, field
+from typing import Dict, Iterator, List, Optional, Tuple
+
+
+class PhaseTimer:
+    """Accumulated wall clock per named phase.
+
+    with timer.phase("ingest"): ...
+    with timer.phase("train"): ...
+    timer.report() -> {"ingest": seconds, ...}
+    """
+
+    def __init__(self):
+        self.totals: Dict[str, float] = {}
+        self.counts: Dict[str, int] = {}
+        self._order: List[str] = []
+
+    @contextlib.contextmanager
+    def phase(self, name: str) -> Iterator[None]:
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            dt = time.perf_counter() - t0
+            if name not in self.totals:
+                self._order.append(name)
+                self.totals[name] = 0.0
+                self.counts[name] = 0
+            self.totals[name] += dt
+            self.counts[name] += 1
+
+    def report(self) -> Dict[str, float]:
+        return {name: self.totals[name] for name in self._order}
+
+    def summary(self) -> str:
+        total = sum(self.totals.values()) or 1.0
+        lines = []
+        for name in self._order:
+            t = self.totals[name]
+            lines.append(f"{name:>20s}  {t:9.3f}s  {100 * t / total:5.1f}%  "
+                         f"x{self.counts[name]}")
+        return "\n".join(lines)
+
+
+@contextlib.contextmanager
+def trace(log_dir: str) -> Iterator[None]:
+    """jax.profiler device trace of the enclosed region, written for
+    TensorBoard / xprof. No-ops cleanly if the profiler can't start (e.g.
+    an already-active trace)."""
+    import jax
+
+    started = False
+    try:
+        jax.profiler.start_trace(log_dir)
+        started = True
+    except Exception:
+        pass
+    try:
+        yield
+    finally:
+        if started:
+            try:
+                jax.profiler.stop_trace()
+            except Exception:
+                pass
+
+
+@dataclass
+class RunningStats:
+    """Mergeable first/second-moment accumulator (chombo SimpleStat role)."""
+
+    count: float = 0.0
+    total: float = 0.0
+    total_sq: float = 0.0
+    min_val: float = math.inf
+    max_val: float = -math.inf
+
+    def add(self, *values: float) -> "RunningStats":
+        for v in values:
+            self.count += 1
+            self.total += v
+            self.total_sq += v * v
+            self.min_val = min(self.min_val, v)
+            self.max_val = max(self.max_val, v)
+        return self
+
+    def add_array(self, arr) -> "RunningStats":
+        import numpy as np
+
+        a = np.asarray(arr, np.float64).ravel()
+        if a.size:
+            self.count += a.size
+            self.total += float(a.sum())
+            self.total_sq += float((a * a).sum())
+            self.min_val = min(self.min_val, float(a.min()))
+            self.max_val = max(self.max_val, float(a.max()))
+        return self
+
+    def merge(self, other: "RunningStats") -> "RunningStats":
+        """Moments are additive — the host-side analog of psum-merging
+        per-shard stats."""
+        self.count += other.count
+        self.total += other.total
+        self.total_sq += other.total_sq
+        self.min_val = min(self.min_val, other.min_val)
+        self.max_val = max(self.max_val, other.max_val)
+        return self
+
+    @property
+    def mean(self) -> float:
+        return self.total / self.count if self.count else 0.0
+
+    @property
+    def variance(self) -> float:
+        if self.count < 2:
+            return 0.0
+        m = self.mean
+        return max((self.total_sq - self.count * m * m) / (self.count - 1), 0.0)
+
+    @property
+    def std(self) -> float:
+        return math.sqrt(self.variance)

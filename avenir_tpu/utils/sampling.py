@@ -47,15 +47,15 @@ class Histogram:
     def uninitialized(cls, xmin, xmax, bin_width) -> "Histogram":
         return cls(xmin, bin_width, xmax=xmax)
 
+    def _bin_index(self, x) -> np.ndarray:
+        return np.clip(((np.asarray(x) - self.xmin) // self.bin_width)
+                       .astype(np.int64), 0, len(self.bins) - 1)
+
     def add(self, x: np.ndarray) -> None:
-        idx = np.clip(((np.asarray(x) - self.xmin) // self.bin_width)
-                      .astype(np.int64), 0, len(self.bins) - 1)
-        np.add.at(self.bins, idx, 1.0)
+        np.add.at(self.bins, self._bin_index(x), 1.0)
 
     def value(self, x) -> np.ndarray:
-        idx = np.clip(((np.asarray(x) - self.xmin) // self.bin_width)
-                      .astype(np.int64), 0, len(self.bins) - 1)
-        return self.bins[idx]
+        return self.bins[self._bin_index(x)]
 
     def bounded(self, x):
         return np.clip(x, self.xmin, self.xmax)
@@ -66,6 +66,23 @@ class Histogram:
     def normalized(self) -> np.ndarray:
         s = self.bins.sum()
         return self.bins / s if s > 0 else self.bins
+
+    def cum_distr(self) -> np.ndarray:
+        """Cumulative distribution over bins (stats.py cumDistr)."""
+        return np.cumsum(self.normalized())
+
+    def percentile(self, percent: float) -> float:
+        """Value at the given percentile (stats.py percentile)."""
+        if not 0 <= percent <= 100:
+            raise ValueError("percent must be in [0, 100]")
+        cum = self.cum_distr()
+        idx = int(np.searchsorted(cum, percent / 100.0))
+        idx = min(idx, len(self.bins) - 1)
+        return self.xmin + idx * self.bin_width
+
+    def cum_value(self, x) -> np.ndarray:
+        """Cumulative probability at value x (stats.py cumValue)."""
+        return self.cum_distr()[self._bin_index(x)]
 
 
 @dataclass

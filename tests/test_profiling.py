@@ -1,0 +1,76 @@
+"""PhaseTimer / trace / RunningStats / Histogram percentile utilities."""
+
+import math
+import time
+
+import numpy as np
+import pytest
+
+from avenir_tpu.utils.profiling import PhaseTimer, RunningStats, trace
+from avenir_tpu.utils.sampling import Histogram
+
+
+def test_phase_timer_accumulates():
+    t = PhaseTimer()
+    with t.phase("a"):
+        time.sleep(0.01)
+    with t.phase("b"):
+        time.sleep(0.005)
+    with t.phase("a"):
+        time.sleep(0.01)
+    rep = t.report()
+    assert list(rep) == ["a", "b"]
+    assert rep["a"] >= 0.018 and rep["b"] >= 0.004
+    assert t.counts["a"] == 2
+    assert "a" in t.summary() and "%" in t.summary()
+
+
+def test_trace_writes_profile(tmp_path):
+    import jax
+    import jax.numpy as jnp
+
+    d = str(tmp_path / "trace")
+    with trace(d):
+        jax.block_until_ready(jnp.ones((128, 128)) @ jnp.ones((128, 128)))
+    import os
+    found = [f for _, _, fs in os.walk(d) for f in fs]
+    assert found, "no trace files written"
+
+
+def test_running_stats_matches_numpy():
+    rng = np.random.default_rng(0)
+    x = rng.normal(3.0, 2.0, 1000)
+    rs = RunningStats().add_array(x)
+    assert rs.mean == pytest.approx(x.mean(), rel=1e-9)
+    assert rs.std == pytest.approx(x.std(ddof=1), rel=1e-9)
+    assert rs.min_val == x.min() and rs.max_val == x.max()
+
+
+def test_running_stats_merge_is_exact():
+    rng = np.random.default_rng(1)
+    x = rng.normal(size=1000)
+    whole = RunningStats().add_array(x)
+    a = RunningStats().add_array(x[:300])
+    b = RunningStats().add_array(x[300:])
+    merged = a.merge(b)
+    assert merged.count == whole.count
+    assert merged.mean == pytest.approx(whole.mean, rel=1e-12)
+    assert merged.variance == pytest.approx(whole.variance, rel=1e-9)
+
+
+def test_running_stats_scalar_adds():
+    rs = RunningStats().add(1.0, 2.0, 3.0)
+    assert rs.mean == 2.0
+    assert rs.variance == pytest.approx(1.0)
+    assert math.isinf(RunningStats().min_val)
+
+
+def test_histogram_percentile_and_cum():
+    h = Histogram.uninitialized(0.0, 10.0, 1.0)
+    h.add(np.repeat(np.arange(10), 10))  # uniform over 0..9
+    assert h.percentile(50) == pytest.approx(4.0, abs=1.0)
+    assert h.percentile(100) == pytest.approx(9.0, abs=1.0)
+    assert h.cum_distr()[-1] == pytest.approx(1.0)
+    assert h.cum_value(9.0) == pytest.approx(1.0)
+    with pytest.raises(ValueError):
+        h.percentile(150)
