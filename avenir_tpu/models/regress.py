@@ -30,11 +30,20 @@ NOT_CONVERGED = 101
 
 
 @jax.jit
-def _lr_step(coeff, x, y, lr):
-    """One full-batch gradient ascent step on the log likelihood."""
-    z = x @ coeff
-    p = jax.nn.sigmoid(z)
-    grad = x.T @ (y - p) / x.shape[0]
+def _lr_grad(coeff, x, y, w=None):
+    """Unnormalized log-likelihood gradient x^T((y - sigmoid(xc)) * w) —
+    the shared core of the single-device and shard_map LR steps."""
+    r = y - jax.nn.sigmoid(x @ coeff)
+    if w is not None:
+        r = r * w
+    return x.T @ r
+
+
+def _lr_step(coeff, x, y, lr, n_eff=None):
+    """One full-batch gradient ascent step on the log likelihood.
+    `n_eff` overrides the row normalizer when x carries zero padding rows
+    (mesh shard divisibility — padded rows contribute 0 to the gradient)."""
+    grad = _lr_grad(coeff, x, y) / (n_eff if n_eff is not None else x.shape[0])
     return coeff + lr * grad, grad
 
 
@@ -74,12 +83,22 @@ class LogisticRegression:
         return jnp.asarray(x), jnp.asarray(y)
 
     # ----------------------------------------------------------------- fit
-    def fit(self, ds: Dataset) -> "LogisticRegression":
+    def fit(self, ds: Dataset, mesh=None) -> "LogisticRegression":
+        """Full-batch gradient epochs. With `mesh`, the design matrix shards
+        over the mesh rows and XLA psums the per-shard gradient halves —
+        the reference's mapper-aggregate/reducer round (SURVEY §3.6) as one
+        collective per epoch."""
         x, y = self._design(ds)
+        n_eff = x.shape[0]
+        if mesh is not None:
+            from avenir_tpu.parallel.mesh import shard_rows
+
+            x = shard_rows(mesh, np.asarray(x))
+            y = shard_rows(mesh, np.asarray(y))
         coeff = jnp.zeros((x.shape[1],), jnp.float32)
         self.coeff_history = [np.asarray(coeff)]
         for _ in range(self.iter_limit):
-            coeff, _ = _lr_step(coeff, x, y, self.lr)
+            coeff, _ = _lr_step(coeff, x, y, self.lr, n_eff)
             self.coeff_history.append(np.asarray(coeff))
             if self.check_convergence() == CONVERGED:
                 break

@@ -36,6 +36,7 @@ async host loop (SURVEY §2.12 "Storm bolts → JAX streaming loop").
 from __future__ import annotations
 
 import math
+import os
 from typing import Callable, Dict, List, Optional, Sequence
 
 import numpy as np
@@ -137,17 +138,25 @@ class ReinforcementLearner:
 
     @staticmethod
     def _encode_state(v):
-        """JSON-safe recursive encoding; int dict keys (histogram bins)
-        get an explicit marker so decode restores them as ints, not the
-        strings JSON would silently make them."""
+        """JSON-safe recursive encoding: numpy scalars coerce to Python,
+        and int dict keys (histogram bins — possibly np.int64 from reward
+        arithmetic) get an explicit marker so decode restores them as ints,
+        not the strings JSON would silently make them."""
+        enc_one = ReinforcementLearner._encode_state
         if isinstance(v, dict):
-            enc = {str(k): ReinforcementLearner._encode_state(x)
-                   for k, x in v.items()}
-            if v and all(isinstance(k, int) for k in v):
+            enc = {str(k): enc_one(x) for k, x in v.items()}
+            if v and all(isinstance(k, (int, np.integer))
+                         and not isinstance(k, bool) for k in v):
                 return {"__intkeys__": enc}
             return enc
         if isinstance(v, (list, tuple)):
-            return [ReinforcementLearner._encode_state(x) for x in v]
+            return [enc_one(x) for x in v]
+        if isinstance(v, np.integer):
+            return int(v)
+        if isinstance(v, np.floating):
+            return float(v)
+        if isinstance(v, np.bool_):
+            return bool(v)
         return v
 
     @staticmethod
@@ -191,14 +200,18 @@ class ReinforcementLearner:
                 extra[k] = enc
         state = {
             "learner": type(self).__name__,
-            "actions": [[a.id, a.trial_count, a.total_reward]
-                        for a in self.actions],
-            "reward_stats": {aid: [st.count, st.total]
+            "actions": [[a.id, int(a.trial_count), self._encode_state(
+                a.total_reward)] for a in self.actions],
+            "reward_stats": {aid: [int(st.count), float(st.total)]
                              for aid, st in self.reward_stats.items()},
             "extra": extra,
         }
-        with open(path, "w") as fh:
+        # atomic replace: a failed dump must not destroy the previous
+        # checkpoint at this path
+        tmp = path + ".tmp"
+        with open(tmp, "w") as fh:
             json.dump(state, fh)
+        os.replace(tmp, path)
 
     def load_state(self, path: str) -> "ReinforcementLearner":
         """Restore a checkpoint written by save_state into this (same-type,

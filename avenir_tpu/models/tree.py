@@ -388,8 +388,12 @@ class DecisionTreeBuilder:
         self.rng = np.random.default_rng(seed)
 
     # ------------------------------------------------------------------- fit
-    def fit(self, ds: Dataset, row_weights: Optional[np.ndarray] = None
-            ) -> DecisionPathList:
+    def fit(self, ds: Dataset, row_weights: Optional[np.ndarray] = None,
+            mesh=None) -> DecisionPathList:
+        """Build the tree. With `mesh`, the row tensors shard over the mesh
+        and every per-level histogram reduction runs SPMD — XLA inserts the
+        psum the reference's shuffle performed (zero-weight rows pad to
+        shard divisibility, so counts are exact)."""
         n = len(ds)
         k = len(self.class_values)
         ns = len(self.splits)
@@ -397,13 +401,21 @@ class DecisionTreeBuilder:
             [sp.segment_of(np.asarray(ds.column(sp.attribute))) for sp in self.splits],
             axis=1,
         ).astype(np.int8)                                     # [n, NS]
-        seg_d = jnp.asarray(seg)
-        labels_d = jnp.asarray(ds.labels())
-        w = jnp.asarray(
-            row_weights.astype(np.float32) if row_weights is not None
-            else np.ones(n, np.float32)
-        )
-        leaf_id = jnp.zeros(n, jnp.int32)
+        labels = ds.labels()
+        w_host = (row_weights.astype(np.float32) if row_weights is not None
+                  else np.ones(n, np.float32))
+        if mesh is not None:
+            from avenir_tpu.parallel.mesh import shard_rows
+
+            seg_d = shard_rows(mesh, seg)
+            labels_d = shard_rows(mesh, labels)
+            w = shard_rows(mesh, w_host)          # pad rows weigh 0
+            leaf_id = shard_rows(mesh, np.zeros(len(ds), np.int32))
+        else:
+            seg_d = jnp.asarray(seg)
+            labels_d = jnp.asarray(labels)
+            w = jnp.asarray(w_host)
+            leaf_id = jnp.zeros(n, jnp.int32)
 
         # host-side tree state: leaf -> (predicate chain, used attrs)
         leaves: List[Dict] = [{"preds": [], "used": set(), "stopped": False}]

@@ -53,7 +53,8 @@ int64_t csv_count_rows(const char* buf, int64_t len) {
 // Parse the buffer in one pass.
 //
 // num_ords / n_num: field ordinals to parse as float32 into num_out
-//   (column-major: num_out[c * n_rows + r]); empty/invalid tokens -> NaN.
+//   (column-major: num_out[c * n_rows + r]); empty tokens -> NaN, invalid
+//   non-empty tokens abort with -2 (see return doc).
 // cat_ords / n_cat: field ordinals to dictionary-encode into cat_out
 //   (column-major int32). The vocabulary for categorical column c is
 //   vocab_blob[vocab_off[vc] .. ] holding vocab_counts[c] zero-terminated

@@ -15,3 +15,10 @@ from avenir_tpu.parallel.mesh import (
     replicated,
     sharded_keyed_count,
 )
+from avenir_tpu.parallel.distributed import (
+    distributed_crosscount_fn,
+    distributed_lr_step_fn,
+    distributed_nb_train_fn,
+    distributed_topk_fn,
+    distributed_tree_level_fn,
+)

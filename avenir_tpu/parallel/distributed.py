@@ -88,3 +88,69 @@ def distributed_nb_train_fn(mesh: Mesh, num_classes: int, bmax: int):
             check_vma=False,
         )
     )
+
+
+def distributed_tree_level_fn(mesh: Mesh, n_leaves: int, n_splits: int,
+                              smax: int, num_classes: int):
+    """Build a jitted mesh-wide tree-level histogram step: every row shard
+    computes its [L, NS, S, K] class-histogram block locally (the
+    segment_sum that replaces one whole MR tree level, SURVEY §3.4), then a
+    psum over the mesh replicates the global histogram — the host picks
+    splits from a tensor that is tiny regardless of row count."""
+    from avenir_tpu.models.tree import _level_histogram
+
+    axes = tuple(a for a in (DATA_AXIS, MODEL_AXIS) if a in mesh.axis_names)
+
+    def kernel(leaf_id, seg_matrix, labels, weights):
+        h = _level_histogram(leaf_id, seg_matrix, labels, weights,
+                             n_leaves, n_splits, smax, num_classes)
+        return lax.psum(h, axes)
+
+    row = P(axes)
+    return jax.jit(
+        jax.shard_map(kernel, mesh=mesh,
+                      in_specs=(row, row, row, row), out_specs=P(),
+                      check_vma=False)
+    )
+
+
+def distributed_lr_step_fn(mesh: Mesh, learning_rate: float = 1.0):
+    """Build a jitted data-parallel logistic-regression step: per-shard
+    gradient halves (regress._lr_grad, the same core as the single-device
+    step), psum'd so every device applies the identical update (the
+    reference's mapper-aggregate + single reducer, SURVEY §3.6, as one
+    collective). Unlike _lr_step, rows carry weights and the normalizer is
+    the weight total — zero-weight padding rows drop out exactly."""
+    from avenir_tpu.models.regress import _lr_grad
+
+    axes = tuple(a for a in (DATA_AXIS, MODEL_AXIS) if a in mesh.axis_names)
+
+    def kernel(coeff, x, y, w):
+        grad = lax.psum(_lr_grad(coeff, x, y, w), axes)
+        n = jnp.maximum(lax.psum(jnp.sum(w), axes), 1.0)
+        return coeff + learning_rate * grad / n
+
+    row = P(axes)
+    return jax.jit(
+        jax.shard_map(kernel, mesh=mesh,
+                      in_specs=(P(), row, row, row), out_specs=P(),
+                      check_vma=False)
+    )
+
+
+def distributed_crosscount_fn(mesh: Mesh, bins_a: int, bins_b: int):
+    """Build a jitted mesh-wide contingency counter: the primitive behind
+    mutual information / correlations (SURVEY §2.4) — per-shard one-hot
+    einsum, psum-merged [A, B] joint counts."""
+    axes = tuple(a for a in (DATA_AXIS, MODEL_AXIS) if a in mesh.axis_names)
+
+    def kernel(a, b, w):
+        oa = jax.nn.one_hot(a, bins_a, dtype=jnp.float32) * w[:, None]
+        ob = jax.nn.one_hot(b, bins_b, dtype=jnp.float32)
+        return lax.psum(jnp.einsum("na,nb->ab", oa, ob), axes)
+
+    row = P(axes)
+    return jax.jit(
+        jax.shard_map(kernel, mesh=mesh, in_specs=(row, row, row),
+                      out_specs=P(), check_vma=False)
+    )

@@ -82,3 +82,93 @@ class TestDistributedTopk:
         )
         assert np.asarray(dist).shape == (16, 2)
         assert np.isfinite(np.asarray(dist)).all()
+
+
+def test_distributed_tree_level_matches_single_device(mesh8, rng):
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    from avenir_tpu.models.tree import _level_histogram
+    from avenir_tpu.parallel import DATA_AXIS, distributed_tree_level_fn
+
+    n, L, NS, S, K = 256, 3, 4, 2, 2
+    leaf = rng.integers(0, L, n).astype(np.int32)
+    seg = rng.integers(0, S, (n, NS)).astype(np.int8)
+    labels = rng.integers(0, K, n).astype(np.int32)
+    w = np.ones(n, np.float32)
+
+    single = np.asarray(_level_histogram(
+        jnp.asarray(leaf), jnp.asarray(seg), jnp.asarray(labels),
+        jnp.asarray(w), L, NS, S, K))
+    shard = NamedSharding(mesh8, P(DATA_AXIS))
+    step = distributed_tree_level_fn(mesh8, L, NS, S, K)
+    dist = np.asarray(step(
+        jax.device_put(leaf, shard), jax.device_put(seg, shard),
+        jax.device_put(labels, shard), jax.device_put(w, shard)))
+    np.testing.assert_allclose(dist, single, atol=1e-4)
+
+
+def test_distributed_lr_step_matches_single_device(mesh8, rng):
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    from avenir_tpu.parallel import DATA_AXIS, distributed_lr_step_fn
+
+    n, d = 512, 5
+    x = rng.normal(size=(n, d)).astype(np.float32)
+    y = (rng.random(n) < 0.5).astype(np.float32)
+    w = np.ones(n, np.float32)
+    coeff0 = np.zeros(d, np.float32)
+
+    # single-device oracle: full-batch sigmoid gradient step
+    p = 1.0 / (1.0 + np.exp(-(x @ coeff0)))
+    expected = coeff0 + 0.7 * (x.T @ ((y - p) * w)) / n
+
+    shard = NamedSharding(mesh8, P(DATA_AXIS))
+    step = distributed_lr_step_fn(mesh8, learning_rate=0.7)
+    got = np.asarray(step(jnp.asarray(coeff0), jax.device_put(x, shard),
+                          jax.device_put(y, shard), jax.device_put(w, shard)))
+    np.testing.assert_allclose(got, expected, rtol=1e-4, atol=1e-5)
+
+
+def test_distributed_crosscount_matches_numpy(mesh8, rng):
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    from avenir_tpu.parallel import DATA_AXIS, distributed_crosscount_fn
+
+    n, A, B = 1024, 6, 3
+    a = rng.integers(0, A, n).astype(np.int32)
+    b = rng.integers(0, B, n).astype(np.int32)
+    w = np.ones(n, np.float32)
+    expected = np.zeros((A, B))
+    np.add.at(expected, (a, b), 1.0)
+
+    shard = NamedSharding(mesh8, P(DATA_AXIS))
+    cc = distributed_crosscount_fn(mesh8, A, B)
+    got = np.asarray(cc(jax.device_put(a, shard), jax.device_put(b, shard),
+                        jax.device_put(w, shard)))
+    np.testing.assert_allclose(got, expected, atol=1e-4)
+
+
+def test_tree_builder_mesh_equals_single_device(mesh8):
+    from avenir_tpu.data import generate_churn
+    from avenir_tpu.models.tree import DecisionTreeBuilder
+
+    ds = generate_churn(300, seed=21)
+    single = DecisionTreeBuilder(ds.schema, max_depth=2).fit(ds)
+    sharded = DecisionTreeBuilder(ds.schema, max_depth=2).fit(ds, mesh=mesh8)
+    cls_vals = ds.schema.class_values()
+    np.testing.assert_array_equal(single.predict(ds, cls_vals),
+                                  sharded.predict(ds, cls_vals))
+    assert len(single.paths) == len(sharded.paths)
+
+
+def test_lr_mesh_equals_single_device(mesh8):
+    from avenir_tpu.data import generate_elearn
+    from avenir_tpu.models.regress import LogisticRegression
+
+    ds = generate_elearn(333, seed=22)   # deliberately not shard-divisible
+    single = LogisticRegression(iteration_limit=5).fit(ds)
+    sharded = LogisticRegression(iteration_limit=5).fit(ds, mesh=mesh8)
+    np.testing.assert_allclose(sharded.coeff, single.coeff,
+                               rtol=1e-4, atol=1e-5)

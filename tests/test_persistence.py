@@ -121,3 +121,26 @@ def test_exp3_weights_roundtrip(tmp_path):
     assert w1 is not None
     np.testing.assert_allclose(np.asarray(w1, float), np.asarray(w2, float),
                                rtol=1e-9)
+
+
+def test_checkpoint_handles_numpy_typed_state(tmp_path):
+    """Rewards arriving as np.int64 (e.g. straight from rng.integers) must
+    still checkpoint and resume with int histogram keys."""
+    cfg = {"batch.size": 1, "bin.width": 10, "confidence.limit": 90,
+           "min.confidence.limit": 50, "confidence.limit.reduction.step": 5,
+           "confidence.limit.reduction.round.interval": 20,
+           "min.reward.distr.sample": 3}
+    rng = np.random.default_rng(8)
+    l1 = create_learner("intervalEstimator", ["a", "b"], dict(cfg))
+    for _ in range(40):
+        act = l1.next_action()
+        l1.set_reward(act.id, rng.integers(0, 60))   # np.int64, no int()
+    p = str(tmp_path / "np.json")
+    l1.save_state(p)
+    l2 = create_learner("intervalEstimator", ["a", "b"], dict(cfg)).load_state(p)
+    assert all(isinstance(k, int)
+               for h in l2.histograms.values() for k in h)
+    assert l2._upper_bound("a") == l1._upper_bound("a")
+    # atomic write: the temp file is gone after a successful save
+    import os
+    assert not os.path.exists(p + ".tmp")
