@@ -68,20 +68,20 @@ def bench_naive_bayes():
     w = jnp.ones((n,), jnp.float32)
     x_cont = jnp.zeros((n, 0), jnp.float32)
 
-    # rotate staged input variants: vanilla JAX never caches results by
-    # value, but remote-tunneled backends have shown a >2x same-input vs
-    # varied-input discrepancy here, so the driver-recorded number must not
-    # depend on repeating one buffer (variants stage before the warmup call,
-    # whose block_until_ready flushes the whole stream)
-    codes_v = [codes_d, jnp.roll(codes_d, 1, axis=0)]
-    labels_v = [labels_d, jnp.roll(labels_d, 1)]
+    # one DISTINCT staged input per timed iteration: the execution path has
+    # been observed to serve repeated (executable, input) pairs ~10x faster
+    # than fresh inputs, so an honest rate must never repeat a buffer
+    # (variants stage before the warmup call, whose block_until_ready
+    # flushes the whole stream)
+    codes_v = [jnp.roll(codes_d, i, axis=0) for i in range(NB_ITERS)]
+    labels_v = [jnp.roll(labels_d, i) for i in range(NB_ITERS)]
 
     # train pass
     out = _count_batch_kernel(codes_d, labels_d, x_cont, w, k, bmax)
     jax.block_until_ready(out)
     t0 = time.perf_counter()
     for i in range(NB_ITERS):
-        out = _count_batch_kernel(codes_v[i % 2], labels_v[i % 2],
+        out = _count_batch_kernel(codes_v[i], labels_v[i],
                                   x_cont, w, k, bmax)
     jax.block_until_ready(out)
     train_rps = n * NB_ITERS / (time.perf_counter() - t0)
@@ -92,7 +92,7 @@ def bench_naive_bayes():
     jax.block_until_ready(out)
     t0 = time.perf_counter()
     for i in range(NB_ITERS):
-        out = pred._predict(codes_v[i % 2], x_cont, pred.tables)
+        out = pred._predict(codes_v[i], x_cont, pred.tables)
     jax.block_until_ready(out)
     predict_rps = n * NB_ITERS / (time.perf_counter() - t0)
 
@@ -108,14 +108,20 @@ def bench_knn():
     from avenir_tpu.ops.distance import blocked_topk_neighbors
     from avenir_tpu.ops.pallas_knn import knn_topk_pallas, pallas_available
 
+    import functools
+
     rng = np.random.default_rng(2)
+    # one distinct query set per timed iteration (see bench_naive_bayes note)
     qs = [jnp.asarray(rng.normal(size=(KNN_QUERIES, KNN_DIM)).astype(np.float32))
-          for _ in range(3)]
+          for _ in range(KNN_ITERS)]
     t = jnp.asarray(rng.normal(size=(KNN_TRAIN, KNN_DIM)).astype(np.float32))
     t_labels = jnp.asarray(rng.integers(0, 2, KNN_TRAIN).astype(np.int32))
     use_pallas = pallas_available()
 
-    def step(q):
+    # whole classify step in ONE jitted program — separate dispatches for
+    # top-k / gather / vote were dispatch-latency-bound through the tunnel
+    @functools.partial(jax.jit, static_argnames=())
+    def step(q, t, t_labels):
         if use_pallas:
             # fused VMEM distance-tile + iterative-min top-k kernel
             dist, idx = knn_topk_pallas(q, t, k=KNN_K, metric="euclidean")
@@ -123,15 +129,14 @@ def bench_knn():
             dist, idx = blocked_topk_neighbors(
                 q, t, k=KNN_K, block=KNN_BLOCK, metric="euclidean"
             )
-        scores = _vote(dist, t_labels[idx], jnp.ones_like(dist),
-                       "gaussian", 30.0, 2, False, False)
-        return scores
+        return _vote(dist, t_labels[idx], jnp.ones_like(dist),
+                     "gaussian", 30.0, 2, False, False)
 
-    out = step(qs[0])
+    out = step(qs[0], t, t_labels)
     jax.block_until_ready(out)
     t0 = time.perf_counter()
     for i in range(KNN_ITERS):
-        out = step(qs[i % len(qs)])
+        out = step(qs[i], t, t_labels)
     jax.block_until_ready(out)
     qps = KNN_QUERIES * KNN_ITERS / (time.perf_counter() - t0)
     return qps
