@@ -1,0 +1,118 @@
+"""Canonical pipelines: the reference's tutorial shell flows as Pipelines.
+
+Each factory wires the stages one of the resource/*.sh case-statement
+drivers (SURVEY §2.11) ran by hand, against the same properties keys, so
+the 20+ *_tutorial.txt run-books translate 1:1: build the pipeline, call
+run(). Iterative flows (Apriori k-rounds, tree levels) that the reference
+drove by re-running jobs with file rotation run inside their jobs here, but
+every between-round file still lands on disk.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, Optional
+
+from avenir_tpu.core.config import load_properties
+from avenir_tpu.runner import JobResult, Pipeline, Stage, job_prefix, run_job
+
+
+def _props(conf) -> Dict[str, str]:
+    """Properties from a file path, a dict, or a JobConfig."""
+    if isinstance(conf, str):
+        return load_properties(conf)
+    if hasattr(conf, "props"):
+        return dict(conf.props)
+    return dict(conf)
+
+
+def knn_pipeline(conf, train_csv: str, test_csv: str, work_dir: str,
+                 schema_path: Optional[str] = None) -> Pipeline:
+    """The 5-stage resource/knn.sh flow (SURVEY §3.3).
+
+    Stage (1) sifarish distances -> recordSimilarity; stages (2)-(4)
+    (NB distributions, feature posterior, join) -> bayesianDistr + the
+    fused class-conditional weighting inside nearestNeighbor; stage (5) ->
+    nearestNeighbor. The distance file is still produced for downstream
+    consumers even though the fused KNN recomputes distances on device.
+    """
+    os.makedirs(work_dir, exist_ok=True)
+    overrides: Dict[str, str] = {}
+    if schema_path:
+        for p in ("sts", "bad", "bap", "nen"):
+            overrides[f"{p}.feature.schema.file.path"] = schema_path
+    model_path = os.path.join(work_dir, "distr.csv")
+    overrides.setdefault("bap.bayesian.model.file.path", model_path)
+    return Pipeline(_props(conf), [
+        Stage("similarity", "recordSimilarity", [train_csv, test_csv],
+              os.path.join(work_dir, "simi.txt"), dict(overrides)),
+        Stage("bayesianDistr", "bayesianDistr", [train_csv], model_path,
+              dict(overrides)),
+        Stage("featurePosterior", "bayesianPredictor", [train_csv],
+              os.path.join(work_dir, "pprob.txt"),
+              {**overrides, "bap.output.feature.prob.only": "true"}),
+        Stage("nearestNeighbor", "nearestNeighbor", [train_csv, test_csv],
+              os.path.join(work_dir, "knn_out.txt"), dict(overrides)),
+    ])
+
+
+def decision_tree_pipeline(conf, train_csv: str, work_dir: str,
+                           schema_path: Optional[str] = None,
+                           forest: bool = False) -> Pipeline:
+    """resource/detr.sh / rafo.sh: the per-level decTree + mvDecFiles
+    rotation (SURVEY §3.4) as one job whose DecisionPathList JSON lands at
+    dtb.decision.file.path.out; rafo's forest variant writes per-tree files."""
+    os.makedirs(work_dir, exist_ok=True)
+    overrides: Dict[str, str] = {}
+    if schema_path:
+        overrides["dtb.feature.schema.file.path"] = schema_path
+    overrides.setdefault(
+        "dtb.decision.file.path.out", os.path.join(work_dir, "decPathOut.txt"))
+    job = "randomForest" if forest else "decTree"
+    return Pipeline(_props(conf), [
+        Stage("decTree", job, [train_csv],
+              os.path.join(work_dir, "forest") if forest else "",
+              overrides),
+    ])
+
+
+def association_pipeline(conf, trans_csv: str, work_dir: str) -> Pipeline:
+    """resource/carm.sh: frequent itemsets (all k rounds) then association
+    rules over the per-k itemset files."""
+    os.makedirs(work_dir, exist_ok=True)
+    iset_dir = os.path.join(work_dir, "itemsets")
+    pipe = Pipeline(_props(conf), [
+        Stage("apriori", "frequentItemsApriori", [trans_csv], iset_dir),
+        # inputs of the rules stage are resolved after apriori runs
+        Stage("rules", "associationRuleMiner", [],
+              os.path.join(work_dir, "rules.txt")),
+    ])
+
+    orig_run = pipe.run
+
+    def run(only=None):
+        results: Dict[str, JobResult] = {}
+        if only in (None, "apriori"):
+            results.update(orig_run("apriori"))
+        if only in (None, "rules"):
+            ap = pipe.results.get("apriori")
+            if ap is None:
+                raise RuntimeError("run the apriori stage first")
+            pipe.stages[1].inputs = list(ap.outputs)
+            results.update(orig_run("rules"))
+        return results
+
+    pipe.run = run  # type: ignore[method-assign]
+    return pipe
+
+
+def bandit_round(conf, stats_csv: str, out_path: str, round_num: int,
+                 job: str = "greedyRandomBandit") -> JobResult:
+    """One decision round of the price-optimization loop
+    (resource/price_optimize_tutorial.txt:20-82): reward-aggregate rows in,
+    selected items out. The driver loop lives with the caller, exactly like
+    the tutorial's manual rounds — reward aggregation between rounds is the
+    caller's data pipeline."""
+    props = _props(conf)
+    props[f"{job_prefix(job)}.current.round.num"] = str(round_num)
+    return run_job(job, props, [stats_csv], out_path)

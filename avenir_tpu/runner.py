@@ -72,6 +72,14 @@ def job_names() -> List[str]:
     return sorted(_REGISTRY)
 
 
+def job_prefix(name: str) -> str:
+    """The reference config prefix a registered job reads (e.g.
+    greedyRandomBandit -> 'grb'); accepts aliases."""
+    if name not in _REGISTRY:
+        raise KeyError(f"unknown job {name!r}")
+    return _REGISTRY[name][1]
+
+
 def run_job(name: str, conf, inputs: Sequence[str], output: str = "") -> JobResult:
     """Run a registered job. `conf` is a properties file path, a dict, or a
     JobConfig; the job sees it scoped under its reference prefix."""

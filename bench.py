@@ -73,8 +73,9 @@ def bench_naive_bayes():
     # than fresh inputs, so an honest rate must never repeat a buffer
     # (variants stage before the warmup call, whose block_until_ready
     # flushes the whole stream)
-    codes_v = [jnp.roll(codes_d, i, axis=0) for i in range(NB_ITERS)]
-    labels_v = [jnp.roll(labels_d, i) for i in range(NB_ITERS)]
+    # shifts start at 1: shift 0 would replay the warmup call's exact value
+    codes_v = [jnp.roll(codes_d, i, axis=0) for i in range(1, NB_ITERS + 1)]
+    labels_v = [jnp.roll(labels_d, i) for i in range(1, NB_ITERS + 1)]
 
     # train pass
     out = _count_batch_kernel(codes_d, labels_d, x_cont, w, k, bmax)
@@ -108,19 +109,18 @@ def bench_knn():
     from avenir_tpu.ops.distance import blocked_topk_neighbors
     from avenir_tpu.ops.pallas_knn import knn_topk_pallas, pallas_available
 
-    import functools
-
     rng = np.random.default_rng(2)
-    # one distinct query set per timed iteration (see bench_naive_bayes note)
+    # one distinct query set per timed iteration, plus one for warmup
+    # (see bench_naive_bayes note)
     qs = [jnp.asarray(rng.normal(size=(KNN_QUERIES, KNN_DIM)).astype(np.float32))
-          for _ in range(KNN_ITERS)]
+          for _ in range(KNN_ITERS + 1)]
     t = jnp.asarray(rng.normal(size=(KNN_TRAIN, KNN_DIM)).astype(np.float32))
     t_labels = jnp.asarray(rng.integers(0, 2, KNN_TRAIN).astype(np.int32))
     use_pallas = pallas_available()
 
     # whole classify step in ONE jitted program — separate dispatches for
     # top-k / gather / vote were dispatch-latency-bound through the tunnel
-    @functools.partial(jax.jit, static_argnames=())
+    @jax.jit
     def step(q, t, t_labels):
         if use_pallas:
             # fused VMEM distance-tile + iterative-min top-k kernel
@@ -132,7 +132,7 @@ def bench_knn():
         return _vote(dist, t_labels[idx], jnp.ones_like(dist),
                      "gaussian", 30.0, 2, False, False)
 
-    out = step(qs[0], t, t_labels)
+    out = step(qs[KNN_ITERS], t, t_labels)   # dedicated warmup set
     jax.block_until_ready(out)
     t0 = time.perf_counter()
     for i in range(KNN_ITERS):

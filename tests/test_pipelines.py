@@ -1,0 +1,118 @@
+"""Canonical tutorial pipelines (knn.sh / detr.sh / carm.sh flows)."""
+
+import os
+
+import numpy as np
+import pytest
+
+from avenir_tpu.data import (churn_schema, elearn_schema, generate_churn,
+                             generate_elearn, generate_price_opt)
+from avenir_tpu.pipelines import (association_pipeline, bandit_round,
+                                  decision_tree_pipeline, knn_pipeline)
+from tests.test_runner import ds_to_csv
+
+
+@pytest.fixture(scope="module")
+def elearn_env(tmp_path_factory):
+    d = tmp_path_factory.mktemp("pipe_elearn")
+    schema = str(d / "elearn.json")
+    elearn_schema().save(schema)
+    train = str(d / "train.csv")
+    test = str(d / "test.csv")
+    with open(train, "w") as fh:
+        fh.write(ds_to_csv(generate_elearn(300, seed=40)))
+    with open(test, "w") as fh:
+        fh.write(ds_to_csv(generate_elearn(80, seed=41)))
+    return {"dir": str(d), "schema": schema, "train": train, "test": test}
+
+
+def test_knn_pipeline_all_stages(elearn_env, tmp_path):
+    work = str(tmp_path / "work")
+    props = {
+        "nen.top.match.count": "5",
+        "nen.validation.mode": "true",
+        "nen.class.condtion.weighted": "true",
+    }
+    pipe = knn_pipeline(props, elearn_env["train"], elearn_env["test"], work,
+                        schema_path=elearn_env["schema"])
+    results = pipe.run()
+    assert set(results) == {"similarity", "bayesianDistr", "featurePosterior",
+                            "nearestNeighbor"}
+    assert results["similarity"].counters["Similarity:Pairs"] == 300 * 80
+    assert results["nearestNeighbor"].counters["Validation:Accuracy"] > 60
+    # all the tutorial's intermediate files exist
+    for f in ["simi.txt", "distr.csv", "pprob.txt", "knn_out.txt"]:
+        assert os.path.exists(os.path.join(work, f)), f
+
+
+def test_decision_tree_pipeline(tmp_path):
+    d = str(tmp_path)
+    schema = os.path.join(d, "churn.json")
+    churn_schema().save(schema)
+    train = os.path.join(d, "train.csv")
+    with open(train, "w") as fh:
+        fh.write(generate_churn(400, seed=42, as_csv=True))
+    work = os.path.join(d, "work")
+    pipe = decision_tree_pipeline({"dtb.max.depth.limit": "2"}, train, work,
+                                  schema_path=schema)
+    results = pipe.run()
+    assert results["decTree"].counters["Tree:Paths"] > 1
+    assert os.path.exists(os.path.join(work, "decPathOut.txt"))
+
+    fpipe = decision_tree_pipeline(
+        {"dtb.max.depth.limit": "2", "dtb.num.trees": "3"}, train, work,
+        schema_path=schema, forest=True)
+    results = fpipe.run()
+    assert results["decTree"].counters["Tree:Trees"] == 3
+
+
+def test_association_pipeline_chains_outputs(tmp_path):
+    rng = np.random.default_rng(43)
+    trans = str(tmp_path / "trans.csv")
+    with open(trans, "w") as fh:
+        for i in range(150):
+            items = []
+            if rng.random() < 0.8:
+                items.append("milk")
+                if rng.random() < 0.7:
+                    items.append("bread")
+            if rng.random() < 0.25:
+                items.append("beer")
+            if items:
+                fh.write(f"T{i}," + ",".join(items) + "\n")
+    work = str(tmp_path / "work")
+    pipe = association_pipeline(
+        {"fia.support.threshold": "0.2", "fia.item.set.length": "2",
+         "arm.conf.threshold": "0.5"}, trans, work)
+    results = pipe.run()
+    assert results["rules"].counters["Rules:Count"] >= 1
+    pairs = {(r.antecedent, r.consequent) for r in results["rules"].payload}
+    assert (("milk",), ("bread",)) in pairs
+
+
+def test_association_pipeline_requires_order(tmp_path):
+    pipe = association_pipeline({"fia.support.threshold": "0.5",
+                                 "arm.conf.threshold": "0.5"},
+                                str(tmp_path / "none.csv"),
+                                str(tmp_path / "w"))
+    with pytest.raises(RuntimeError, match="apriori"):
+        pipe.run(only="rules")
+
+
+def test_bandit_round_loop(tmp_path):
+    """The price-optimize tutorial loop: rounds feed rewards back."""
+    rows = generate_price_opt(num_products=4, seed=44)
+    stats = str(tmp_path / "stats.csv")
+    with open(stats, "w") as fh:
+        for r in rows:
+            fh.write(",".join(r) + "\n")
+    picks_per_round = []
+    for rnd in [1, 10, 100]:
+        out = str(tmp_path / f"round{rnd}.txt")
+        res = bandit_round({"grb.global.batch.size": "1",
+                            "grb.random.selection.prob": "0.0"},
+                           stats, out, rnd)
+        assert res.counters["Bandit:Groups"] == 4
+        picks_per_round.append(open(out).read())
+    # greedy with no exploration is deterministic across rounds
+    assert picks_per_round[1] == picks_per_round[2]
