@@ -465,7 +465,9 @@ def bagging_sample(ds: Dataset, rate: float = 1.0, seed: int = 0) -> Dataset:
 def top_matches_by_class(ds: Dataset, k: int = 3, block: int = 4096
                          ) -> Dict[str, Tuple[np.ndarray, np.ndarray]]:
     """Per class: k nearest same-class neighbors for each record of that
-    class (TopMatchesByClass.java:47). Returns class -> (dist, local idx)."""
+    class (TopMatchesByClass.java:47). Returns class -> (dist [m, k],
+    global dataset row idx [m, k]); row r of the pair is the class's r-th
+    record in dataset order (np.flatnonzero(labels == class))."""
     from avenir_tpu.models.knn import NeighborIndex
 
     y = ds.labels()

@@ -166,10 +166,14 @@ def read_distance_file(path: str, delim: str = ",", scale: int = 1000,
 
 def distance_matrix_from_file(path: str, ids: Sequence[str],
                               delim: str = ",", scale: int = 1000,
-                              default: float = np.inf) -> np.ndarray:
+                              default: float = np.inf,
+                              pairs: Optional[Dict[Tuple[str, str], float]]
+                              = None) -> np.ndarray:
     """Dense [n, n] matrix over `ids` from a distance file (missing pairs
-    get `default`; diagonal 0)."""
-    pairs = read_distance_file(path, delim, scale)
+    get `default`; diagonal 0). Pass `pairs` from a prior
+    read_distance_file call to skip re-parsing the (O(n^2)-line) file."""
+    if pairs is None:
+        pairs = read_distance_file(path, delim, scale)
     n = len(ids)
     m = np.full((n, n), default, np.float64)
     np.fill_diagonal(m, 0.0)

@@ -534,6 +534,315 @@ def rule_evaluator(cfg: JobConfig, inputs: List[str], output: str) -> JobResult:
     return JobResult("ruleEvaluator", {}, [out], results)
 
 
+@job("cramerCorrelation", "crc", "org.avenir.explore.CramerCorrelation")
+@job("categoricalCorrelation", "cac",
+     "org.avenir.explore.CategoricalCorrelation")
+def cramer_job(cfg: JobConfig, inputs: List[str], output: str) -> JobResult:
+    """Cramér-index categorical<->class correlation (crc.*); the cac.* job
+    computes the same contingency-table stat (CramerCorrelation.java:54)."""
+    from avenir_tpu.models.explore import cramer_correlation
+
+    name = cfg.props.get("__job_name__", "cramerCorrelation")
+    ds = _dataset(inputs[0], cfg)
+    corr = cramer_correlation(ds)
+    out = _out_file(output)
+    delim = cfg.field_delim
+    with open(out, "w") as fh:
+        for ordinal, v in sorted(corr.items()):
+            fh.write(f"{ordinal}{delim}{v:.6f}\n")
+    return JobResult(name, {}, [out], corr)
+
+
+@job("heterogeneityReduction", "hrc",
+     "org.avenir.explore.HeterogeneityReductionCorrelation")
+def heterogeneity_job(cfg: JobConfig, inputs: List[str], output: str) -> JobResult:
+    from avenir_tpu.models.explore import heterogeneity_reduction
+
+    ds = _dataset(inputs[0], cfg)
+    corr = heterogeneity_reduction(
+        ds, algo=cfg.get("heterogeneity.algorithm", "entropy"))
+    out = _out_file(output)
+    delim = cfg.field_delim
+    with open(out, "w") as fh:
+        for ordinal, v in sorted(corr.items()):
+            fh.write(f"{ordinal}{delim}{v:.6f}\n")
+    return JobResult("heterogeneityReduction", {}, [out], corr)
+
+
+@job("numericalCorrelation", "nuc",
+     "org.avenir.explore.NumericalCorrelation")
+def numerical_corr_job(cfg: JobConfig, inputs: List[str], output: str) -> JobResult:
+    from avenir_tpu.models.explore import numerical_correlation
+
+    ds = _dataset(inputs[0], cfg)
+    corr = numerical_correlation(ds)   # [D+1, D+1]: class is the last column
+    fields = [f.ordinal for f in ds.schema.feature_fields if f.is_numeric]
+    out = _out_file(output)
+    delim = cfg.field_delim
+    with open(out, "w") as fh:
+        for i, oi in enumerate(fields):
+            for j, oj in enumerate(fields):
+                if j > i:
+                    fh.write(f"{oi}{delim}{oj}{delim}{corr[i, j]:.6f}\n")
+            # feature-vs-class correlation: the relevance signal this
+            # family of jobs exists to emit
+            fh.write(f"{oi}{delim}class{delim}{corr[i, -1]:.6f}\n")
+    return JobResult("numericalCorrelation", {}, [out], corr)
+
+
+@job("reliefFeatureRelevance", "ffr",
+     "org.avenir.explore.ReliefFeatureRelevance")
+def relief_job(cfg: JobConfig, inputs: List[str], output: str) -> JobResult:
+    from avenir_tpu.models.explore import relief_relevance
+
+    ds = _dataset(inputs[0], cfg)
+    rel = relief_relevance(ds, sample_size=cfg.get_int("sample.size"))
+    out = _out_file(output)
+    delim = cfg.field_delim
+    with open(out, "w") as fh:
+        for ordinal, v in sorted(rel.items()):
+            fh.write(f"{ordinal}{delim}{v:.6f}\n")
+    return JobResult("reliefFeatureRelevance", {}, [out], rel)
+
+
+@job("categoricalClassAffinity", "cca",
+     "org.avenir.explore.CategoricalClassAffinity")
+def class_affinity_job(cfg: JobConfig, inputs: List[str], output: str) -> JobResult:
+    from avenir_tpu.models.explore import class_affinity
+
+    ds = _dataset(inputs[0], cfg)
+    top_n = cfg.get_int("top.count", 3)
+    out = _out_file(output)
+    delim = cfg.field_delim
+    payload = {}
+    with open(out, "w") as fh:
+        for fld in ds.schema.feature_fields:
+            if not fld.is_categorical:
+                continue
+            aff = class_affinity(ds, fld, top_n=top_n)
+            payload[fld.ordinal] = aff
+            for cv, pairs in aff.items():
+                for val, score in pairs:
+                    fh.write(f"{fld.ordinal}{delim}{cv}{delim}{val}"
+                             f"{delim}{score:.6f}\n")
+    return JobResult("categoricalClassAffinity", {}, [out], payload)
+
+
+@job("categoricalContinuousEncoding", "coe",
+     "org.avenir.explore.CategoricalContinuousEncoding")
+def supervised_encoding_job(cfg: JobConfig, inputs: List[str], output: str) -> JobResult:
+    from avenir_tpu.models.explore import supervised_encoding
+
+    ds = _dataset(inputs[0], cfg)
+    strategy = cfg.get("encoding.strategy", "supervisedRatio")
+    pos = cfg.get("pos.class.attr.value")
+    out = _out_file(output)
+    delim = cfg.field_delim
+    payload = {}
+    with open(out, "w") as fh:
+        for fld in ds.schema.feature_fields:
+            if not fld.is_categorical:
+                continue
+            enc = supervised_encoding(ds, fld, strategy=strategy,
+                                      pos_class=pos)
+            payload[fld.ordinal] = enc
+            for val, code in enc.items():
+                fh.write(f"{fld.ordinal}{delim}{val}{delim}{code:.6f}\n")
+    return JobResult("categoricalContinuousEncoding", {}, [out], payload)
+
+
+@job("topMatchesByClass", "tmc", "org.avenir.explore.TopMatchesByClass")
+def top_matches_job(cfg: JobConfig, inputs: List[str], output: str) -> JobResult:
+    from avenir_tpu.models.explore import top_matches_by_class
+
+    ds = _dataset(inputs[0], cfg)
+    matches = top_matches_by_class(ds, k=cfg.get_int("top.match.count", 3))
+    out = _out_file(output)
+    delim = cfg.field_delim
+    ids = ds.ids()
+    y = ds.labels()
+    cls_vals = ds.schema.class_values()
+    n = 0
+    with open(out, "w") as fh:
+        for cv, (dist, idx) in matches.items():
+            rows = np.flatnonzero(y == cls_vals.index(cv))
+            for r in range(dist.shape[0]):
+                # entity ids on both sides so rows join back to the data
+                row = [cv, str(ids[rows[r]])] + [
+                    f"{ids[idx[r, j]]}:{dist[r, j]:.4f}"
+                    for j in range(dist.shape[1])]
+                fh.write(delim.join(row) + "\n")
+                n += 1
+    return JobResult("topMatchesByClass", {"Basic:Records": n}, [out], matches)
+
+
+@job("underSamplingBalancer", "usb",
+     "org.avenir.explore.UnderSamplingBalancer")
+@job("baggingSampler", "bas", "org.avenir.explore.BaggingSampler")
+def sampler_job(cfg: JobConfig, inputs: List[str], output: str) -> JobResult:
+    """Map-only row samplers: class rebalancing by undersampling (usb.*)
+    or bootstrap sampling (bas.*); rows pass through byte-identical."""
+    from avenir_tpu.models.explore import bagging_sample, undersample_balance
+
+    name = cfg.props.get("__job_name__", "underSamplingBalancer")
+    ds = _dataset(inputs[0], cfg, keep_raw=True)
+    if name == "baggingSampler":
+        sampled = bagging_sample(ds, rate=cfg.get_float("sample.rate", 1.0),
+                                 seed=cfg.get_int("seed", 0))
+    else:
+        sampled = undersample_balance(ds, seed=cfg.get_int("seed", 0))
+    out = _out_file(output)
+    with open(out, "w") as fh:
+        fh.write(sampled.to_csv(cfg.field_delim) if len(sampled) else "")
+    return JobResult(name, {"Basic:Records": len(sampled)}, [out])
+
+
+# ==================================================================== cluster
+@job("agglomerativeGraphical", "agg",
+     "org.avenir.cluster.AgglomerativeGraphical")
+def agglomerative_job(cfg: JobConfig, inputs: List[str], output: str) -> JobResult:
+    """Greedy agglomerative clustering over a pairwise-distance file (the
+    EntityDistanceMapFileAccessor input, AgglomerativeGraphical.java:108)."""
+    from avenir_tpu.models.cluster import AgglomerativeGraphical
+    from avenir_tpu.models.similarity import (distance_matrix_from_file,
+                                              read_distance_file)
+
+    dist_path = cfg.get("distance.file.path") or inputs[0]
+    pairs = read_distance_file(dist_path, delim=cfg.field_delim_regex,
+                               scale=cfg.get_int("distance.scale", 1000))
+    ids = sorted({a for a, _ in pairs})
+    m = distance_matrix_from_file(dist_path, ids, pairs=pairs)
+    model = AgglomerativeGraphical(
+        num_clusters=cfg.get_int("num.clusters", 2),
+        max_avg_distance=cfg.get_float("max.avg.distance"),
+    ).fit(m)
+    out = _out_file(output)
+    delim = cfg.field_delim
+    with open(out, "w") as fh:
+        for i, rid in enumerate(ids):
+            fh.write(f"{rid}{delim}{int(model.labels_[i])}\n")
+    return JobResult("agglomerativeGraphical",
+                     {"Cluster:Count": len(set(model.labels_.tolist()))},
+                     [out], model)
+
+
+@job("clusterTrain", "train", "kmeansCluster")
+def cluster_train_job(cfg: JobConfig, inputs: List[str], output: str) -> JobResult:
+    """The python-layer cluster.py surface (train.* jprops keys,
+    unsupv/cluster.py:24-60): kmeans / dbscan over the schema's numeric
+    features, with cohesion model selection output."""
+    from avenir_tpu.models.cluster import DBSCAN, KMeans, cohesion
+
+    ds = _dataset(inputs[0], cfg)
+    x = ds.feature_matrix()
+    algo = cfg.get("algo", "kmeans")
+    if algo == "kmeans":
+        model = KMeans(k=cfg.get_int("num.clusters", 3),
+                       iters=cfg.get_int("num.iters", 100)).fit(x)
+        labels = model.labels_          # fit already assigned the train rows
+    elif algo == "dbscan":
+        from avenir_tpu.models.cluster import dataset_distance_matrix
+
+        model = DBSCAN(eps=cfg.get_float("eps", 0.5),
+                       min_samples=cfg.get_int("min.samples", 4))
+        model.fit(dataset_distance_matrix(ds))
+        labels = model.labels_
+    else:
+        raise ValueError(f"unknown cluster algo {algo!r}")
+    out = _out_file(output)
+    delim = cfg.field_delim
+    with open(out, "w") as fh:
+        for rid, lab in zip(ds.ids(), labels):
+            fh.write(f"{rid}{delim}{int(lab)}\n")
+    coh = float(cohesion(x, np.asarray(labels))) if len(set(labels)) > 1 else 0.0
+    return JobResult("clusterTrain", {"Cluster:Cohesion": coh}, [out], model)
+
+
+# =================================================================== sequence
+@job("candidateGenerationWithSelfJoin", "cgs",
+     "org.avenir.sequence.CandidateGenerationWithSelfJoin", "gspMiner")
+def gsp_job(cfg: JobConfig, inputs: List[str], output: str) -> JobResult:
+    """GSP frequent-sequence mining; the reference's per-k self-join rounds
+    (CandidateGenerationWithSelfJoin.java:44-49) run internally up to
+    cgs.item.set.length, with per-k output files."""
+    from avenir_tpu.models.sequence import GSPMiner, SequenceSet
+
+    skip = cfg.get_int("skip.field.count", 1)
+    rows = [[t.strip() for t in ln.split(cfg.field_delim_regex)]
+            for p in inputs for ln in _read_lines(p)]
+    ss = SequenceSet.from_token_rows(rows, skip_field_count=skip)
+    miner = GSPMiner(
+        support_threshold=cfg.assert_float("support.threshold"),
+        max_length=cfg.get_int("item.set.length", 3),
+    )
+    levels = miner.mine(ss)
+    os.makedirs(output or ".", exist_ok=True)
+    outs = []
+    delim = cfg.field_delim
+    for k, seqs in sorted(levels.items()):
+        p = os.path.join(output, f"sequences-{k}.txt")
+        with open(p, "w") as fh:
+            for cand, support in sorted(seqs.items()):
+                fh.write(delim.join([*cand, f"{support:.6f}"]) + "\n")
+        outs.append(p)
+    return JobResult("candidateGenerationWithSelfJoin",
+                     {"GSP:MaxLength": max(levels) if levels else 0},
+                     outs, levels)
+
+
+@job("sequencePositionalCluster", "spc",
+     "org.avenir.sequence.SequencePositionalCluster")
+def positional_cluster_job(cfg: JobConfig, inputs: List[str], output: str) -> JobResult:
+    from avenir_tpu.models.sequence import EventLocalityAnalyzer, positional_cluster
+
+    analyzer = EventLocalityAnalyzer(
+        window_time_span=cfg.assert_float("window.time.span"),
+        time_step=cfg.get_float("window.time.step", 1.0),
+        score_threshold=cfg.get_float("score.threshold", 0.5),
+        min_occurence=cfg.get_int("min.occurence", 2),
+    )
+    rows = [[t.strip() for t in ln.split(cfg.field_delim_regex)]
+            for p in inputs for ln in _read_lines(p)]
+    quant_ord = cfg.get_int("quant.field.ordinal", 2)
+    seq_ord = cfg.get_int("seq.num.field.ordinal", 1)
+    thresh = cfg.get_float("quant.threshold")
+    cond = (lambda v: v >= thresh) if thresh is not None else (lambda v: True)
+    clusters = positional_cluster(rows, analyzer, quant_ord, seq_ord, cond)
+    out = _out_file(output)
+    delim = cfg.field_delim
+    with open(out, "w") as fh:
+        for pos, score in clusters:
+            fh.write(f"{pos:.4f}{delim}{score:.6f}\n")
+    return JobResult("sequencePositionalCluster",
+                     {"Windows:Found": len(clusters)}, [out], clusters)
+
+
+@job("eventTimeDistribution", "etd",
+     "org.avenir.spark.sequence.EventTimeDistribution")
+def event_time_job(cfg: JobConfig, inputs: List[str], output: str) -> JobResult:
+    """Inter-arrival time histogram (EventTimeDistribution.scala:27):
+    rows are id,timestamp... grouped by id."""
+    from avenir_tpu.models.markov import event_time_distribution
+
+    ts_ord = cfg.get_int("time.stamp.field.ordinal", 1)
+    by_id: Dict[str, List[float]] = {}
+    for p in inputs:
+        for ln in _read_lines(p):
+            toks = [t.strip() for t in ln.split(cfg.field_delim_regex)]
+            by_id.setdefault(toks[0], []).append(float(toks[ts_ord]))
+    seqs = [sorted(v) for v in by_id.values()]
+    hist = event_time_distribution(
+        seqs, num_buckets=cfg.get_int("num.buckets", 24),
+        bucket_width=cfg.get_float("bucket.width", 3600.0))
+    out = _out_file(output)
+    delim = cfg.field_delim
+    with open(out, "w") as fh:
+        for b, c in enumerate(hist):
+            fh.write(f"{b}{delim}{int(c)}\n")
+    return JobResult("eventTimeDistribution",
+                     {"Basic:Entities": len(by_id)}, [out], hist)
+
+
 # ================================================================ association
 @job("frequentItemsApriori", "fia",
      "org.avenir.association.FrequentItemsApriori", "apriori")

@@ -1,0 +1,176 @@
+"""Runner coverage for the explore / cluster / sequence job families."""
+
+import os
+
+import numpy as np
+import pytest
+
+from avenir_tpu.data import churn_schema, elearn_schema, generate_churn, generate_elearn
+from avenir_tpu.runner import job_names, run_job
+from tests.test_runner import ds_to_csv
+
+
+@pytest.fixture(scope="module")
+def churn(tmp_path_factory):
+    d = tmp_path_factory.mktemp("rx_churn")
+    schema = str(d / "churn.json")
+    churn_schema().save(schema)
+    data = str(d / "data.csv")
+    with open(data, "w") as fh:
+        fh.write(generate_churn(400, seed=60, as_csv=True))
+    return {"schema": schema, "data": data}
+
+
+@pytest.fixture(scope="module")
+def elearn(tmp_path_factory):
+    d = tmp_path_factory.mktemp("rx_elearn")
+    schema = str(d / "elearn.json")
+    elearn_schema().save(schema)
+    data = str(d / "data.csv")
+    with open(data, "w") as fh:
+        fh.write(ds_to_csv(generate_elearn(200, seed=61)))
+    return {"schema": schema, "data": data}
+
+
+def test_registry_covers_all_job_families():
+    names = job_names()
+    for n in ["cramerCorrelation", "categoricalCorrelation",
+              "heterogeneityReduction", "numericalCorrelation",
+              "reliefFeatureRelevance", "categoricalClassAffinity",
+              "categoricalContinuousEncoding", "topMatchesByClass",
+              "underSamplingBalancer", "baggingSampler",
+              "agglomerativeGraphical", "clusterTrain",
+              "candidateGenerationWithSelfJoin",
+              "sequencePositionalCluster", "eventTimeDistribution",
+              "recordSimilarity", "groupedRecordSimilarity",
+              "classPartitionGenerator", "dataPartitioner",
+              "contTimeStateTransitionStats"]:
+        assert n in names, n
+
+
+def test_correlation_jobs(churn, tmp_path):
+    props = {"crc.feature.schema.file.path": churn["schema"],
+             "hrc.feature.schema.file.path": churn["schema"]}
+    res = run_job("cramerCorrelation", props, [churn["data"]],
+                  str(tmp_path / "crc.txt"))
+    assert all(0.0 <= v <= 1.0 + 1e-9 for v in res.payload.values())
+    res = run_job("heterogeneityReduction", props, [churn["data"]],
+                  str(tmp_path / "hrc.txt"))
+    assert len(res.payload) > 0
+
+
+def test_numerical_and_relief_jobs(elearn, tmp_path):
+    props = {"nuc.feature.schema.file.path": elearn["schema"],
+             "ffr.feature.schema.file.path": elearn["schema"],
+             "ffr.sample.size": "100"}
+    res = run_job("numericalCorrelation", props, [elearn["data"]],
+                  str(tmp_path / "nuc.txt"))
+    corr = res.payload
+    assert np.allclose(np.diag(corr), 1.0, atol=1e-5)
+    res = run_job("reliefFeatureRelevance", props, [elearn["data"]],
+                  str(tmp_path / "ffr.txt"))
+    # the elearn features all separate the classes: positive relevance
+    assert all(v > 0 for v in res.payload.values())
+
+
+def test_affinity_and_encoding_jobs(churn, tmp_path):
+    props = {"cca.feature.schema.file.path": churn["schema"],
+             "coe.feature.schema.file.path": churn["schema"],
+             "coe.pos.class.attr.value": "closed"}
+    res = run_job("categoricalClassAffinity", props, [churn["data"]],
+                  str(tmp_path / "cca.txt"))
+    assert 1 in res.payload          # minUsed ordinal
+    res = run_job("categoricalContinuousEncoding", props, [churn["data"]],
+                  str(tmp_path / "coe.txt"))
+    enc = res.payload[3]             # CSCalls: high should skew to churn
+    assert enc["high"] > enc["low"]
+
+
+def test_sampler_jobs(churn, tmp_path):
+    props = {"usb.feature.schema.file.path": churn["schema"],
+             "bas.feature.schema.file.path": churn["schema"],
+             "bas.sample.rate": "0.5"}
+    res = run_job("underSamplingBalancer", props, [churn["data"]],
+                  str(tmp_path / "usb.txt"))
+    lines = open(res.outputs[0]).read().splitlines()
+    labels = [ln.split(",")[6] for ln in lines]
+    assert labels.count("open") == labels.count("closed")
+    res = run_job("baggingSampler", props, [churn["data"]],
+                  str(tmp_path / "bas.txt"))
+    assert res.counters["Basic:Records"] == 200
+
+
+def test_top_matches_job(elearn, tmp_path):
+    props = {"tmc.feature.schema.file.path": elearn["schema"],
+             "tmc.top.match.count": "3"}
+    res = run_job("topMatchesByClass", props, [elearn["data"]],
+                  str(tmp_path / "tmc.txt"))
+    assert set(res.payload) == {"fail", "pass"}
+
+
+def test_agglomerative_job_from_distance_file(tmp_path):
+    # 2 tight groups: (a,b) close, (c,d) close, far apart
+    dist = str(tmp_path / "dist.txt")
+    with open(dist, "w") as fh:
+        fh.write("a,b,100\nc,d,120\na,c,900\na,d,910\nb,c,920\nb,d,930\n")
+    out = str(tmp_path / "clusters.txt")
+    res = run_job("agglomerativeGraphical", {"agg.num.clusters": "2"},
+                  [dist], out)
+    assert res.counters["Cluster:Count"] == 2
+    assign = dict(ln.split(",") for ln in open(out).read().splitlines())
+    assert assign["a"] == assign["b"]
+    assert assign["c"] == assign["d"]
+    assert assign["a"] != assign["c"]
+
+
+def test_cluster_train_job(elearn, tmp_path):
+    props = {"train.feature.schema.file.path": elearn["schema"],
+             "train.algo": "kmeans", "train.num.clusters": "2"}
+    res = run_job("clusterTrain", props, [elearn["data"]],
+                  str(tmp_path / "km.txt"))
+    lines = open(res.outputs[0]).read().splitlines()
+    assert len(lines) == 200
+    assert res.counters["Cluster:Cohesion"] > 0
+
+
+def test_gsp_job(tmp_path):
+    seq_path = str(tmp_path / "seqs.csv")
+    with open(seq_path, "w") as fh:
+        for i in range(60):
+            fh.write(f"s{i},login,browse,buy\n")
+    props = {"cgs.support.threshold": "0.5", "cgs.item.set.length": "2"}
+    res = run_job("candidateGenerationWithSelfJoin", props, [seq_path],
+                  str(tmp_path / "gsp"))
+    assert res.counters["GSP:MaxLength"] >= 2
+    two = res.payload[2]
+    assert ("login", "browse") in two
+
+
+def test_event_time_job(tmp_path):
+    data = str(tmp_path / "events.csv")
+    with open(data, "w") as fh:
+        for e in range(5):
+            for i in range(10):
+                fh.write(f"u{e},{i * 100}\n")
+    props = {"etd.num.buckets": "4", "etd.bucket.width": "100"}
+    res = run_job("eventTimeDistribution", props, [data],
+                  str(tmp_path / "etd.txt"))
+    assert res.counters["Basic:Entities"] == 5
+    # all gaps are 100 -> bucket 1 holds everything
+    assert res.payload[1] == 45
+
+
+def test_positional_cluster_job(tmp_path):
+    data = str(tmp_path / "pos.csv")
+    with open(data, "w") as fh:
+        # burst of high values around t=50
+        for t in [10, 48, 50, 52, 90]:
+            fh.write(f"e,{t},{9 if 45 <= t <= 55 else 1}\n")
+    props = {"spc.window.time.span": "10", "spc.window.time.step": "5",
+             "spc.score.threshold": "0.1", "spc.quant.threshold": "5",
+             "spc.min.occurence": "2"}
+    res = run_job("sequencePositionalCluster", props, [data],
+                  str(tmp_path / "spc.txt"))
+    assert res.counters["Windows:Found"] >= 1
+    positions = [p for p, _ in res.payload]
+    assert any(40 <= p <= 60 for p in positions)
