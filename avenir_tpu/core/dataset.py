@@ -119,13 +119,26 @@ class Dataset:
         else:
             raise FileNotFoundError(f"no such CSV file: {source!r}")
         numeric = [f.ordinal for f in schema.fields if f.is_numeric]
-        categorical = [(f.ordinal, f.cardinality)
-                       for f in schema.fields if f.is_categorical]
+        # categoricals with a fixed declared vocabulary encode in C; those
+        # with an undeclared (data-discovered, growable) vocabulary come
+        # back as tokens and encode below
+        declared = [f for f in schema.fields if f.is_categorical
+                    and f.cardinality and not f.discovered_cardinality]
+        undeclared = [f for f in schema.fields if f.is_categorical
+                      and (not f.cardinality or f.discovered_cardinality)]
+        categorical = [(f.ordinal, f.cardinality) for f in declared]
         strings = [f.ordinal for f in schema.fields
                    if not f.is_numeric and not f.is_categorical]
+        strings += [f.ordinal for f in undeclared]
         try:
             n, columns = parse_csv_native(data, delim, numeric, categorical,
                                           strings)
+            for fld in undeclared:
+                toks = columns[fld.ordinal]
+                _discover_cardinality(fld, toks.tolist())
+                index = fld.cardinality_index()
+                columns[fld.ordinal] = np.array(
+                    [index[t] for t in toks], dtype=np.int32)
         except ValueError as e:
             # align cardinality errors with the Python parser (field name);
             # other ValueErrors (e.g. invalid numerics) pass through as-is
@@ -154,6 +167,7 @@ class Dataset:
             o = fld.ordinal
             toks = [r[o] if o < len(r) else "" for r in rows]
             if fld.is_categorical:
+                _discover_cardinality(fld, toks)
                 index = fld.cardinality_index()
                 try:
                     columns[o] = np.array([index[t] for t in toks], dtype=np.int32)
@@ -281,6 +295,24 @@ class Dataset:
 
     def __repr__(self) -> str:
         return f"Dataset(n={self.n_rows}, fields={len(self.schema)})"
+
+
+def _discover_cardinality(fld, tokens) -> None:
+    """Categorical fields may ship without a declared cardinality (e.g.
+    `status` in the reference's elearnActivity.json rich schema) — the
+    value set is then discovered from the data, sorted for determinism,
+    and recorded on the (shared) schema field so later splits parsed
+    against the same schema object encode consistently; unseen values in
+    later splits extend the vocabulary instead of raising."""
+    if fld.cardinality:
+        if fld.discovered_cardinality:
+            known = set(fld.cardinality)
+            new = sorted({t for t in tokens} - known)
+            if new:
+                fld.cardinality.extend(new)
+        return
+    fld.cardinality = sorted({t for t in tokens})
+    fld.discovered_cardinality = True
 
 
 def pad_rows(n: int, multiple: int) -> int:

@@ -45,8 +45,10 @@ class FeatureField:
     id_field: bool = False
     feature: bool = False
     class_attr: bool = False
-    # categorical metadata
+    # categorical metadata; discovered_cardinality marks a vocabulary that
+    # was inferred from data (undeclared in the schema file) and may grow
     cardinality: List[str] = field(default_factory=list)
+    discovered_cardinality: bool = False
     # numeric metadata (binning / split hints)
     min: Optional[float] = None
     max: Optional[float] = None
@@ -124,6 +126,7 @@ class FeatureField:
             "bucketWidth",
             "maxSplit",
             "splitScanInterval",
+            "discoveredCardinality",
         }
         return cls(
             name=obj.get("name", f"field{obj.get('ordinal')}"),
@@ -133,6 +136,8 @@ class FeatureField:
             feature=bool(obj.get("feature", False)),
             class_attr=bool(obj.get("classAttribute", False)),
             cardinality=[str(v) for v in obj.get("cardinality", [])],
+            discovered_cardinality=bool(obj.get("discoveredCardinality",
+                                                False)),
             min=obj.get("min"),
             max=obj.get("max"),
             bucket_width=obj.get("bucketWidth"),
@@ -152,6 +157,9 @@ class FeatureField:
             obj["classAttribute"] = True
         if self.cardinality:
             obj["cardinality"] = list(self.cardinality)
+        if self.discovered_cardinality:
+            # keeps a data-discovered vocabulary growable after reload
+            obj["discoveredCardinality"] = True
         for key, val in (
             ("min", self.min),
             ("max", self.max),
@@ -174,15 +182,30 @@ class FeatureSchema:
     the Bayesian jobs even though it carries no explicit role flag).
     """
 
-    def __init__(self, fields: Sequence[FeatureField]):
+    def __init__(self, fields: Sequence[FeatureField],
+                 dist_algorithm: Optional[str] = None,
+                 entity_name: Optional[str] = None):
         self.fields: List[FeatureField] = sorted(fields, key=lambda f: f.ordinal)
         self._by_ordinal = {f.ordinal: f for f in self.fields}
         self._by_name = {f.name: f for f in self.fields}
+        self.dist_algorithm = dist_algorithm
+        self.entity_name = entity_name
 
     # --------------------------------------------------------------- loading
     @classmethod
     def from_json(cls, obj: Dict[str, Any]) -> "FeatureSchema":
-        return cls([FeatureField.from_json(f) for f in obj["fields"]])
+        """Accepts both the plain FeatureSchema layout ({"fields": [...]},
+        resource/churn.json) and the sifarish rich-attribute wrapper
+        ({"distAlgorithm", "entity": {"name", "fields"}},
+        resource/elearnActivity.json consumed at knn.sh:46)."""
+        if "fields" in obj:
+            return cls([FeatureField.from_json(f) for f in obj["fields"]])
+        if "entity" in obj:
+            ent = obj["entity"]
+            return cls([FeatureField.from_json(f) for f in ent["fields"]],
+                       dist_algorithm=obj.get("distAlgorithm"),
+                       entity_name=ent.get("name"))
+        raise ValueError("schema JSON has neither 'fields' nor 'entity'")
 
     @classmethod
     def from_file(cls, path: str) -> "FeatureSchema":
@@ -216,7 +239,18 @@ class FeatureSchema:
 
     @property
     def feature_fields(self) -> List[FeatureField]:
-        return [f for f in self.fields if f.feature]
+        """Fields in the feature role. When no field carries an explicit
+        `feature` flag (the sifarish rich schemas, e.g. elearnActivity.json,
+        mark only id/class roles), every non-id, non-class field is
+        implicitly a feature — the convention SameTypeSimilarity applies."""
+        explicit = [f for f in self.fields if f.feature]
+        if explicit:
+            return explicit
+        cf = self.class_field
+        cls_ord = cf.ordinal if cf is not None else -1
+        return [f for f in self.fields
+                if not f.id_field and not f.class_attr
+                and f.ordinal != cls_ord]
 
     @property
     def feature_ordinals(self) -> List[int]:

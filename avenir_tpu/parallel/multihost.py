@@ -1,0 +1,67 @@
+"""Multi-host scale-out: jax.distributed + per-host ingest.
+
+The reference scales ingest by HDFS input splits — each mapper reads its
+local block (SURVEY §2.12). The TPU-pod analog: one process per host
+(jax.distributed), each host reads its own CSV shard, and
+`jax.make_array_from_process_local_data` assembles the global row-sharded
+array without any host ever materializing the whole dataset. Collectives
+then ride ICI within a slice and DCN across slices — XLA owns the
+transport; there is no NCCL/MPI analog to manage.
+
+Single-process usage degrades transparently: `initialize()` is a no-op
+with one process and `global_rows` is then just a device_put.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional, Sequence
+
+import numpy as np
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from avenir_tpu.parallel.mesh import DATA_AXIS, data_mesh
+
+
+def initialize(coordinator_address: Optional[str] = None,
+               num_processes: Optional[int] = None,
+               process_id: Optional[int] = None) -> int:
+    """Bring up jax.distributed when running multi-process. On TPU pods the
+    three arguments auto-detect from the environment; elsewhere pass them
+    explicitly. Returns the process count. Safe to call in a single-process
+    run (no-op)."""
+    n = num_processes if num_processes is not None else int(
+        os.environ.get("JAX_NUM_PROCESSES", "1"))
+    if n <= 1 and coordinator_address is None:
+        return 1
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+    return jax.process_count()
+
+
+def global_mesh(model_parallel: int = 1) -> Mesh:
+    """The pod-wide (data[, model]) mesh over every process's devices."""
+    return data_mesh(jax.devices(), model_parallel=model_parallel)
+
+
+def host_shard_bounds(n_rows_global: int) -> tuple:
+    """[lo, hi) row range this host should ingest — the input-split
+    assignment, contiguous per process."""
+    p, i = jax.process_count(), jax.process_index()
+    per = (n_rows_global + p - 1) // p
+    lo = min(i * per, n_rows_global)
+    return lo, min(lo + per, n_rows_global)
+
+
+def global_rows(mesh: Mesh, local_rows: np.ndarray) -> jax.Array:
+    """Assemble a globally row-sharded array from this host's local rows
+    (each host passes only its own shard; shapes must agree across hosts
+    up to the row count). Single-process: a plain sharded device_put."""
+    sharding = NamedSharding(mesh, P(DATA_AXIS))
+    if jax.process_count() == 1:
+        return jax.device_put(local_rows, sharding)
+    return jax.make_array_from_process_local_data(sharding, local_rows)

@@ -193,3 +193,101 @@ class TestDataset:
         sub = ds.take(np.array([2, 0]))
         assert list(sub.ids()) == ["a3", "a1"]
         np.testing.assert_array_equal(sub.labels(), [1, 0])
+
+
+def test_rich_attribute_schema_wrapper():
+    """sifarish rich-schema layout (resource/elearnActivity.json): entity
+    wrapper + distAlgorithm, consumed by the similarity stage."""
+    from avenir_tpu.core.schema import FeatureSchema
+
+    s = FeatureSchema.from_string("""
+    {
+      "distAlgorithm": "euclidean",
+      "numericDiffThreshold": 0.2,
+      "entity": {
+        "name": "studentActivity",
+        "fields": [
+          {"name": "id", "ordinal": 0, "id": true, "dataType": "string"},
+          {"name": "score", "ordinal": 1, "dataType": "int",
+           "feature": true, "min": 0, "max": 100},
+          {"name": "status", "ordinal": 2, "dataType": "categorical",
+           "cardinality": ["fail", "pass"]}
+        ]
+      }
+    }""")
+    assert s.dist_algorithm == "euclidean"
+    assert s.entity_name == "studentActivity"
+    assert s.class_field.name == "status"
+    assert len(s.feature_fields) == 1
+
+
+def test_schema_rejects_unknown_layout():
+    from avenir_tpu.core.schema import FeatureSchema
+    import pytest as _pytest
+
+    with _pytest.raises(ValueError, match="fields"):
+        FeatureSchema.from_json({"something": []})
+
+
+def test_parses_actual_reference_schemas():
+    """When the reference checkout is present, every schema JSON it ships
+    must load (the verbatim-compat surface of SURVEY §5)."""
+    import glob
+    import pytest as _pytest
+
+    from avenir_tpu.core.schema import FeatureSchema
+
+    files = sorted(glob.glob("/root/reference/resource/*.json"))
+    if not files:
+        _pytest.skip("reference checkout not present")
+    for p in files:
+        s = FeatureSchema.from_file(p)
+        assert len(s.fields) > 0, p
+
+
+def test_undeclared_categorical_discovers_vocab():
+    """Categorical without declared cardinality (elearnActivity.json's
+    status field): vocabulary discovered from data, consistent across
+    splits parsed with the same schema, growable on unseen values."""
+    from avenir_tpu.core.dataset import Dataset
+    from avenir_tpu.core.schema import FeatureSchema
+
+    for engine in ("python", "native"):
+        s = FeatureSchema.from_json({"fields": [
+            {"name": "x", "ordinal": 0, "dataType": "double", "feature": True},
+            {"name": "status", "ordinal": 1, "dataType": "categorical"},
+        ]})
+        ds1 = Dataset.from_csv("1,pass\n2,fail\n3,pass\n", s, engine=engine)
+        assert s.field_by_name("status").cardinality == ["fail", "pass"]
+        np.testing.assert_array_equal(ds1.labels(), [1, 0, 1])
+        # a later split with only one value keeps the same codes
+        ds2 = Dataset.from_csv("4,pass\n", s, engine=engine)
+        np.testing.assert_array_equal(ds2.labels(), [1])
+        # and an unseen value extends instead of raising
+        ds3 = Dataset.from_csv("5,hold\n", s, engine=engine)
+        assert s.field_by_name("status").cardinality == ["fail", "pass", "hold"]
+        np.testing.assert_array_equal(ds3.labels(), [2])
+
+
+def test_implicit_feature_roles_without_flags():
+    """Rich schemas mark only id/class roles; everything else is a feature
+    (the convention the sifarish similarity stage applies)."""
+    from avenir_tpu.core.schema import FeatureSchema
+
+    s = FeatureSchema.from_json({"fields": [
+        {"name": "id", "ordinal": 0, "id": True, "dataType": "string"},
+        {"name": "a", "ordinal": 1, "dataType": "int", "min": 0, "max": 9},
+        {"name": "b", "ordinal": 2, "dataType": "double"},
+        {"name": "status", "ordinal": 3, "dataType": "categorical",
+         "cardinality": ["n", "y"]},
+    ]})
+    assert [f.name for f in s.feature_fields] == ["a", "b"]
+    assert s.class_field.name == "status"
+    # explicit flags still win
+    s2 = FeatureSchema.from_json({"fields": [
+        {"name": "a", "ordinal": 0, "dataType": "int", "feature": True},
+        {"name": "b", "ordinal": 1, "dataType": "int"},
+        {"name": "status", "ordinal": 2, "dataType": "categorical",
+         "cardinality": ["n", "y"]},
+    ]})
+    assert [f.name for f in s2.feature_fields] == ["a"]

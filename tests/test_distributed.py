@@ -172,3 +172,18 @@ def test_lr_mesh_equals_single_device(mesh8):
     sharded = LogisticRegression(iteration_limit=5).fit(ds, mesh=mesh8)
     np.testing.assert_allclose(sharded.coeff, single.coeff,
                                rtol=1e-4, atol=1e-5)
+
+
+def test_multihost_helpers_single_process(mesh8, rng):
+    import jax
+    from avenir_tpu.parallel import multihost
+
+    assert multihost.initialize() == 1
+    lo, hi = multihost.host_shard_bounds(1000)
+    assert (lo, hi) == (0, 1000)     # single process owns everything
+    rows = rng.normal(size=(64, 4)).astype(np.float32)
+    arr = multihost.global_rows(mesh8, rows)
+    assert arr.shape == (64, 4)
+    np.testing.assert_allclose(np.asarray(arr), rows)
+    # the array is actually row-sharded over the mesh
+    assert len(arr.sharding.device_set) == 8
