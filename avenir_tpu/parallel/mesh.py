@@ -34,7 +34,12 @@ def data_mesh(devices: Optional[Sequence] = None,
     devs = np.array(devices if devices is not None else jax.devices())
     n = devs.size
     if model_parallel > 1:
-        assert n % model_parallel == 0
+        if n % model_parallel != 0:
+            raise ValueError(
+                f"device count {n} is not divisible by "
+                f"model_parallel={model_parallel}; pass a device list whose "
+                "size is a multiple of the model axis (or model_parallel=1)"
+            )
         grid = devs.reshape(n // model_parallel, model_parallel)
         return Mesh(grid, (DATA_AXIS, MODEL_AXIS))
     return Mesh(devs.reshape(n), (DATA_AXIS,))
