@@ -1,0 +1,43 @@
+"""Scaling-efficiency bench: distributed NB + KNN over 1/2/4/8-device meshes.
+
+Prints ONE JSON line:
+  {"metric": "scaling_efficiency_nb_knn", "value": <geomean efficiency at
+   max devices>, "unit": "fraction_of_linear", "table": [...]}
+
+Runs on real chips when the host has them; otherwise bootstraps a virtual
+CPU device pool (same mechanism as __graft_entry__.dryrun_multichip). See
+avenir_tpu/parallel/scaling.py for what the virtual numbers do and don't
+mean.
+"""
+
+import json
+import sys
+
+
+def main(n_devices: int = 8):
+    from __graft_entry__ import _bootstrap_devices
+
+    devices = _bootstrap_devices(n_devices)
+    from avenir_tpu.parallel.scaling import measure_scaling
+
+    result = measure_scaling(devices)
+    eff = result["efficiency_at_max"]
+    value = float((eff["nb"] * eff["knn"]) ** 0.5)
+    platform = devices[0].platform
+    print(f"# platform={platform} table={result['table']}", file=sys.stderr)
+    line = {
+        "metric": "scaling_efficiency_nb_knn",
+        "value": round(value, 3),
+        "unit": "fraction_of_linear",
+        "devices": eff["devices"],
+        "platform": platform,
+        "table": result["table"],
+    }
+    if result.get("virtual_devices"):
+        line["virtual_devices"] = True
+        line["note"] = result["note"]
+    print(json.dumps(line))
+
+
+if __name__ == "__main__":
+    main(int(sys.argv[1]) if len(sys.argv) > 1 else 8)

@@ -1,0 +1,45 @@
+"""Scaling-efficiency harness smoke tests (virtual 8-device CPU mesh)."""
+
+import jax
+
+from avenir_tpu.parallel.scaling import measure_scaling
+
+
+def test_measure_scaling_shape_and_sanity():
+    result = measure_scaling(
+        jax.devices(), counts=(1, 2), nb_rows_per_device=2_048,
+        knn_queries_per_device=32, knn_train=512, iters=2,
+    )
+    table = result["table"]
+    assert [row["devices"] for row in table] == [1, 2]
+    for row in table:
+        assert row["nb_rows_per_sec"] > 0
+        assert row["knn_queries_per_sec"] > 0
+        assert row["nb_efficiency"] > 0
+        assert row["knn_efficiency"] > 0
+    assert table[0]["nb_efficiency"] == 1.0
+    assert table[0]["knn_efficiency"] == 1.0
+    assert result["efficiency_at_max"]["devices"] == 2
+    assert result["virtual_devices"] is True
+    assert "note" in result
+
+
+def test_measure_scaling_caps_counts_to_available():
+    result = measure_scaling(
+        jax.devices()[:2], counts=(1, 2, 4, 8), nb_rows_per_device=1_024,
+        knn_queries_per_device=16, knn_train=256, iters=1,
+    )
+    assert [row["devices"] for row in result["table"]] == [1, 2]
+
+
+def test_measure_scaling_baseline_not_one_device():
+    import pytest
+
+    result = measure_scaling(
+        jax.devices()[:4], counts=(2, 4), nb_rows_per_device=1_024,
+        knn_queries_per_device=16, knn_train=256, iters=1,
+    )
+    assert result["table"][0]["devices"] == 2
+    assert result["table"][0]["nb_efficiency"] == 1.0
+    with pytest.raises(ValueError, match="no requested device count"):
+        measure_scaling(jax.devices()[:1], counts=(2, 4))
