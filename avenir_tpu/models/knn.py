@@ -104,7 +104,12 @@ class NeighborIndex:
         block: int = 4096,
         approx: bool = False,
         use_pallas: Optional[bool] = None,
+        packed: bool = True,
     ):
+        """packed=True (default) routes the pallas path through the
+        packed-key insertion-network kernel — several times faster, with
+        distances quantized to ~2^-12 relative (below the pallas euclidean
+        path's own dot-form error); packed=False forces the exact kernel."""
         self.schema = train.schema
         # the reference takes "the first topMatchCount values" — a train set
         # smaller than k just yields all of it
@@ -139,11 +144,14 @@ class NeighborIndex:
             else (pallas_available() and x_cat is None and x_num.shape[1] > 0
                   and metric in ("euclidean", "manhattan") and not approx)
         )
+        self.packed = packed and self.use_pallas
         if self.use_pallas:
-            # pre-normalize by ranges once; pad to the kernel block
-            # (256x8192 f32 tile = 8 MB VMEM, the measured sweet spot)
+            # pre-normalize by ranges once; pad to the kernel block.
+            # packed kernel: block_t <= 4096 (12 index bits); exact kernel:
+            # 256x8192 f32 tile = 8 MB VMEM, the measured sweet spot
             x_num = x_num / np.maximum(ranges, 1e-9)
-            self.block = max(128, min(pad_rows(len(train), 128), 8192))
+            max_block = 4096 if self.packed else 8192
+            self.block = max(128, min(pad_rows(len(train), 128), max_block))
             t_num, x_cat, n_valid = pad_train(x_num, None, self.block)
         else:
             t_num, x_cat, n_valid = pad_train(x_num, x_cat, self.block)
@@ -171,7 +179,7 @@ class NeighborIndex:
             dist, idx = knn_topk_pallas(
                 jnp.asarray(q), self.t_num, k=self.k, block_q=bq,
                 block_t=self.block, metric=self.metric,
-                n_valid=self.n_valid)
+                n_valid=self.n_valid, packed=self.packed)
             return dist[:nq], idx[:nq]
         return blocked_topk_neighbors(
             jnp.asarray(q_num) if self.t_num is not None else None,

@@ -26,9 +26,29 @@ import numpy as np
 from avenir_tpu.parallel.mesh import DATA_AXIS, data_mesh
 
 
+def _timed_scalar(many_fn, *args) -> float:
+    """Best-of-2 wall clock of the jitted scalar-reducing many_fn, warmup
+    excluded, result forced to host with float(). Through the axon tunnel
+    jax.block_until_ready has been observed returning before results are
+    computed (see bench.py's timing note), so loop-and-block timing is
+    banned here; every measurement runs its iterations inside one program
+    and forces the scalar out."""
+    import jax.numpy as jnp
+
+    _ = float(many_fn(*args))
+    best = np.inf
+    for s in (1, 2):
+        shifted = (jnp.roll(args[0], s, axis=0),) + args[1:]
+        t0 = time.perf_counter()
+        _ = float(many_fn(*shifted))
+        best = min(best, time.perf_counter() - t0)
+    return best
+
+
 def _nb_rate(mesh, rows: int, iters: int) -> float:
     """Weak-scaling NB sufficient-stat rate (rows/sec) on the given mesh."""
     import jax
+    import jax.numpy as jnp
     from jax.sharding import NamedSharding, PartitionSpec as P
 
     from avenir_tpu.parallel.distributed import distributed_nb_train_fn
@@ -44,24 +64,23 @@ def _nb_rate(mesh, rows: int, iters: int) -> float:
     codes_d = jax.device_put(codes, shard)
     labels_d = jax.device_put(labels, shard)
     w_d = jax.device_put(w, shard)
-    # distinct input per timed iteration (memoized-replay guard; see bench.py)
-    variants = [
-        (jax.device_put(np.roll(codes, i + 1, axis=0), shard),
-         jax.device_put(np.roll(labels, i + 1), shard))
-        for i in range(iters)
-    ]
-    out = step(codes_d, labels_d, w_d)
-    jax.block_until_ready(out)
-    t0 = time.perf_counter()
-    for cv, lv in variants:
-        out = step(cv, lv, w_d)
-    jax.block_until_ready(out)
-    return rows * iters / (time.perf_counter() - t0)
+
+    @jax.jit
+    def many(codes_d, labels_d, w_d):
+        def body(i):
+            # distinct data per step: on-device roll along the feature axis
+            # keeps the row sharding intact (no cross-shard traffic)
+            out = step(jnp.roll(codes_d, i, axis=1), labels_d, w_d)
+            return sum(jnp.sum(o) for o in jax.tree.leaves(out))
+        return jax.lax.map(body, jnp.arange(1, iters + 1)).sum()
+
+    return rows * iters / _timed_scalar(many, codes_d, labels_d, w_d)
 
 
 def _knn_rate(mesh, queries: int, train: int, iters: int, k: int = 5) -> float:
     """Weak-scaling data-parallel KNN top-k rate (queries/sec)."""
     import jax
+    import jax.numpy as jnp
     from jax.sharding import NamedSharding, PartitionSpec as P
 
     from avenir_tpu.parallel.distributed import distributed_topk_fn
@@ -75,18 +94,18 @@ def _knn_rate(mesh, queries: int, train: int, iters: int, k: int = 5) -> float:
     rep = NamedSharding(mesh, P())
     step = distributed_topk_fn(mesh, k=k, metric="euclidean")
 
+    q_d = jax.device_put(q, q_spec)
     t_d = jax.device_put(t, rep)
     l_d = jax.device_put(t_labels, rep)
-    variants = [
-        jax.device_put(np.roll(q, i + 1, axis=0), q_spec) for i in range(iters)
-    ]
-    out = step(jax.device_put(q, q_spec), t_d, l_d)
-    jax.block_until_ready(out)
-    t0 = time.perf_counter()
-    for qv in variants:
-        out = step(qv, t_d, l_d)
-    jax.block_until_ready(out)
-    return queries * iters / (time.perf_counter() - t0)
+
+    @jax.jit
+    def many(q_d, t_d, l_d):
+        def body(i):
+            dist, labs = step(jnp.roll(q_d, i, axis=1), t_d, l_d)
+            return jnp.sum(dist) + jnp.sum(labs).astype(jnp.float32)
+        return jax.lax.map(body, jnp.arange(1, iters + 1)).sum()
+
+    return queries * iters / _timed_scalar(many, q_d, t_d, l_d)
 
 
 def measure_scaling(

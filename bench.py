@@ -1,16 +1,27 @@
 """Benchmark: Naive Bayes + KNN throughput on the local chip.
 
 Prints ONE JSON line:
-  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N, ...extras}
 
 Workloads (the BASELINE.json north-star configs #1/#2):
 - Naive Bayes churn: sufficient-stat training pass + posterior predict pass
   over encoded rows (one-hot einsum contractions on the MXU).
-- KNN elearn: blocked streaming top-k (euclidean = matmul path) queries
-  against a train corpus, kernel vote included.
+- KNN elearn-shaped, two configs: d=8 (the reference's feature width —
+  memory/VPU-bound by construction at 8 MACs = 16 FLOPs per distance) and
+  d=128 (the euclidean-as-matmul regime where MFU is meaningful), both
+  through the packed-key pallas kernel (ops/pallas_knn.py), which is also
+  what NeighborIndex uses on TPU (models/knn.py packed=True default).
 
-value = harmonic mean of NB rows/sec and KNN query rows/sec — the rate of a
-pipeline that runs every row through both model families, per chip.
+Timing methodology (round 2 fix): through the axon tunnel,
+jax.block_until_ready has been observed returning without the result being
+computed/fetchable (a subsequent host fetch of "ready" arrays took seconds),
+so loop-and-block-at-the-end timings overstate throughput badly. Every
+measurement here runs M steps inside ONE jitted lax.map — each step on
+distinct data (on-device roll; the execution path memoizes repeated
+(executable, input) pairs) — reduces to a scalar, and forces it to host
+with float(). Dispatch+tunnel overhead is amortized over M steps and the
+scalar transfer is negligible. Numbers are NOT comparable to round 1's
+(inflated) BENCH_r01.json.
 
 vs_baseline: the reference publishes no numbers (BASELINE.md); the
 north-star target is >=50x a 32-node Hadoop cluster on NB+KNN. The two
@@ -22,7 +33,7 @@ the 32-node Hadoop reference:
 - KNN: sifarish SameTypeSimilarity computes all pair distances in JVM text
   records; assume 1e6 pair-distances/sec/node = 3.2e7 pairs/sec for 32
   nodes; at this bench's corpus size (KNN_TRAIN) that is
-  3.2e7 / KNN_TRAIN queries/sec (~244 q/s).
+  3.2e7 / KNN_TRAIN queries/sec (~244 q/s), evaluated at the d=8 config.
 """
 
 import json
@@ -35,13 +46,41 @@ HADOOP_NB_ROWS_PER_SEC = 1.0e6
 HADOOP_PAIR_DIST_PER_SEC = 3.2e7
 
 NB_ROWS = 1_000_000
-NB_ITERS = 8
+NB_STEPS = 8
 KNN_QUERIES = 8_192
 KNN_TRAIN = 131_072
-KNN_ITERS = 12
+KNN_STEPS = 8
 KNN_K = 5
 KNN_BLOCK = 32_768
-KNN_DIM = 8
+
+# bf16 peak matmul throughput per chip; MFU for f32 work is reported against
+# the same number (conservative). Fallback is v5e.
+PEAK_FLOPS = {
+    "TPU v5 lite": 197e12,
+    "TPU v5e": 197e12,
+    "TPU v5p": 459e12,
+    "TPU v4": 275e12,
+    "TPU v6 lite": 918e12,
+    "TPU v6e": 918e12,
+}
+DEFAULT_PEAK = 197e12
+
+
+def _timed(many_fn, *args, repeats: int = 3) -> float:
+    """Best wall-clock of `repeats` calls of the jitted scalar-reducing
+    many_fn; one untimed warmup compiles. Each repeat perturbs the first
+    arg by an on-device roll so no (executable, input) pair repeats."""
+    import jax
+    import jax.numpy as jnp
+
+    _ = float(many_fn(*args))
+    best = np.inf
+    for s in range(1, repeats + 1):
+        shifted = (jnp.roll(args[0], s, axis=-1),) + args[1:]
+        t0 = time.perf_counter()
+        _ = float(many_fn(*shifted))
+        best = min(best, time.perf_counter() - t0)
+    return best
 
 
 def bench_naive_bayes():
@@ -68,41 +107,41 @@ def bench_naive_bayes():
     w = jnp.ones((n,), jnp.float32)
     x_cont = jnp.zeros((n, 0), jnp.float32)
 
-    # one DISTINCT staged input per timed iteration: the execution path has
-    # been observed to serve repeated (executable, input) pairs ~10x faster
-    # than fresh inputs, so an honest rate must never repeat a buffer
-    # (variants stage before the warmup call, whose block_until_ready
-    # flushes the whole stream)
-    # shifts start at 1: shift 0 would replay the warmup call's exact value
-    codes_v = [jnp.roll(codes_d, i, axis=0) for i in range(1, NB_ITERS + 1)]
-    labels_v = [jnp.roll(labels_d, i) for i in range(1, NB_ITERS + 1)]
+    @jax.jit
+    def train_many(codes_d, labels_d, w):
+        def step(i):
+            # distinct data per step: on-device roll (cheap copy)
+            c = jnp.roll(codes_d, i, axis=0)
+            l = jnp.roll(labels_d, i)
+            out = _count_batch_kernel(c, l, x_cont, w, k, bmax)
+            return sum(jnp.sum(o) for o in jax.tree.leaves(out))
+        return jax.lax.map(step, jnp.arange(1, NB_STEPS + 1)).sum()
 
-    # train pass
-    out = _count_batch_kernel(codes_d, labels_d, x_cont, w, k, bmax)
-    jax.block_until_ready(out)
-    t0 = time.perf_counter()
-    for i in range(NB_ITERS):
-        out = _count_batch_kernel(codes_v[i], labels_v[i],
-                                  x_cont, w, k, bmax)
-    jax.block_until_ready(out)
-    train_rps = n * NB_ITERS / (time.perf_counter() - t0)
+    train_rps = n * NB_STEPS / _timed(train_many, codes_d, labels_d, w)
 
-    # predict pass
     pred = NaiveBayesPredictor(model)
-    out = pred._predict(codes_d, x_cont, pred.tables)
-    jax.block_until_ready(out)
-    t0 = time.perf_counter()
-    for i in range(NB_ITERS):
-        out = pred._predict(codes_v[i], x_cont, pred.tables)
-    jax.block_until_ready(out)
-    predict_rps = n * NB_ITERS / (time.perf_counter() - t0)
+
+    @jax.jit
+    def predict_many(codes_d):
+        def step(i):
+            c = jnp.roll(codes_d, i, axis=0)
+            out = pred._predict(c, x_cont, pred.tables)
+            return sum(jnp.sum(o).astype(jnp.float32)
+                       for o in jax.tree.leaves(out))
+        return jax.lax.map(step, jnp.arange(1, NB_STEPS + 1)).sum()
+
+    predict_rps = n * NB_STEPS / _timed(predict_many, codes_d)
 
     # a "row processed" = trained on + predicted once
     rps = 1.0 / (1.0 / train_rps + 1.0 / predict_rps)
     return train_rps, predict_rps, rps
 
 
-def bench_knn():
+def bench_knn(dim: int):
+    """One fused classify step (top-k + kernel vote) per query batch.
+
+    Returns (queries/sec, achieved FLOP/s) counting only the 2*nq*nt*d
+    distance matmul flops (vote flops are negligible)."""
     import jax
     import jax.numpy as jnp
     from avenir_tpu.models.knn import _vote
@@ -110,51 +149,55 @@ def bench_knn():
     from avenir_tpu.ops.pallas_knn import knn_topk_pallas, pallas_available
 
     rng = np.random.default_rng(2)
-    # one distinct query set per timed iteration, plus one for warmup
-    # (see bench_naive_bayes note)
-    qs = [jnp.asarray(rng.normal(size=(KNN_QUERIES, KNN_DIM)).astype(np.float32))
-          for _ in range(KNN_ITERS + 1)]
-    t = jnp.asarray(rng.normal(size=(KNN_TRAIN, KNN_DIM)).astype(np.float32))
+    q = jnp.asarray(rng.normal(size=(KNN_QUERIES, dim)).astype(np.float32))
+    t = jnp.asarray(rng.normal(size=(KNN_TRAIN, dim)).astype(np.float32))
     t_labels = jnp.asarray(rng.integers(0, 2, KNN_TRAIN).astype(np.int32))
     use_pallas = pallas_available()
 
-    # whole classify step in ONE jitted program — separate dispatches for
-    # top-k / gather / vote were dispatch-latency-bound through the tunnel
     @jax.jit
-    def step(q, t, t_labels):
-        if use_pallas:
-            # fused VMEM distance-tile + iterative-min top-k kernel
-            dist, idx = knn_topk_pallas(q, t, k=KNN_K, metric="euclidean")
-        else:
-            dist, idx = blocked_topk_neighbors(
-                q, t, k=KNN_K, block=KNN_BLOCK, metric="euclidean"
-            )
-        return _vote(dist, t_labels[idx], jnp.ones_like(dist),
-                     "gaussian", 30.0, 2, False, False)
+    def classify_many(q, t, t_labels):
+        def step(i):
+            qi = jnp.roll(q, i, axis=0)
+            if use_pallas:
+                # packed-key insertion-network kernel: tile stays in VMEM
+                dist, idx = knn_topk_pallas(qi, t, k=KNN_K, block_q=512,
+                                            block_t=4096,
+                                            metric="euclidean", packed=True)
+            else:
+                dist, idx = blocked_topk_neighbors(
+                    qi, t, k=KNN_K, block=KNN_BLOCK, metric="euclidean")
+            scores = _vote(dist, t_labels[idx], jnp.ones_like(dist),
+                           "gaussian", 30.0, 2, False, False)
+            return jnp.sum(scores).astype(jnp.float32)
+        return jax.lax.map(step, jnp.arange(1, KNN_STEPS + 1)).sum()
 
-    out = step(qs[KNN_ITERS], t, t_labels)   # dedicated warmup set
-    jax.block_until_ready(out)
-    t0 = time.perf_counter()
-    for i in range(KNN_ITERS):
-        out = step(qs[i], t, t_labels)
-    jax.block_until_ready(out)
-    qps = KNN_QUERIES * KNN_ITERS / (time.perf_counter() - t0)
-    return qps
+    dt = _timed(classify_many, q, t, t_labels)
+    qps = KNN_QUERIES * KNN_STEPS / dt
+    flops = 2.0 * KNN_QUERIES * KNN_TRAIN * dim * KNN_STEPS / dt
+    return qps, flops
 
 
 def main():
     import jax
 
     dev = jax.devices()[0]
+    peak = PEAK_FLOPS.get(dev.device_kind, DEFAULT_PEAK)
     train_rps, predict_rps, nb_rps = bench_naive_bayes()
-    knn_qps = bench_knn()
+    knn_qps, knn_flops = bench_knn(8)
+    knn_qps_hi, knn_flops_hi = bench_knn(128)
     combined = 2.0 / (1.0 / nb_rps + 1.0 / knn_qps)
     nb_speedup = nb_rps / HADOOP_NB_ROWS_PER_SEC
     knn_speedup = knn_qps / (HADOOP_PAIR_DIST_PER_SEC / KNN_TRAIN)
     vs_baseline = float(np.sqrt(nb_speedup * knn_speedup))
+    mfu_d8 = knn_flops / peak
+    mfu_d128 = knn_flops_hi / peak
     print(
         f"# device={dev.device_kind} nb_train={train_rps:.3e} "
-        f"nb_predict={predict_rps:.3e} nb={nb_rps:.3e} knn={knn_qps:.3e} rows/s "
+        f"nb_predict={predict_rps:.3e} nb={nb_rps:.3e} knn_d8={knn_qps:.3e} "
+        f"q/s ({knn_flops/1e12:.1f} TF/s, MFU {mfu_d8*100:.1f}% — d=8 is "
+        f"8 MACs (16 FLOPs)/distance, VPU/memory-bound by construction) "
+        f"knn_d128={knn_qps_hi:.3e} q/s ({knn_flops_hi/1e12:.1f} TF/s, "
+        f"MFU {mfu_d128*100:.1f}%) "
         f"nb_speedup={nb_speedup:.1f}x knn_speedup={knn_speedup:.1f}x",
         file=sys.stderr,
     )
@@ -163,6 +206,15 @@ def main():
         "value": round(combined, 1),
         "unit": "rows/sec",
         "vs_baseline": round(vs_baseline, 2),
+        "nb_rows_per_sec": round(nb_rps, 1),
+        "knn_d8_qps": round(knn_qps, 1),
+        "knn_d128_qps": round(knn_qps_hi, 1),
+        "knn_d128_tflops": round(knn_flops_hi / 1e12, 2),
+        "knn_d128_mfu": round(mfu_d128, 4),
+        "peak_tflops": round(peak / 1e12, 1),
+        "timing_note": ("scan-amortized, scalar-forced timing; NOT "
+                        "comparable to BENCH_r01 (block_until_ready through "
+                        "the axon tunnel returns early, inflating r01)"),
     }))
 
 

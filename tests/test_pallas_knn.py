@@ -74,3 +74,51 @@ def test_kernel_small_train_fills_with_sentinels():
     d0, i0 = np.asarray(got_d)[0], np.asarray(got_i)[0]
     assert np.isfinite(d0[:2]).all() and set(i0[:2]) == {0, 1}
     assert np.isinf(d0[2:]).all() and (i0[2:] == -1).all()
+
+
+@pytest.mark.parametrize("case", ["basic", "pad", "tiny", "multiblock"])
+def test_packed_kernel_matches_oracle(case):
+    """Packed-key insertion-network path: quantized to ~2^-12 relative but
+    must find the same neighbor sets as the exact oracle."""
+    rng = np.random.default_rng(3)
+    nq, d, k = 128, 8, 5
+    q = rng.normal(size=(nq, d)).astype(np.float32)
+    if case == "tiny":
+        t = rng.normal(size=(3, d)).astype(np.float32)
+    elif case == "multiblock":
+        t = rng.normal(size=(1024, d)).astype(np.float32)
+    else:
+        t = rng.normal(size=(300 if case == "pad" else 512, d)).astype(
+            np.float32)
+    t_pad, _, n_valid = pad_train(t, None, 256)
+
+    got_d, got_i = knn_topk_pallas(
+        jnp.asarray(q), jnp.asarray(t_pad), k=k, block_q=128, block_t=256,
+        n_valid=n_valid, interpret=True, packed=True)
+    got_d, got_i = np.asarray(got_d), np.asarray(got_i)
+
+    full = np.sqrt(((q[:, None, :] - t[None, :, :]) ** 2).mean(-1))
+    order = np.argsort(full, axis=1)[:, :k]
+    kk = min(k, t.shape[0])
+    ref_d = np.take_along_axis(full, order, axis=1)
+
+    np.testing.assert_allclose(got_d[:, :kk], ref_d[:, :kk],
+                               rtol=3e-4, atol=1e-5)
+    # neighbor-set recall (ties within quantization may reorder)
+    recall = np.mean([
+        len(set(got_i[r, :kk]) & set(order[r, :kk])) / kk for r in range(nq)
+    ])
+    assert recall >= 0.99
+    if kk < k:  # unfillable slots
+        assert np.isinf(got_d[:, kk:]).all()
+        assert (got_i[:, kk:] == -1).all()
+    # ascending within the filled slots (diff of two infs is NaN)
+    assert (np.diff(got_d[:, :kk], axis=1) >= -1e-7).all()
+
+
+def test_packed_kernel_rejects_oversize_block():
+    q = np.zeros((128, 2), np.float32)
+    t = np.zeros((8192, 2), np.float32)
+    with pytest.raises(AssertionError, match="packed"):
+        knn_topk_pallas(jnp.asarray(q), jnp.asarray(t), k=2, block_q=128,
+                        block_t=8192, interpret=True, packed=True)
