@@ -277,7 +277,10 @@ class Dataset:
                     tok = fld.decode_value(int(col[i]))
                 elif fld.is_numeric:
                     v = float(col[i])
-                    tok = str(int(v)) if v == int(v) else f"{v:.6g}"
+                    # NaN is the documented missing-value sentinel from both
+                    # parsers; render it (and inf) back as an empty token
+                    tok = ("" if not np.isfinite(v)
+                           else str(int(v)) if v == int(v) else f"{v:.6g}")
                 else:
                     tok = str(col[i])
                 toks[fld.ordinal] = tok

@@ -421,15 +421,14 @@ class ContTimeStateTransitionStats:
         self.count = self.max_rate * self.horizon
         self.limit = int(4 + 6 * math.sqrt(self.count) + self.count)
 
-        p_d = jnp.asarray(p, jnp.float32)
-
-        def step(carry, _):
-            nxt = carry @ p_d
-            return nxt, carry
-
-        _, powers = jax.lax.scan(step, jnp.eye(n, dtype=jnp.float32),
-                                 None, length=self.limit + 1)
-        self.powers = np.asarray(powers, np.float64)     # [limit+1, S, S]
+        # power table on host in float64: limit grows ~linearly with
+        # maxRate*horizon, and f32 matmul error compounds over long power
+        # chains; S is small, so host numpy is cheap and exact enough
+        self.powers = np.empty((self.limit + 1, n, n), np.float64)
+        acc = np.eye(n)
+        for i in range(self.limit + 1):
+            self.powers[i] = acc
+            acc = acc @ p
         # Poisson(count) pmf over 0..limit, built in log space for stability
         i = np.arange(self.limit + 1, dtype=np.float64)
         logpmf = -self.count + i * math.log(max(self.count, _EPS)) - (

@@ -37,6 +37,7 @@ from __future__ import annotations
 
 import math
 import os
+import warnings
 from typing import Callable, Dict, List, Optional, Sequence
 
 import numpy as np
@@ -196,6 +197,11 @@ class ReinforcementLearner:
                 try:
                     json.dumps(enc)
                 except (TypeError, ValueError):
+                    # an incomplete checkpoint must be visible, not silent:
+                    # resume would otherwise quietly lose this state
+                    warnings.warn(
+                        f"checkpoint skipping non-serializable state {k!r} "
+                        f"of {type(self).__name__}")
                     continue
                 extra[k] = enc
         state = {

@@ -457,7 +457,12 @@ def ctmc_stats_job(cfg: JobConfig, inputs: List[str], output: str) -> JobResult:
     """CTMC statistics by uniformization (ContTimeStateTransitionStats.scala:34).
     `cts.state.trans.file.path` holds the rate matrix rows; input rows are
     `id,initState[,endState]`; `cts.state.trans.stat` picks stateDwellTime
-    (target = cts.target.states[0]) or StateTransitionCount (targets[0:2])."""
+    (target = cts.target.states[0]) or StateTransitionCount (targets[0:2]).
+
+    Output-compat deviation vs the Scala job (documented on the model class
+    too): the transition-count inner loop bound and the conditional
+    normalization differ, so stats for identical inputs are close but not
+    byte-identical to the reference's."""
     from avenir_tpu.models.markov import ContTimeStateTransitionStats
 
     states = cfg.assert_list("state.values")
@@ -1178,10 +1183,12 @@ def run_from_cli(argv: Sequence[str]) -> JobResult:
                     help="properties file (the -Dconf.path analog)")
     ap.add_argument("paths", nargs="*", help="input paths... output path")
     args = ap.parse_args(argv)
+    if not args.paths:
+        ap.error("expected IN... OUT paths (at least an output path)")
     props = load_properties(args.conf) if args.conf else {}
     short = args.jobname.rsplit(".", 1)[-1]
     name = args.jobname if args.jobname in _REGISTRY else short[0].lower() + short[1:]
-    inputs, output = args.paths[:-1], (args.paths[-1] if args.paths else "")
+    inputs, output = args.paths[:-1], args.paths[-1]
     res = run_job(name, props, inputs, output)
     print(json.dumps({"job": res.name, "counters": res.counters,
                       "outputs": res.outputs}))
