@@ -1,0 +1,35 @@
+"""Driver-entry contract tests: hermetic multi-chip dryrun.
+
+The dryrun is the multi-chip correctness proof the driver records
+(SURVEY §2.12); it must pass even when the TPU runtime is broken, because
+it runs in a subprocess with the CPU platform pinned before backend init.
+"""
+
+import os
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import __graft_entry__ as graft  # noqa: E402
+
+
+def test_dryrun_hermetic_even_with_broken_tpu(monkeypatch, capsys):
+    # Simulate a broken accelerator runtime in the parent environment: if
+    # the dryrun subprocess touched the TPU platform at all, these would
+    # make backend init raise. The wrapper must override them.
+    monkeypatch.setenv("JAX_PLATFORMS", "tpu")
+    monkeypatch.setenv("TPU_LIBRARY_PATH", "/nonexistent/libtpu.so")
+    graft.dryrun_multichip(4)
+    out = capsys.readouterr().out
+    assert "dryrun_multichip OK" in out
+    assert "mesh=" in out
+
+
+def test_entry_returns_jittable():
+    import jax
+
+    fn, args = graft.entry()
+    pred, log_post = jax.jit(fn)(*args)
+    assert pred.shape[0] == log_post.shape[0] == args[0].shape[0]
