@@ -104,12 +104,14 @@ class NeighborIndex:
         block: int = 4096,
         approx: bool = False,
         use_pallas: Optional[bool] = None,
-        packed: bool = True,
+        packed: bool = False,
     ):
-        """packed=True (default) routes the pallas path through the
-        packed-key insertion-network kernel — several times faster, with
-        distances quantized to ~2^-12 relative (below the pallas euclidean
-        path's own dot-form error); packed=False forces the exact kernel."""
+        """packed=True opts into the lane-resident packed-key kernel
+        (ops.pallas_knn.knn_topk_lanes) — several times faster, but
+        distances are quantized to ~2^-13 relative, which can reorder
+        near-tied neighbors. The default (packed=False) keeps the exact
+        kernel so TPU results match the jnp/reference path bit-for-bit
+        modulo f32 dot-form error."""
         self.schema = train.schema
         # the reference takes "the first topMatchCount values" — a train set
         # smaller than k just yields all of it
@@ -137,8 +139,8 @@ class NeighborIndex:
                 raise ValueError(f"pallas KNN kernel: unsupported metric {metric!r}")
             if approx:
                 raise ValueError(
-                    "pallas KNN kernel is exact; approx=True needs the "
-                    "jnp path (approx_min_k)")
+                    "the pallas KNN kernels compute full (non-approximate) "
+                    "top-k; approx=True needs the jnp path (approx_min_k)")
         self.use_pallas = (
             use_pallas if use_pallas is not None
             else (pallas_available() and x_cat is None and x_num.shape[1] > 0
@@ -147,11 +149,11 @@ class NeighborIndex:
         self.packed = packed and self.use_pallas
         if self.use_pallas:
             # pre-normalize by ranges once; pad to the kernel block.
-            # packed kernel: block_t <= 4096 (12 index bits); exact kernel:
-            # 256x8192 f32 tile = 8 MB VMEM, the measured sweet spot
+            # 256x8192 f32 tile = 8 MB VMEM, the measured sweet spot; the
+            # lane-packed kernel carries global chunk ids so block_t has no
+            # index-bit cap (corpus cap 524288 rows enforced by the kernel)
             x_num = x_num / np.maximum(ranges, 1e-9)
-            max_block = 4096 if self.packed else 8192
-            self.block = max(128, min(pad_rows(len(train), 128), max_block))
+            self.block = max(128, min(pad_rows(len(train), 128), 8192))
             t_num, x_cat, n_valid = pad_train(x_num, None, self.block)
         else:
             t_num, x_cat, n_valid = pad_train(x_num, x_cat, self.block)
@@ -168,7 +170,7 @@ class NeighborIndex:
         """(dist [nq,k], train index [nq,k]); unfillable slots are (+inf, -1)."""
         q_num, _, q_cat, _ = _extract(test)
         if self.use_pallas:
-            from avenir_tpu.ops.pallas_knn import knn_topk_pallas
+            from avenir_tpu.ops.pallas_knn import knn_topk_lanes, knn_topk_pallas
 
             q = q_num / np.maximum(np.asarray(self.ranges), 1e-9)
             bq = 256
@@ -176,10 +178,16 @@ class NeighborIndex:
             pad = (-nq) % bq
             if pad:
                 q = np.concatenate([q, np.zeros((pad, q.shape[1]), q.dtype)])
-            dist, idx = knn_topk_pallas(
-                jnp.asarray(q), self.t_num, k=self.k, block_q=bq,
-                block_t=self.block, metric=self.metric,
-                n_valid=self.n_valid, packed=self.packed)
+            if self.packed:
+                dist, idx = knn_topk_lanes(
+                    jnp.asarray(q), self.t_num, k=self.k, block_q=bq,
+                    block_t=self.block, metric=self.metric,
+                    n_valid=self.n_valid)
+            else:
+                dist, idx = knn_topk_pallas(
+                    jnp.asarray(q), self.t_num, k=self.k, block_q=bq,
+                    block_t=self.block, metric=self.metric,
+                    n_valid=self.n_valid)
             return dist[:nq], idx[:nq]
         return blocked_topk_neighbors(
             jnp.asarray(q_num) if self.t_num is not None else None,

@@ -8,7 +8,7 @@ import pytest
 import jax.numpy as jnp
 
 from avenir_tpu.ops.distance import blocked_topk_neighbors, pad_train
-from avenir_tpu.ops.pallas_knn import knn_topk_pallas
+from avenir_tpu.ops.pallas_knn import knn_topk_lanes, knn_topk_pallas
 
 
 @pytest.mark.parametrize("metric", ["euclidean", "manhattan"])
@@ -114,6 +114,71 @@ def test_packed_kernel_matches_oracle(case):
         assert (got_i[:, kk:] == -1).all()
     # ascending within the filled slots (diff of two infs is NaN)
     assert (np.diff(got_d[:, :kk], axis=1) >= -1e-7).all()
+
+
+@pytest.mark.parametrize("case", ["basic", "pad", "tiny", "multiblock"])
+def test_lane_kernel_matches_oracle(case):
+    """Lane-resident packed kernel (global chunk ids, deferred extraction):
+    quantized to 2^-(23-pack_bits) relative but must find the same neighbor
+    sets as the exact oracle, across train-block boundaries."""
+    rng = np.random.default_rng(4)
+    nq, d, k = 128, 8, 5
+    q = rng.normal(size=(nq, d)).astype(np.float32)
+    if case == "tiny":
+        t = rng.normal(size=(3, d)).astype(np.float32)
+    elif case == "multiblock":
+        t = rng.normal(size=(1024, d)).astype(np.float32)
+    else:
+        t = rng.normal(size=(300 if case == "pad" else 512, d)).astype(
+            np.float32)
+    t_pad, _, n_valid = pad_train(t, None, 256)
+
+    got_d, got_i = knn_topk_lanes(
+        jnp.asarray(q), jnp.asarray(t_pad), k=k, block_q=128, block_t=256,
+        n_valid=n_valid, interpret=True)
+    got_d, got_i = np.asarray(got_d), np.asarray(got_i)
+
+    full = np.sqrt(((q[:, None, :] - t[None, :, :]) ** 2).mean(-1))
+    order = np.argsort(full, axis=1)[:, :k]
+    kk = min(k, t.shape[0])
+    ref_d = np.take_along_axis(full, order, axis=1)
+
+    np.testing.assert_allclose(got_d[:, :kk], ref_d[:, :kk],
+                               rtol=3e-4, atol=1e-5)
+    recall = np.mean([
+        len(set(got_i[r, :kk]) & set(order[r, :kk])) / kk for r in range(nq)
+    ])
+    assert recall >= 0.99
+    if kk < k:
+        assert np.isinf(got_d[:, kk:]).all()
+        assert (got_i[:, kk:] == -1).all()
+    assert (np.diff(got_d[:, :kk], axis=1) >= -1e-7).all()
+
+
+def test_lane_kernel_same_lane_collisions():
+    """Up to k nearest neighbors planted in ONE lane (columns congruent
+    mod 128) must all survive the per-lane k-deep carry."""
+    rng = np.random.default_rng(5)
+    nq, d, k = 128, 4, 5
+    q = np.zeros((nq, d), np.float32)
+    t = rng.normal(size=(1024, d)).astype(np.float32) * 10
+    # plant the 5 nearest rows all in lane 3: columns 3, 131, 259, 515, 899
+    cols = [3, 131, 259, 515, 899]
+    for rank, c in enumerate(cols):
+        t[c] = 0.01 * (rank + 1)
+    got_d, got_i = knn_topk_lanes(
+        jnp.asarray(q), jnp.asarray(t), k=k, block_q=128, block_t=256,
+        interpret=True)
+    assert set(np.asarray(got_i)[0].tolist()) == set(cols)
+    assert (np.diff(np.asarray(got_d), axis=1) >= -1e-7).all()
+
+
+def test_lane_kernel_rejects_oversize_corpus():
+    q = np.zeros((128, 2), np.float32)
+    t = np.zeros((128 * 4096 + 256, 2), np.float32)
+    with pytest.raises(AssertionError, match="chunk-id bits"):
+        knn_topk_lanes(jnp.asarray(q), jnp.asarray(t), k=2, block_q=128,
+                       block_t=256, interpret=True)
 
 
 def test_packed_kernel_rejects_oversize_block():

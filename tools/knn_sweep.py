@@ -1,0 +1,75 @@
+"""On-chip block/dtype sweep for the pallas KNN kernels.
+
+Usage: python tools/knn_sweep.py [d]
+Prints qps + TF/s per config using the memoization-safe timing methodology
+from bench.py (lax.map over rolled inputs, scalar-forced).
+"""
+
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, ".")
+
+KNN_QUERIES = 8_192
+KNN_TRAIN = 131_072
+STEPS = 8
+K = 5
+
+
+def timed(many_fn, *args, repeats=3):
+    import jax.numpy as jnp
+
+    _ = float(many_fn(*args))
+    best = np.inf
+    for s in range(1, repeats + 1):
+        shifted = (jnp.roll(args[0], s, axis=-1),) + args[1:]
+        t0 = time.perf_counter()
+        _ = float(many_fn(*shifted))
+        best = min(best, time.perf_counter() - t0)
+    return best
+
+
+def run(dim):
+    import jax
+    import jax.numpy as jnp
+    from avenir_tpu.ops.pallas_knn import knn_topk_lanes, knn_topk_pallas
+
+    rng = np.random.default_rng(2)
+    q = jnp.asarray(rng.normal(size=(KNN_QUERIES, dim)).astype(np.float32))
+    t = jnp.asarray(rng.normal(size=(KNN_TRAIN, dim)).astype(np.float32))
+
+    configs = [
+        ("old_packed", knn_topk_pallas, 512, 4096, "float32", {"packed": True}),
+        ("old_packed", knn_topk_pallas, 512, 4096, "bfloat16", {"packed": True}),
+        ("lanes", knn_topk_lanes, 512, 4096, "float32", {}),
+        ("lanes", knn_topk_lanes, 512, 4096, "bfloat16", {}),
+        ("lanes", knn_topk_lanes, 256, 4096, "bfloat16", {}),
+        ("lanes", knn_topk_lanes, 256, 8192, "bfloat16", {}),
+        ("lanes", knn_topk_lanes, 512, 2048, "bfloat16", {}),
+        ("lanes", knn_topk_lanes, 1024, 4096, "bfloat16", {}),
+    ]
+    for name, fn, bq, bt, cdt, extra in configs:
+        @jax.jit
+        def many(q, t):
+            def step(i):
+                qi = jnp.roll(q, i, axis=0)
+                dist, idx = fn(qi, t, k=K, block_q=bq, block_t=bt,
+                               metric="euclidean", compute_dtype=cdt, **extra)
+                return jnp.sum(dist) + jnp.sum(idx).astype(jnp.float32)
+            return jax.lax.map(step, jnp.arange(1, STEPS + 1)).sum()
+
+        try:
+            dt = timed(many, q, t)
+        except Exception as exc:
+            print(f"{name} bq={bq} bt={bt} {cdt}: FAILED {type(exc).__name__}: "
+                  f"{str(exc)[:200]}")
+            continue
+        qps = KNN_QUERIES * STEPS / dt
+        tfs = 2.0 * KNN_QUERIES * KNN_TRAIN * dim * STEPS / dt / 1e12
+        print(f"{name} bq={bq} bt={bt} {cdt}: {qps:.3e} q/s  {tfs:.1f} TF/s")
+
+
+if __name__ == "__main__":
+    run(int(sys.argv[1]) if len(sys.argv) > 1 else 128)

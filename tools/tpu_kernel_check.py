@@ -1,0 +1,133 @@
+"""Compiled-path sweep of every pallas KNN kernel on the real TPU.
+
+Interpret-mode tests (tests/test_pallas_knn.py) prove the algorithms; this
+script proves the Mosaic-compiled artifacts: bitcast/int-key ops, pack-bit
+quantization, n_valid masking, sentinel laundering, same-lane collisions,
+and both compute dtypes, each checked against a NumPy oracle ON DEVICE.
+
+Usage: python tools/tpu_kernel_check.py   (needs jax.default_backend()=tpu)
+Exit code 0 iff every case passes; prints one summary JSON line.
+"""
+
+import json
+import sys
+
+import numpy as np
+
+sys.path.insert(0, ".")
+
+
+def oracle(q, t, k, metric):
+    if metric == "euclidean":
+        full = np.sqrt(((q[:, None, :] - t[None, :, :]) ** 2).mean(-1))
+    else:
+        full = np.abs(q[:, None, :] - t[None, :, :]).sum(-1) / q.shape[1]
+    order = np.argsort(full, axis=1, kind="stable")[:, :k]
+    return np.take_along_axis(full, order, axis=1), order
+
+
+def check(name, got_d, got_i, q, t, k, metric, rtol):
+    got_d, got_i = np.asarray(got_d), np.asarray(got_i)
+    ref_d, ref_i = oracle(q, t, k, metric)
+    kk = min(k, t.shape[0])
+    ok = True
+    msg = []
+    if not np.allclose(got_d[:, :kk], ref_d[:, :kk], rtol=rtol, atol=1e-5):
+        ok = False
+        msg.append(f"dist err {np.abs(got_d[:, :kk]-ref_d[:, :kk]).max():.2e}")
+    # tie-tolerant recall: a returned neighbor counts if its TRUE distance
+    # is within the mode's quantization tolerance of the kth-best — the
+    # packed/bf16 modes may legally swap near-ties
+    if metric == "euclidean":
+        full = np.sqrt(((q[:, None, :] - t[None, :, :]) ** 2).mean(-1))
+    else:
+        full = np.abs(q[:, None, :] - t[None, :, :]).sum(-1) / q.shape[1]
+    hits = 0
+    for r in range(q.shape[0]):
+        bar = ref_d[r, kk - 1] * (1.0 + 2 * rtol) + 1e-6
+        hits += sum(full[r, i] <= bar for i in got_i[r, :kk] if i >= 0) / kk
+    recall = hits / q.shape[0]
+    if recall < 0.999:
+        ok = False
+        msg.append(f"tie-tolerant recall {recall:.3f}")
+    if kk < k and not (np.isinf(got_d[:, kk:]).all()
+                       and (got_i[:, kk:] == -1).all()):
+        ok = False
+        msg.append("bad sentinel slots")
+    if (got_i[:, :kk] >= t.shape[0]).any() or (got_i[:, :kk] < 0).any():
+        ok = False
+        msg.append("index out of range")
+    print(f"{'PASS' if ok else 'FAIL'} {name}" + (": " + "; ".join(msg) if msg else ""))
+    return ok
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+    from avenir_tpu.ops.distance import pad_train
+    from avenir_tpu.ops.pallas_knn import knn_topk_lanes, knn_topk_pallas
+
+    if jax.default_backend() != "tpu":
+        print(json.dumps({"metric": "tpu_kernel_check", "skipped": True,
+                          "reason": "no TPU backend"}))
+        return 0
+
+    rng = np.random.default_rng(7)
+    results = []
+
+    cases = [
+        # (label, nq, nt_real, d, k, block_q, block_t, metric)
+        ("basic", 256, 4096, 16, 5, 256, 512, "euclidean"),
+        ("pad", 256, 3000, 16, 5, 256, 512, "euclidean"),
+        ("multiblock", 256, 16384, 32, 5, 256, 2048, "euclidean"),
+        ("tiny_train", 128, 3, 8, 5, 128, 256, "euclidean"),
+        ("k1", 128, 2048, 8, 1, 128, 512, "euclidean"),
+        ("manhattan", 128, 1024, 8, 4, 128, 512, "manhattan"),
+    ]
+    for label, nq, nt, d, k, bq, bt, metric in cases:
+        q = rng.normal(size=(nq, d)).astype(np.float32)
+        t = rng.normal(size=(nt, d)).astype(np.float32)
+        t_pad, _, n_valid = pad_train(t, None, bt)
+        qd, td = jnp.asarray(q), jnp.asarray(t_pad)
+
+        de, ie = knn_topk_pallas(qd, td, k=k, block_q=bq, block_t=bt,
+                                 metric=metric, n_valid=n_valid)
+        results.append(check(f"exact/{label}", de, ie, q, t, k, metric, 1e-3))
+        if bt <= 4096:
+            dp, ip = knn_topk_pallas(qd, td, k=k, block_q=bq, block_t=bt,
+                                     metric=metric, n_valid=n_valid,
+                                     packed=True)
+            results.append(
+                check(f"packed/{label}", dp, ip, q, t, k, metric, 3e-3))
+        dl, il = knn_topk_lanes(qd, td, k=k, block_q=bq, block_t=bt,
+                                metric=metric, n_valid=n_valid)
+        results.append(check(f"lanes/{label}", dl, il, q, t, k, metric, 3e-3))
+        if metric == "euclidean":
+            db, ib = knn_topk_lanes(qd, td, k=k, block_q=bq, block_t=bt,
+                                    metric=metric, n_valid=n_valid,
+                                    compute_dtype="bfloat16")
+            # bf16 cross term: ~2^-8 relative on distances
+            results.append(
+                check(f"lanes-bf16/{label}", db, ib, q, t, k, metric, 2e-2))
+
+    # same-lane collision stress for the lane kernel, compiled
+    q = np.zeros((128, 4), np.float32)
+    t = rng.normal(size=(2048, 4)).astype(np.float32) * 10
+    cols = [3, 131, 259, 515, 899]
+    for rank, c in enumerate(cols):
+        t[c] = 0.01 * (rank + 1)
+    import jax.numpy as jnp2
+    dl, il = knn_topk_lanes(jnp2.asarray(q), jnp2.asarray(t), k=5,
+                            block_q=128, block_t=256)
+    ok = set(np.asarray(il)[0].tolist()) == set(cols)
+    print(f"{'PASS' if ok else 'FAIL'} lanes/same-lane-collision")
+    results.append(ok)
+
+    n_pass = sum(results)
+    print(json.dumps({"metric": "tpu_kernel_check", "passed": n_pass,
+                      "total": len(results)}))
+    return 0 if n_pass == len(results) else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
