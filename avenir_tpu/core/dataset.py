@@ -49,24 +49,38 @@ class Dataset:
     @classmethod
     def from_csv(
         cls,
-        source: Union[str, Iterable[str]],
+        source: Union[str, bytes, Iterable[str]],
         schema: FeatureSchema,
         delim: str = ",",
         keep_raw: bool = False,
         engine: str = "auto",
     ) -> "Dataset":
-        """Parse CSV lines (a path, a text blob, or an iterable of lines)
-        into columns. Unknown categorical values raise — the schema declares
-        the full cardinality, same contract as the reference. A string is
-        treated as a file path if such a file exists, otherwise as content
-        (content must contain a newline or the delimiter).
+        """Parse CSV lines (a path, a text blob, raw bytes, or an iterable
+        of lines) into columns. Unknown categorical values raise — the
+        schema declares the full cardinality, same contract as the
+        reference. A string is treated as a file path if such a file
+        exists, otherwise as content (content must contain a newline or the
+        delimiter). Bytes are always content — the block-streaming reader
+        (core/stream.py) hands file blocks here without a decode copy.
 
         engine: 'auto' uses the native C++ parser (avenir_tpu/native) when
-        built and applicable (path/blob source, single-char delimiter, no
-        keep_raw), 'native' requires it, 'python' forces the row parser."""
+        built and applicable (path/blob/bytes source, single-char delimiter,
+        no keep_raw), 'native' requires it, 'python' forces the row parser."""
         if engine not in ("auto", "native", "python"):
             raise ValueError(f"unknown CSV engine {engine!r} "
                              "(want auto, native, or python)")
+        if isinstance(source, (bytes, bytearray)):
+            native_ok = not keep_raw and len(delim.encode()) == 1
+            if engine in ("auto", "native") and native_ok:
+                ds = cls._from_native_data(bytes(source), schema, delim,
+                                           required=engine == "native")
+                if ds is not None:
+                    return ds
+            if engine == "native":
+                raise ValueError(
+                    "engine='native' requires a single-byte delimiter and "
+                    "keep_raw=False")
+            source = io.StringIO(bytes(source).decode())
         native_ok = (not keep_raw and isinstance(source, str)
                      and len(delim.encode()) == 1)
         if engine == "native" and not native_ok:
@@ -103,14 +117,8 @@ class Dataset:
     @classmethod
     def _from_csv_native(cls, source: str, schema: FeatureSchema,
                          delim: str, required: bool) -> Optional["Dataset"]:
-        """Native one-pass columnar parse; None when unavailable/inapplicable
-        (caller falls through to the Python parser)."""
-        from avenir_tpu.native.ingest import native_available, parse_csv_native
-
-        if not native_available():
-            if required:
-                raise RuntimeError("native CSV ingest unavailable")
-            return None
+        """Native one-pass columnar parse of a path/blob source; None when
+        unavailable (caller falls through to the Python parser)."""
         if os.path.exists(source):
             with open(source, "rb") as fh:
                 data = fh.read()
@@ -118,6 +126,17 @@ class Dataset:
             data = source.encode()
         else:
             raise FileNotFoundError(f"no such CSV file: {source!r}")
+        return cls._from_native_data(data, schema, delim, required)
+
+    @classmethod
+    def _from_native_data(cls, data: bytes, schema: FeatureSchema,
+                          delim: str, required: bool) -> Optional["Dataset"]:
+        from avenir_tpu.native.ingest import native_available, parse_csv_native
+
+        if not native_available():
+            if required:
+                raise RuntimeError("native CSV ingest unavailable")
+            return None
         numeric = [f.ordinal for f in schema.fields if f.is_numeric]
         # categoricals with a fixed declared vocabulary encode in C; those
         # with an undeclared (data-discovered, growable) vocabulary come

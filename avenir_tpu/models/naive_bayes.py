@@ -59,6 +59,21 @@ class NaiveBayesModel:
     # set when a model was loaded from CSV (mean/std known, raw moments not):
     cont_params: Optional[np.ndarray] = None        # [Fc, K, 2] (mean, std)
     cont_prior_params: Optional[np.ndarray] = None  # [Fc, 2]
+    # deferred device-side accumulator (streaming ingest): a pytree of
+    # per-chunk count tensors folded on device. Unweighted counts with no
+    # continuous features fold as int32 (exact to 2^31 rows per cell — no
+    # mid-stream flush at any realistic scale); weighted or moment-bearing
+    # folds stay f32 and flush to the float64 host arrays before any cell
+    # could exceed f32 integer exactness (2^24)
+    _pending: Optional[tuple] = None
+    _pending_rows: int = 0
+    _pending_int: bool = False
+
+    # rows a single pending f32 cell can safely absorb (2^24 ~ 16.7M,
+    # with margin); crossing it flushes to host float64. int32 folds get
+    # a 2^30 bound.
+    _FLUSH_ROWS = 14 << 20
+    _FLUSH_ROWS_INT = 1 << 30
 
     # ------------------------------------------------------------ training
     @classmethod
@@ -79,19 +94,54 @@ class NaiveBayesModel:
             class_counts=np.zeros((k,), np.float64),
         )
 
-    def accumulate(self, codes, labels, x_cont, weights=None) -> None:
-        """Add one batch of sufficient statistics (host-side accumulate of a
-        device-computed count pytree)."""
+    def accumulate(self, codes, labels, x_cont, weights=None,
+                   defer: bool = False) -> None:
+        """Add one batch of sufficient statistics.
+
+        defer=False (default) fetches the device-computed count pytree to
+        the host immediately. defer=True — the streaming-ingest path —
+        folds it into a device-side accumulator instead, so a chunk loop
+        dispatches asynchronously with no host round trip per chunk; the
+        fold flushes to the float64 host arrays automatically before any
+        cell could lose f32 integer exactness, and flush() (called by
+        finish/to_csv/merge) drains the remainder."""
         k = len(self.class_values)
         bmax = self.post_counts.shape[2]
-        post, mom, cls = _count_batch(
-            jnp.asarray(codes), jnp.asarray(labels), jnp.asarray(x_cont),
-            k, bmax,
-            jnp.asarray(weights) if weights is not None else None,
-        )
-        self.post_counts += np.asarray(post)
-        self.cont_moments += np.asarray(mom)
-        self.class_counts += np.asarray(cls)
+        n = labels.shape[0]
+        int_mode = weights is None and self.cont_moments.shape[0] == 0
+        if self._pending is not None and self._pending_int != int_mode:
+            self.flush()
+        w = (jnp.asarray(weights) if weights is not None
+             else jnp.ones((n,), jnp.float32))
+        if self._pending is None:
+            f, fc = self.post_counts.shape[0], self.cont_moments.shape[0]
+            dt = jnp.int32 if int_mode else jnp.float32
+            self._pending = (jnp.zeros((f, k, bmax), dt),
+                             jnp.zeros((fc, k, 3), jnp.float32),
+                             jnp.zeros((k,), dt))
+            self._pending_int = int_mode
+        # count + fold is ONE jitted dispatch with a donated accumulator —
+        # a chunk loop never round-trips the host (per-dispatch latency,
+        # not device FLOPs, is what kills a chunked loop otherwise)
+        self._pending = _fold_batch_kernel(
+            self._pending, jnp.asarray(codes), jnp.asarray(labels),
+            jnp.asarray(x_cont), w, k, bmax)
+        # shape only — np.asarray here would fetch the whole device chunk
+        self._pending_rows += int(n)
+        bound = self._FLUSH_ROWS_INT if int_mode else self._FLUSH_ROWS
+        if not defer or self._pending_rows >= bound:
+            self.flush()
+
+    def flush(self) -> None:
+        """Drain the deferred device accumulator into the host arrays."""
+        if self._pending is None:
+            return
+        post, mom, cls = self._pending
+        self._pending = None
+        self._pending_rows = 0
+        self.post_counts += np.asarray(post, np.float64)
+        self.cont_moments += np.asarray(mom, np.float64)
+        self.class_counts += np.asarray(cls, np.float64)
 
     @classmethod
     def fit(cls, dataset: Dataset) -> "NaiveBayesModel":
@@ -108,6 +158,8 @@ class NaiveBayesModel:
         if self.cont_params is not None or other.cont_params is not None:
             raise ValueError("cannot merge models loaded from CSV "
                              "(raw moments unavailable)")
+        self.flush()
+        other.flush()
         self.post_counts = self.post_counts + other.post_counts
         self.cont_moments = self.cont_moments + other.cont_moments
         self.class_counts = self.class_counts + other.class_counts
@@ -121,6 +173,7 @@ class NaiveBayesModel:
         posterior P(bin|class) normalized within class, feature prior P(bin),
         class prior P(class); continuous features get per-class and prior
         Gaussian (mean, std)."""
+        self.flush()
         f, k, bmax = self.post_counts.shape
         post = self.post_counts
         post_p = post / np.maximum(post.sum(axis=2, keepdims=True), _TINY)
@@ -167,6 +220,7 @@ class NaiveBayesModel:
           ,ord,bin,count                  feature prior (binned, per class)
           ,ord,,mean,stddev               feature prior (continuous)
         """
+        self.flush()
         out: List[str] = []
         d = delim
         for fi, fld in enumerate(self.binned_fields):
@@ -264,6 +318,14 @@ def _count_batch(codes, labels, x_cont, k: int, bmax: int, weights=None):
     n = labels.shape[0]
     w = weights if weights is not None else jnp.ones((n,), jnp.float32)
     return _count_batch_kernel(codes, labels, x_cont, w, k, bmax)
+
+
+@partial(jax.jit, static_argnames=("k", "bmax"), donate_argnums=(0,))
+def _fold_batch_kernel(acc, codes, labels, x_cont, w, k: int, bmax: int):
+    batch = _count_batch_kernel(codes, labels, x_cont, w, k, bmax)
+    # per-batch einsum counts are <= batch rows, exact in f32; the fold
+    # target's dtype (int32 on the unweighted path) sets the ceiling
+    return jax.tree.map(lambda a, b: a + b.astype(a.dtype), acc, batch)
 
 
 class NaiveBayesPredictor:

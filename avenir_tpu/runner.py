@@ -177,15 +177,37 @@ def bayesian_distribution(cfg: JobConfig, inputs: List[str], output: str) -> Job
                          {"Distribution Data:Records": len(texts)},
                          [out], tmodel)
 
+    from avenir_tpu.core.stream import iter_csv_chunks, prefetched
     from avenir_tpu.models.naive_bayes import NaiveBayesModel
 
+    schema = _schema(cfg)
     model = None
+    # block streaming keeps host RSS O(block) however large the input —
+    # the mapper's one-line-at-a-time contract at block granularity
+    # (BayesianDistribution.java:137); counts are additive so chunking
+    # cannot change the model
+    block = int(cfg.get_float("stream.block.size.mb", 64.0) * (1 << 20))
     rows = 0
     for path in inputs:
-        ds = _dataset(path, cfg)
-        rows += len(ds)
-        part = NaiveBayesModel.fit(ds)
-        model = part if model is None else model.merge(part)
+        for ds in prefetched(iter_csv_chunks(
+                path, schema, cfg.field_delim_regex, block)):
+            if model is None:
+                # after the first parse, so data-discovered categorical
+                # vocabularies are sized into the count tensors
+                model = NaiveBayesModel.empty(schema)
+            codes, bins = ds.feature_codes(model.binned_fields)
+            if bins != model.bins:
+                raise ValueError(
+                    "categorical vocabulary grew mid-stream (a chunk saw a "
+                    "value absent from the first chunk / declared "
+                    "cardinality); declare full cardinalities in the schema "
+                    "to stream")
+            x_cont = ds.feature_matrix(model.cont_fields)
+            model.accumulate(codes, ds.labels(), x_cont, defer=True)
+            rows += len(ds)
+    if model is None:
+        model = NaiveBayesModel.empty(schema)
+    model.flush()
     model.save(out, delim=cfg.field_delim)
     return JobResult("bayesianDistr", {"Distribution Data:Records": rows},
                      [out], model)
@@ -249,11 +271,12 @@ def nearest_neighbor(cfg: JobConfig, inputs: List[str], output: str) -> JobResul
     `class.condtion.weighted` spelling, NearestNeighbor.java:92)."""
     from avenir_tpu.models.knn import NearestNeighborClassifier
 
+    from avenir_tpu.core.stream import iter_csv_chunks, prefetched
+
     train_path, test_path = inputs[0], inputs[-1]
     schema = _schema(cfg)
     delim = cfg.field_delim_regex
     train = Dataset.from_csv(train_path, schema, delim=delim)
-    test = Dataset.from_csv(test_path, schema, delim=delim, keep_raw=True)
     clf = NearestNeighborClassifier(
         train,
         top_match_count=cfg.get_int("top.match.count", 5),
@@ -265,23 +288,33 @@ def nearest_neighbor(cfg: JobConfig, inputs: List[str], output: str) -> JobResul
         decision_threshold=cfg.get_float("decision.threshold", -1.0),
         positive_class=cfg.get("positive.class.value"),
     )
-    codes, scores = clf.predict(test)
     out = _out_file(output)
     out_delim = cfg.field_delim
     cls_vals = schema.class_values()
     with_distr = cfg.get_bool("output.class.distr", False)
+    validate = cfg.get_bool("validation.mode", False)
+    # queries stream in blocks against the resident train index — test-set
+    # size never bounds host RSS (the model is the index, not the queries)
+    block = int(cfg.get_float("stream.block.size.mb", 64.0) * (1 << 20))
+    actual: List[np.ndarray] = []
+    predicted: List[np.ndarray] = []
     with open(out, "w") as fh:
-        for i, (rid, c) in enumerate(zip(test.ids(), codes)):
-            fields = [str(rid), cls_vals[int(c)]]
-            if with_distr:
-                tot = float(np.sum(scores[i])) or 1.0
-                fields += [f"{cls_vals[j]}:{scores[i][j] / tot:.3f}"
-                           for j in range(len(cls_vals))]
-            fh.write(out_delim.join(fields) + "\n")
+        for test in prefetched(iter_csv_chunks(test_path, schema, delim, block)):
+            codes, scores = clf.predict(test)
+            for i, (rid, c) in enumerate(zip(test.ids(), codes)):
+                fields = [str(rid), cls_vals[int(c)]]
+                if with_distr:
+                    tot = float(np.sum(scores[i])) or 1.0
+                    fields += [f"{cls_vals[j]}:{scores[i][j] / tot:.3f}"
+                               for j in range(len(cls_vals))]
+                fh.write(out_delim.join(fields) + "\n")
+            if validate:
+                actual.append(test.labels())
+                predicted.append(codes)
     counters: Dict[str, float] = {}
-    if cfg.get_bool("validation.mode", False):
-        counters = _validate(cls_vals, test.labels(), codes,
-                             clf.positive_class)
+    if actual:
+        counters = _validate(cls_vals, np.concatenate(actual),
+                             np.concatenate(predicted), clf.positive_class)
     return JobResult("nearestNeighbor", counters, [out])
 
 
