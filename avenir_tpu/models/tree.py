@@ -47,10 +47,25 @@ import jax.numpy as jnp
 
 from avenir_tpu.core.dataset import Dataset
 from avenir_tpu.core.schema import FeatureField, FeatureSchema
-from avenir_tpu.ops.infotheory import bits_entropy, gini
 from avenir_tpu.utils.metrics import ConfusionMatrix
 
 ROOT_PATH = "$root"
+
+
+def _np_bits_entropy(counts: np.ndarray, axis: int = -1) -> np.ndarray:
+    """Host twin of ops.infotheory.bits_entropy for the builder's tiny
+    per-level stat tensors — an eager device dispatch per level/leaf costs
+    more than the arithmetic (remote-chip dispatch latency)."""
+    tot = counts.sum(axis=axis, keepdims=True)
+    p = counts / np.maximum(tot, 1e-12)
+    h = -np.sum(np.where(p > 0, p * np.log(np.maximum(p, 1e-12)), 0.0), axis=axis)
+    return h / np.log(2.0)
+
+
+def _np_gini(counts: np.ndarray, axis: int = -1) -> np.ndarray:
+    tot = counts.sum(axis=axis, keepdims=True)
+    p = counts / np.maximum(tot, 1e-12)
+    return 1.0 - np.sum(p * p, axis=axis)
 
 # ---------------------------------------------------------------------------
 # candidate split enumeration (host; SplitManager semantics)
@@ -356,6 +371,151 @@ class DecisionPathList:
 
 
 # ---------------------------------------------------------------------------
+# device path evaluation (tensorized predict)
+# ---------------------------------------------------------------------------
+
+_OP_CODE = {"ge": 0, "lt": 1, "gt": 2, "le": 3}
+
+
+@partial(jax.jit, static_argnames=())
+def _path_match_kernel(x_num, x_cat, kind, col, op, val, other, member):
+    """matches[n, T, P]: does row n satisfy every predicate of path P of
+    tree T. One batched comparison routes all rows through all paths'
+    predicates at once — the device twin of the reference's pass-through
+    classify (DecisionTreeBuilder.java:700-705) without the per-path host
+    loop.
+
+    x_num f32 [n, An], x_cat i32 [n, Ac]; predicate tables [T, P, D]
+    (+ member [T, P, D, B]); kind 0 = unused slot (always true)."""
+    xn = x_num[:, None, None, None, :]            # [n,1,1,1,An]
+    xv = jnp.take_along_axis(
+        jnp.broadcast_to(xn, xn.shape[:3] + (1, xn.shape[-1])),
+        jnp.maximum(col, 0)[None, ..., None], axis=-1)[..., 0]   # [n,T,P,D]
+    v, o = val[None], other[None]
+    ge = xv >= v
+    lt = xv < v
+    gt = xv > v
+    le = xv <= v
+    has_other = jnp.isfinite(o)
+    num_ok = jnp.select(
+        [op[None] == 0, op[None] == 1, op[None] == 2],
+        [ge & jnp.where(has_other, xv < o, True),
+         lt & jnp.where(has_other, xv >= o, True),
+         gt & jnp.where(has_other, xv <= o, True)],
+        le & jnp.where(has_other, xv > o, True),
+    )
+    code = jnp.take_along_axis(
+        jnp.broadcast_to(x_cat[:, None, None, None, :],
+                         (x_cat.shape[0],) + col.shape + (x_cat.shape[1],)),
+        jnp.maximum(col, 0)[None, ..., None], axis=-1)[..., 0]   # [n,T,P,D]
+    cat_ok = jnp.take_along_axis(
+        jnp.broadcast_to(member[None],
+                         (x_cat.shape[0],) + member.shape),
+        jnp.clip(code, 0, member.shape[-1] - 1)[..., None], axis=-1)[..., 0]
+    ok = jnp.where(kind[None] == 1, num_ok,
+                   jnp.where(kind[None] == 2, cat_ok, True))
+    return jnp.all(ok, axis=-1)                   # [n, T, P]
+
+
+class DevicePathEvaluator:
+    """Tensorized application of one or more DecisionPathList models.
+
+    Compiles the trees' predicate chains into padded tables [T, P, D]
+    (trees x paths x chain depth) so prediction is one jitted kernel:
+    every row x every path evaluates as a batched comparison, first
+    matching path in path order wins (the host predict's assignment
+    order), and a forest majority-votes across the tree axis."""
+
+    def __init__(self, trees: Sequence[DecisionPathList],
+                 schema: FeatureSchema, class_values: List[str]):
+        self.schema = schema
+        self.class_values = class_values
+        num_fields = [f for f in schema.feature_fields if f.is_numeric]
+        cat_fields = [f for f in schema.feature_fields if f.is_categorical]
+        self.num_fields, self.cat_fields = num_fields, cat_fields
+        num_col = {f.ordinal: i for i, f in enumerate(num_fields)}
+        cat_col = {f.ordinal: i for i, f in enumerate(cat_fields)}
+        bmax = max((len(f.cardinality) for f in cat_fields), default=1)
+        t = len(trees)
+        p = max((len(tr.paths) for tr in trees), default=1) or 1
+        d = max((len(pa.predicates) for tr in trees for pa in tr.paths),
+                default=1) or 1
+        kind = np.zeros((t, p, d), np.int8)
+        col = np.zeros((t, p, d), np.int32)
+        op = np.zeros((t, p, d), np.int8)
+        val = np.zeros((t, p, d), np.float32)
+        other = np.full((t, p, d), np.nan, np.float32)
+        member = np.ones((t, p, d, bmax), bool)
+        path_class = np.zeros((t, p), np.int32)
+        path_valid = np.zeros((t, p), bool)
+        for ti, tr in enumerate(trees):
+            for pi, pa in enumerate(tr.paths):
+                if pa.class_val_pr:
+                    best = max(pa.class_val_pr.items(), key=lambda kv: kv[1])[0]
+                    path_class[ti, pi] = class_values.index(best)
+                    path_valid[ti, pi] = True
+                for di, pr in enumerate(pa.predicates):
+                    if pr.operator == "in":
+                        kind[ti, pi, di] = 2
+                        col[ti, pi, di] = cat_col[pr.attribute]
+                        fld = schema.field_by_ordinal(pr.attribute)
+                        idx = fld.cardinality_index()
+                        row = np.zeros(bmax, bool)
+                        for v in pr.cat_values:
+                            if v in idx:
+                                row[idx[v]] = True
+                        member[ti, pi, di] = row
+                    else:
+                        kind[ti, pi, di] = 1
+                        col[ti, pi, di] = num_col[pr.attribute]
+                        op[ti, pi, di] = _OP_CODE[pr.operator]
+                        val[ti, pi, di] = pr.value
+                        if pr.other_bound is not None:
+                            other[ti, pi, di] = pr.other_bound
+        self.tables = tuple(jnp.asarray(a) for a in
+                            (kind, col, op, val, other, member))
+        self.path_class = jnp.asarray(path_class)
+        self.path_valid = jnp.asarray(path_valid)
+        self.n_trees = t
+
+    def _features(self, ds: Dataset):
+        # a dummy column keeps the gather axes non-empty for schemas with
+        # no numeric (or no categorical) features; kind masks it out
+        x_num = np.stack(
+            [ds.column(f.ordinal).astype(np.float32) for f in self.num_fields],
+            axis=1) if self.num_fields else np.zeros((len(ds), 1), np.float32)
+        x_cat = np.stack(
+            [ds.column(f.ordinal).astype(np.int32) for f in self.cat_fields],
+            axis=1) if self.cat_fields else np.zeros((len(ds), 1), np.int32)
+        return jnp.asarray(x_num), jnp.asarray(x_cat)
+
+    def per_tree_predict(self, ds: Dataset) -> np.ndarray:
+        """[n, T] predicted class codes, first matching path in path order
+        (rows matching no valid path predict class 0, as the host loop)."""
+        x_num, x_cat = self._features(ds)
+        matches = _path_match_kernel(x_num, x_cat, *self.tables)
+        matches = matches & self.path_valid[None]
+        first = jnp.argmax(matches, axis=-1)                    # [n, T]
+        pred = jnp.take_along_axis(
+            jnp.broadcast_to(self.path_class[None], matches.shape),
+            first[..., None], axis=-1)[..., 0]
+        any_match = matches.any(axis=-1)
+        return np.asarray(jnp.where(any_match, pred, 0).astype(jnp.int32))
+
+    def predict(self, ds: Dataset) -> np.ndarray:
+        """[n] class codes: single tree pass-through, or majority vote
+        across trees (RandomForestBuilder.predict semantics)."""
+        per_tree = self.per_tree_predict(ds)
+        if self.n_trees == 1:
+            return per_tree[:, 0]
+        k = len(self.class_values)
+        votes = np.zeros((per_tree.shape[0], k), np.int64)
+        for t in range(per_tree.shape[1]):
+            votes[np.arange(per_tree.shape[0]), per_tree[:, t]] += 1
+        return votes.argmax(axis=1).astype(np.int32)
+
+
+# ---------------------------------------------------------------------------
 # builder
 # ---------------------------------------------------------------------------
 
@@ -421,7 +581,8 @@ class DecisionTreeBuilder:
         leaves: List[Dict] = [{"preds": [], "used": set(), "stopped": False}]
         done_paths: List[DecisionPath] = []
 
-        impurity_fn = bits_entropy if self.algo in ("entropy", "infoGain") else gini
+        impurity_fn = (_np_bits_entropy if self.algo in ("entropy", "infoGain")
+                       else _np_gini)
 
         for depth in range(self.max_depth):
             active = [
@@ -430,18 +591,25 @@ class DecisionTreeBuilder:
             ]
             if not active:
                 break
+            # pad the leaf axis to the next power of two: n_leaves is a
+            # static (compile-time) dimension, and letting it take every
+            # integer value would recompile the histogram per level and
+            # per tree (each compile costs tens of seconds on a remote
+            # chip); padded segment ids receive no rows
+            lpad = 1 << (len(leaves) - 1).bit_length()
             counts = np.asarray(_level_histogram(
-                leaf_id, seg_d, labels_d, w, len(leaves), ns, self.smax, k
-            ))                                                # [L, NS, S, K]
+                leaf_id, seg_d, labels_d, w, lpad, ns, self.smax, k
+            ))[: len(leaves)]                                 # [L, NS, S, K]
             seg_tot = counts.sum(axis=3)                      # [L, NS, S]
             leaf_tot = seg_tot.sum(axis=2)                    # [L, NS] (same per split)
 
             # weighted impurity per (leaf, split)
-            imp = np.asarray(impurity_fn(jnp.asarray(counts), axis=-1))  # [L,NS,S]
+            imp = impurity_fn(counts, axis=-1)                # [L,NS,S]
             wimp = (seg_tot * imp).sum(axis=2) / np.maximum(leaf_tot, 1e-9)
 
-            best_split_of_leaf = np.full(len(leaves), -1, np.int32)
-            child_offset = np.full(len(leaves), -1, np.int32)
+            # lpad-sized for the same compile-stability reason as counts
+            best_split_of_leaf = np.full(lpad, -1, np.int32)
+            child_offset = np.full(lpad, -1, np.int32)
             new_leaves: List[Dict] = []
 
             for li in active:
@@ -449,7 +617,7 @@ class DecisionTreeBuilder:
                 pop = float(leaf_tot[li].max())
                 # class counts of this leaf: any split column's segment-sum
                 cls_counts = counts[li, 0].sum(axis=0) if ns else np.zeros(k)
-                node_imp = float(np.asarray(impurity_fn(jnp.asarray(cls_counts))))
+                node_imp = float(impurity_fn(cls_counts))
 
                 allowed = self._allowed_splits(lf)
                 if pop <= 0 or not allowed or node_imp <= 0.0:
@@ -502,8 +670,9 @@ class DecisionTreeBuilder:
         # emit final paths: any leaf never split
         model_paths: List[DecisionPath] = []
         counts_final = np.asarray(_level_histogram(
-            leaf_id, seg_d, labels_d, w, len(leaves), max(ns, 1), self.smax, k
-        )) if ns else None
+            leaf_id, seg_d, labels_d, w,
+            1 << (len(leaves) - 1).bit_length(), max(ns, 1), self.smax, k
+        ))[: len(leaves)] if ns else None
         for li, lf in enumerate(leaves):
             if "split" in lf or lf.get("pad"):
                 continue                   # internal node / padded child slot
@@ -518,9 +687,9 @@ class DecisionTreeBuilder:
                 self.class_values[c]: (float(cls_counts[c]) / tot if tot else 0.0)
                 for c in range(k)
             }
-            info = float(np.asarray(
-                (bits_entropy if self.algo in ("entropy", "infoGain") else gini)(
-                    jnp.asarray(cls_counts))))
+            info = float(
+                (_np_bits_entropy if self.algo in ("entropy", "infoGain")
+                 else _np_gini)(cls_counts))
             model_paths.append(DecisionPath(
                 lf["preds"], int(tot), info, True, pr
             ))
@@ -578,11 +747,13 @@ class RandomForestBuilder:
         self.tree_kwargs = tree_kwargs
         self.trees: List[DecisionPathList] = []
         self.class_values = schema.class_values()
+        self._evaluator: Optional[DevicePathEvaluator] = None
 
     def fit(self, ds: Dataset) -> "RandomForestBuilder":
         n = len(ds)
         rng = np.random.default_rng(self.seed)
         self.trees = []
+        self._evaluator = None
         for t in range(self.num_trees):
             if self.sampling == "withReplace":
                 idx = rng.integers(0, n, n)
@@ -597,7 +768,15 @@ class RandomForestBuilder:
             self.trees.append(builder.fit(ds, row_weights=w))
         return self
 
-    def predict(self, ds: Dataset) -> np.ndarray:
+    def predict(self, ds: Dataset, device: bool = False) -> np.ndarray:
+        """Majority vote across trees. device=True routes every row
+        through every tree's paths as one batched kernel
+        (DevicePathEvaluator) instead of the host per-path loop."""
+        if device:
+            if self._evaluator is None:
+                self._evaluator = DevicePathEvaluator(
+                    self.trees, self.schema, self.class_values)
+            return self._evaluator.predict(ds)
         k = len(self.class_values)
         votes = np.zeros((len(ds), k), np.int64)
         for tree in self.trees:
