@@ -89,7 +89,12 @@ def run_job(name: str, conf, inputs: Sequence[str], output: str = "") -> JobResu
         )
     canonical, prefix, fn = _REGISTRY[name]
     if isinstance(conf, str):
-        cfg = JobConfig(load_properties(conf), prefix)
+        if conf.endswith(".conf"):
+            # Spark-surface HOCON config: one block per job name
+            # (resource/atmTrans.conf, chombo-spark JobConfiguration)
+            cfg = JobConfig.from_hocon(conf, canonical, prefix)
+        else:
+            cfg = JobConfig(load_properties(conf), prefix)
     elif isinstance(conf, dict):
         cfg = JobConfig(conf, prefix)
     else:
@@ -221,10 +226,22 @@ def bayesian_predictor(cfg: JobConfig, inputs: List[str], output: str) -> JobRes
     pipeline joins in (BayesianPredictor.java:262-286)."""
     from avenir_tpu.models.naive_bayes import NaiveBayesModel, NaiveBayesPredictor
 
+    from avenir_tpu.utils.metrics import CostBasedArbitrator
+
     schema = _schema(cfg)
     model = NaiveBayesModel.load(cfg.assert_get("bayesian.model.file.path"),
                                  schema, delim=cfg.field_delim)
-    pred = NaiveBayesPredictor(model)
+    # cost-based arbitration (BayesianPredictor.java:140-144):
+    # bap.predict.class.cost = falseNegCost,falsePosCost with
+    # bap.predict.class = negClass,posClass (cardinality order fallback)
+    arbitrator = None
+    costs = cfg.get_list("predict.class.cost", delim=cfg.field_delim)
+    if costs:
+        classes = cfg.get_list("predict.class",
+                               delim=cfg.field_delim) or schema.class_values()
+        arbitrator = CostBasedArbitrator(classes[0], classes[1],
+                                         int(costs[0]), int(costs[1]))
+    pred = NaiveBayesPredictor(model, arbitrator=arbitrator)
     prob_only = cfg.get_bool("output.feature.prob.only", False)
     validate = cfg.get_bool("validation.mode", False)
     delim = cfg.field_delim
@@ -293,6 +310,21 @@ def nearest_neighbor(cfg: JobConfig, inputs: List[str], output: str) -> JobResul
     cls_vals = schema.class_values()
     with_distr = cfg.get_bool("output.class.distr", False)
     validate = cfg.get_bool("validation.mode", False)
+    # cost-based arbitration (NearestNeighbor.java:264-277, :383-387):
+    # nen.misclassification.cost = falsePosCost,falseNegCost with
+    # nen.class.attribute.values = posClass,negClass
+    arbitrator = pos_i = neg_i = None
+    if cfg.get_bool("use.cost.based.classifier", False):
+        from avenir_tpu.utils.metrics import CostBasedArbitrator
+
+        cav = cfg.get_list("class.attribute.values") or [
+            cls_vals[1], cls_vals[0]]
+        pos_v, neg_v = cav[0], cav[1]
+        costs = cfg.assert_list("misclassification.cost")
+        fp_cost, fn_cost = int(costs[0]), int(costs[1])
+        arbitrator = CostBasedArbitrator(neg_v, pos_v, fn_cost, fp_cost)
+        pos_i, neg_i = cls_vals.index(pos_v), cls_vals.index(neg_v)
+        clf.positive_class = pos_i
     # queries stream in blocks against the resident train index — test-set
     # size never bounds host RSS (the model is the index, not the queries)
     block = int(cfg.get_float("stream.block.size.mb", 64.0) * (1 << 20))
@@ -301,6 +333,12 @@ def nearest_neighbor(cfg: JobConfig, inputs: List[str], output: str) -> JobResul
     with open(out, "w") as fh:
         for test in prefetched(iter_csv_chunks(test_path, schema, delim, block)):
             codes, scores = clf.predict(test)
+            if arbitrator is not None:
+                # getClassProb int-percent scale (Neighborhood.java:319-334)
+                tot = np.maximum(scores.sum(axis=1), 1e-9)
+                pos_prob = np.floor(100.0 * scores[:, pos_i] / tot)
+                codes = np.where(arbitrator.classify(pos_prob),
+                                 pos_i, neg_i).astype(np.int32)
             for i, (rid, c) in enumerate(zip(test.ids(), codes)):
                 fields = [str(rid), cls_vals[int(c)]]
                 if with_distr:
@@ -939,21 +977,59 @@ def rule_miner_job(cfg: JobConfig, inputs: List[str], output: str) -> JobResult:
 @job("markovStateTransitionModel", "mst",
      "org.avenir.markov.MarkovStateTransitionModel")
 def markov_model_job(cfg: JobConfig, inputs: List[str], output: str) -> JobResult:
+    """Per-class matrices via mst.* keys (the Hadoop job). With
+    `id.field.ordinals` set (the Spark surface's HOCON key,
+    MarkovStateTransitionModel.scala:51-52), builds one matrix PER ENTITY
+    key — the multi-tenant mode — with `seq.start.ordinal` marking where
+    the state sequence begins and optional `class.attr.ordinal` splitting
+    each entity's matrix by class; sections are emitted as `entity:<key>`."""
     from avenir_tpu.models.markov import MarkovStateTransitionModel
 
-    states = cfg.assert_list("model.states")
+    states = cfg.get_list("model.states") or cfg.assert_list("state.list")
+    scale = cfg.get_int("trans.prob.scale", 1000)
+    id_ords = cfg.get_int_list("id.field.ordinals")
+    out = _out_file(output)
+    if id_ords is not None:
+        class_ord = cfg.get_int("class.attr.ordinal")
+        # mandatory in the Spark reference (getMandatoryIntParam, :54);
+        # the convenience default must skip the class column too
+        seq_start = cfg.get_int(
+            "seq.start.ordinal",
+            max(id_ords + ([class_ord] if class_ord is not None else [])) + 1)
+        delim = cfg.field_delim_regex
+        seqs: List[List[str]] = []
+        entity_of_row: List[str] = []
+        entities: List[str] = []
+        seen = set()
+        for path in inputs:
+            for ln in _read_lines(path):
+                toks = [t.strip() for t in ln.split(delim)]
+                key = ",".join(toks[o] for o in id_ords)
+                if class_ord is not None:
+                    key += f",{toks[class_ord]}"
+                if key not in seen:
+                    seen.add(key)
+                    entities.append(key)
+                entity_of_row.append(key)
+                seqs.append(toks[seq_start:])
+        model = MarkovStateTransitionModel(states, scale=scale,
+                                           class_labels=entities)
+        model.fit(seqs, entity_of_row)
+        model.save(out, delim=cfg.field_delim, marker="entity")
+        return JobResult("markovStateTransitionModel",
+                         {"Entities:Count": len(entities)}, [out], model)
+
     class_ord = cfg.get_int("class.label.field.ord")
     skip = cfg.get_int("skip.field.count", 1)
     class_labels = cfg.get_list("class.labels")
     model = MarkovStateTransitionModel(
-        states, scale=cfg.get_int("trans.prob.scale", 1000),
+        states, scale=scale,
         class_labels=class_labels,
     )
     for path in inputs:
         _, seqs, labels = _read_sequences(path, cfg.field_delim_regex,
                                           skip, class_ord)
         model.fit(seqs, labels if class_labels else None)
-    out = _out_file(output)
     model.save(out, delim=cfg.field_delim)
     return JobResult("markovStateTransitionModel", {}, [out], model)
 
@@ -999,7 +1075,10 @@ def markov_classifier_job(cfg: JobConfig, inputs: List[str], output: str) -> Job
      "org.avenir.markov.HiddenMarkovModelBuilder")
 def hmm_builder_job(cfg: JobConfig, inputs: List[str], output: str) -> JobResult:
     """Fully-tagged input: `obs<sub.field.delim>state` tokens after the skip
-    fields (HiddenMarkovModelBuilder.java:136-153)."""
+    fields (HiddenMarkovModelBuilder.java:136-153). With
+    `hmmb.partially.tagged=true`, tokens are bare observations except the
+    ones matching hmmb.model.states, and `hmmb.window.function` spreads the
+    state->obs counts around each tagged position (:174-259)."""
     from avenir_tpu.models.markov import HiddenMarkovModelBuilder
 
     states = cfg.assert_list("model.states")
@@ -1007,14 +1086,22 @@ def hmm_builder_job(cfg: JobConfig, inputs: List[str], output: str) -> JobResult
     sub = cfg.get("sub.field.delim", ":")
     skip = cfg.get_int("skip.field.count", 1)
     builder = HiddenMarkovModelBuilder(states, obs)
-    state_seqs, obs_seqs = [], []
-    for path in inputs:
-        _, seqs, _ = _read_sequences(path, cfg.field_delim_regex, skip)
-        for seq in seqs:
-            pairs = [tok.split(sub) for tok in seq]
-            obs_seqs.append([p[0] for p in pairs])
-            state_seqs.append([p[1] for p in pairs])
-    hmm = builder.fit(state_seqs, obs_seqs)
+    if cfg.get_bool("partially.tagged", False):
+        wf = [int(v) for v in cfg.assert_list("window.function")]
+        all_seqs = []
+        for path in inputs:
+            _, seqs, _ = _read_sequences(path, cfg.field_delim_regex, skip)
+            all_seqs.extend(seqs)
+        hmm = builder.fit_partially_tagged(all_seqs, wf)
+    else:
+        state_seqs, obs_seqs = [], []
+        for path in inputs:
+            _, seqs, _ = _read_sequences(path, cfg.field_delim_regex, skip)
+            for seq in seqs:
+                pairs = [tok.split(sub) for tok in seq]
+                obs_seqs.append([p[0] for p in pairs])
+                state_seqs.append([p[1] for p in pairs])
+        hmm = builder.fit(state_seqs, obs_seqs)
     out = _out_file(output)
     hmm.save(out, delim=cfg.field_delim)
     return JobResult("hiddenMarkovModelBuilder", {}, [out], hmm)
@@ -1218,7 +1305,8 @@ def run_from_cli(argv: Sequence[str]) -> JobResult:
     args = ap.parse_args(argv)
     if not args.paths:
         ap.error("expected IN... OUT paths (at least an output path)")
-    props = load_properties(args.conf) if args.conf else {}
+    # a .conf path routes through the HOCON block loader in run_job
+    props = args.conf if args.conf else {}
     short = args.jobname.rsplit(".", 1)[-1]
     name = args.jobname if args.jobname in _REGISTRY else short[0].lower() + short[1:]
     inputs, output = args.paths[:-1], args.paths[-1]

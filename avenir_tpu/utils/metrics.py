@@ -89,22 +89,37 @@ class ConfusionMatrix:
 class CostBasedArbitrator:
     """Misclassification-cost decision between two classes.
 
-    Reference: util/CostBasedArbitrator.java, used by BayesianPredictor
-    (:342-391) and NearestNeighbor. Given per-class probabilities (scaled to
-    int percent in the reference) and per-class misclassification costs,
-    choose positive iff prob_pos * cost_fn >= prob_neg * cost_fp (expected
-    cost comparison)."""
+    Reference: util/CostBasedArbitrator.java, constructed as
+    (negClass, posClass, falseNegCost, falsePosCost) and used by
+    BayesianPredictor (:342-391, two-probability `arbitrate`) and
+    NearestNeighbor (:383-387, positive-probability-threshold `classify`).
+    Probabilities are int-percent scaled in the reference; both methods
+    here are vectorized over numpy arrays and keep the reference's exact
+    integer decision formulas."""
 
     def __init__(self, neg_class: str, pos_class: str,
-                 cost_neg: float, cost_pos: float):
+                 false_neg_cost: float, false_pos_cost: float):
         self.neg_class = neg_class
         self.pos_class = pos_class
-        self.cost_neg = cost_neg  # cost of misclassifying a true negative
-        self.cost_pos = cost_pos  # cost of misclassifying a true positive
+        self.false_neg_cost = false_neg_cost  # cost of missing a positive
+        self.false_pos_cost = false_pos_cost  # cost of a false alarm
 
     def arbitrate(self, prob_neg: np.ndarray, prob_pos: np.ndarray) -> np.ndarray:
-        """Vectorized: returns bool array, True -> positive class."""
-        return np.asarray(prob_pos) * self.cost_pos >= np.asarray(prob_neg) * self.cost_neg
+        """True -> positive class. CostBasedArbitrator.arbitrate:
+        negCost = falseNegCost*posProb + negProb,
+        posCost = falsePosCost*negProb + posProb, pick pos iff posCost<negCost."""
+        pos, neg = np.asarray(prob_pos), np.asarray(prob_neg)
+        neg_cost = self.false_neg_cost * pos + neg
+        pos_cost = self.false_pos_cost * neg + pos
+        return pos_cost < neg_cost
+
+    def classify(self, prob_pos: np.ndarray) -> np.ndarray:
+        """True -> positive class. CostBasedArbitrator.classify: positive
+        iff posProb > falsePosCost*100 / (falsePosCost + falseNegCost)
+        (integer division, as the reference computes it)."""
+        thr = int(self.false_pos_cost * 100) // int(
+            self.false_pos_cost + self.false_neg_cost)
+        return np.asarray(prob_pos) > thr
 
 
 class Counters:

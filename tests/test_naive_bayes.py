@@ -82,7 +82,8 @@ class TestPredict:
         assert counters["Validation:Accuracy"] > 80
 
     def test_cost_arbitration_shifts_decisions(self, churn, model):
-        arb = CostBasedArbitrator("open", "closed", cost_neg=1.0, cost_pos=10.0)
+        arb = CostBasedArbitrator("open", "closed",
+                                  false_neg_cost=10.0, false_pos_cost=1.0)
         pred_arb, _ = NaiveBayesPredictor(model, arbitrator=arb).predict(churn)
         pred_def, _ = NaiveBayesPredictor(model).predict(churn)
         # heavy positive-miss cost -> at least as many positive predictions

@@ -74,6 +74,49 @@ def test_job_registry_has_reference_names():
         assert expected in names, expected
 
 
+def test_cost_arbitration_flips_predictions(churn_files, tmp_path):
+    """The bap.predict.class.cost / nen.misclassification.cost keys must
+    change job output (BayesianPredictor.java:140-144, NearestNeighbor.java:
+    264-277) — a heavy false-negative cost pushes decisions positive."""
+    model_out = str(tmp_path / "model.csv")
+    base = {"bad.feature.schema.file.path": churn_files["schema"],
+            "bap.feature.schema.file.path": churn_files["schema"],
+            "bap.bayesian.model.file.path": model_out,
+            "nen.feature.schema.file.path": churn_files["schema"],
+            "nen.top.match.count": "5"}
+    run_job("bayesianDistr", base, [churn_files["train"]], model_out)
+
+    def nb_preds(props, tag):
+        out = str(tmp_path / f"bap_{tag}.csv")
+        run_job("bayesianPredictor", props, [churn_files["test"]], out)
+        return [ln.rsplit(",", 2)[1] for ln in open(out).read().splitlines()]
+
+    plain = nb_preds(base, "plain")
+    # churn classes are (open, closed)=(neg, pos); missing a closed
+    # (pos) costs 50x a false alarm
+    costed = nb_preds({**base, "bap.predict.class.cost": "50,1",
+                       "bap.predict.class": "open,closed"}, "cost")
+    assert costed != plain
+    assert costed.count("closed") > plain.count("closed")
+
+    def knn_preds(props, tag):
+        out = str(tmp_path / f"nen_{tag}.csv")
+        run_job("nearestNeighbor", props,
+                [churn_files["train"], churn_files["test"]], out)
+        return [ln.split(",")[1] for ln in open(out).read().splitlines()]
+
+    plain = knn_preds(base, "plain")
+    costed = knn_preds({**base, "nen.use.cost.based.classifier": "true",
+                        "nen.class.attribute.values": "closed,open",
+                        "nen.misclassification.cost": "1,50"}, "cost")
+    assert costed != plain
+    assert costed.count("closed") > plain.count("closed")
+    # oracle: threshold form — pos iff 100*score_pos/total > 100*fp/(fp+fn)
+    thr = (1 * 100) // (1 + 50)
+    assert all(p in ("open", "closed") for p in costed)
+    assert thr == 1  # nearly any positive evidence flips to closed
+
+
 def test_nb_train_predict_jobs(churn_files, tmp_path):
     model_out = str(tmp_path / "distr") + os.sep
     props = {"bad.feature.schema.file.path": churn_files["schema"]}
