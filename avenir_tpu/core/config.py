@@ -58,6 +58,58 @@ def parse_properties_string(text: str) -> Dict[str, str]:
     return props
 
 
+def load_hocon(path: str) -> Dict[str, Dict[str, str]]:
+    """Parse the HOCON subset the reference's Spark layer uses
+    (resource/atmTrans.conf, sup.conf; consumed per job block by
+    chombo-spark JobConfiguration, MarkovStateTransitionModel.scala:43-46):
+    one `jobName { ... }` block per job, `key = value` / `key: value`
+    entries, `//`/`#` comments, quoted or bare scalars, and `[a, "b"]`
+    lists. Nested blocks flatten to dotted keys. Values normalize to the
+    .properties string convention — lists become comma-joined strings — so
+    a JobConfig over a block behaves exactly like one over a properties
+    file."""
+    blocks: Dict[str, Dict[str, str]] = {}
+    stack: List[str] = []
+    with open(path) as fh:
+        text = fh.read()
+    for raw in text.splitlines():
+        line = raw.strip()
+        if not line or line.startswith("//") or line.startswith("#"):
+            continue
+        if line.endswith("{"):
+            stack.append(line[:-1].strip())
+            continue
+        if line == "}":
+            if not stack:
+                raise ValueError(f"{path}: unbalanced '}}'")
+            stack.pop()
+            continue
+        m = re.match(r"([^=:{]+?)\s*[=:]\s*(.*)$", line)
+        if not m:
+            continue
+        key, val = m.group(1).strip(), m.group(2).strip()
+        if not stack:
+            raise ValueError(f"{path}: top-level entry {key!r} outside a job block")
+        block = stack[0]
+        dotted = ".".join(stack[1:] + [key])
+        blocks.setdefault(block, {})[dotted] = _hocon_value(val)
+    if stack:
+        raise ValueError(f"{path}: unclosed block {stack[-1]!r}")
+    return blocks
+
+
+def _hocon_value(val: str) -> str:
+    val = val.strip()
+    if val.startswith("[") and val.endswith("]"):
+        inner = val[1:-1].strip()
+        if not inner:
+            return ""
+        return ",".join(_hocon_value(tok) for tok in inner.split(","))
+    if len(val) >= 2 and val[0] == val[-1] and val[0] in "\"'":
+        return val[1:-1]
+    return val
+
+
 _TRUE = {"true", "yes", "1", "on"}
 
 
@@ -81,6 +133,16 @@ class JobConfig:
     @classmethod
     def from_file(cls, path: str, prefix: str = "") -> "JobConfig":
         return cls(load_properties(path), prefix)
+
+    @classmethod
+    def from_hocon(cls, path: str, block: str, prefix: str = "") -> "JobConfig":
+        """A job's view of one HOCON job block (the Spark-surface config,
+        e.g. resource/atmTrans.conf driving contTimeStateTransitionStats)."""
+        blocks = load_hocon(path)
+        if block not in blocks:
+            raise MissingConfigError(
+                f"no block {block!r} in {path} (has: {', '.join(sorted(blocks))})")
+        return cls(blocks[block], prefix)
 
     def scoped(self, prefix: str) -> "JobConfig":
         """Same properties viewed under a different job prefix."""
@@ -160,7 +222,9 @@ class JobConfig:
 
     @property
     def field_delim_regex(self) -> str:
-        return self.props.get("field.delim.regex", ",")
+        # field.delim.in is the HOCON/Spark-surface spelling
+        return self.props.get("field.delim.regex",
+                              self.props.get("field.delim.in", ","))
 
     @property
     def debug_on(self) -> bool:

@@ -53,14 +53,22 @@ def encode_sequences(
 
 @partial(jax.jit, static_argnames=("n_states", "n_classes"))
 def _bigram_counts(padded, labels, n_states: int, n_classes: int):
-    """counts[c, i, j] = #(class c sequences with transition i->j)."""
+    """counts[c, i, j] = #(class c sequences with transition i->j).
+
+    Keyed segment_sum rather than a class one-hot einsum: the class axis
+    doubles as the ENTITY axis in the per-entity (multi-tenant) Spark mode
+    (MarkovStateTransitionModel.scala:34), where its size scales with the
+    data — a [rows, entities] one-hot would be O(rows x entities) memory,
+    while the flat (class, prev, next) key keeps it O(rows x seq_len)."""
     prev = padded[:, :-1]
     nxt = padded[:, 1:]
     valid = (prev >= 0) & (nxt >= 0)
-    oh_prev = jax.nn.one_hot(prev, n_states, dtype=jnp.float32) * valid[..., None]
-    oh_next = jax.nn.one_hot(nxt, n_states, dtype=jnp.float32)
-    oh_cls = jax.nn.one_hot(labels, n_classes, dtype=jnp.float32)
-    return jnp.einsum("sc,sli,slj->cij", oh_cls, oh_prev, oh_next)
+    key = (labels[:, None] * n_states + jnp.maximum(prev, 0)) * n_states \
+        + jnp.maximum(nxt, 0)
+    flat = jax.ops.segment_sum(
+        valid.astype(jnp.float32).reshape(-1), key.reshape(-1),
+        num_segments=n_classes * n_states * n_states)
+    return flat.reshape(n_classes, n_states, n_states)
 
 
 class MarkovStateTransitionModel:
@@ -100,14 +108,19 @@ class MarkovStateTransitionModel:
         return np.rint(prob * self.scale).astype(np.int64) if scaled else prob
 
     # ------------------------------------------------------------- file IO
-    def save(self, path: str, delim: str = ",") -> None:
+    def save(self, path: str, delim: str = ",",
+             marker: str = "classLabel") -> None:
         """Reference text format: states line, then (per class) matrix rows,
-        class sections marked 'classLabel:<v>'."""
+        class sections marked 'classLabel:<v>'. The per-entity Spark
+        variant (spark/sequence/MarkovStateTransitionModel.scala:34, one
+        matrix per entity key) writes the same shape with 'entity:<key>'
+        section markers — the adaptation of its (Record key, matrix)
+        saveAsTextFile pairs to the Hadoop job's single-file format."""
         with open(path, "w") as fh:
             fh.write(delim.join(self.states) + "\n")
             if self.class_labels:
                 for cv in self.class_labels:
-                    fh.write(f"classLabel:{cv}\n")
+                    fh.write(f"{marker}:{cv}\n")
                     for row in self.matrix(cv):
                         fh.write(delim.join(str(int(v)) for v in row) + "\n")
             else:
@@ -124,7 +137,7 @@ class MarkovStateTransitionModel:
         sections: Dict[Optional[str], List[List[float]]] = {}
         cur: Optional[str] = None
         for ln in lines[1:]:
-            if ln.startswith("classLabel:"):
+            if ln.startswith("classLabel:") or ln.startswith("entity:"):
                 cur = ln.split(":", 1)[1]
                 sections[cur] = []
             else:
@@ -234,9 +247,58 @@ class HiddenMarkovModelBuilder:
         for s, o in zip(ss, oo):
             self.emis_counts[s, o] += 1
 
-    def fit(self, state_seqs, obs_seqs) -> HiddenMarkovModel:
-        for ss, oo in zip(state_seqs, obs_seqs):
-            self.add(ss, oo)
+    def add_partially_tagged(self, tokens: Sequence[str],
+                             window_function: Sequence[int]) -> None:
+        """Window-function count spreading for partially-tagged sequences
+        (HiddenMarkovModelBuilder.processPartiallyTagged, :174-259): tokens
+        matching a model state tag the sequence sparsely; every untagged
+        token within the window around a state position contributes a
+        state->obs count weighted by windowFunction[distance-1] (the last
+        weight repeats beyond the function's length). Window bounds reach
+        halfway to the neighboring state; at the ends the opposite side's
+        window is mirrored (clamped to the sequence), and a lone state
+        reaches halfway to both sequence boundaries. Initial-state and
+        state->state counts come from the tagged positions alone.
+
+        Deviation from the reference, documented: the Java window-bound
+        expressions (:197, :205) read `a - b / 2` — operator precedence
+        makes them `a - (b/2)`, which walks the window past the neighboring
+        state (and past the sequence end, an array-bounds crash for long
+        gaps). This implements the evident intent, half the gap:
+        `(a - b) / 2`."""
+        sidx = {v: i for i, v in enumerate(self.states)}
+        oidx = {v: i for i, v in enumerate(self.observations)}
+        wf = list(window_function) or [1]
+        pos = [i for i, t in enumerate(tokens) if t in sidx]
+        if not pos:
+            return
+        self.init_counts[sidx[tokens[pos[0]]]] += 1
+        for a, b in zip(pos[:-1], pos[1:]):
+            self.trans_counts[sidx[tokens[a]], sidx[tokens[b]]] += 1
+        n = len(tokens)
+        for i, p in enumerate(pos):
+            left_w = (p - pos[i - 1]) // 2 if i > 0 else None
+            right_w = (pos[i + 1] - p) // 2 if i < len(pos) - 1 else None
+            if left_w is None and right_w is None:        # only one state
+                lb = p // 2
+                rb = p + (n - 1 - p) // 2
+            elif left_w is None:                          # first state
+                lb = max(p - right_w, 0)
+                rb = p + right_w
+            elif right_w is None:                         # last state
+                lb = p - left_w
+                rb = min(p + left_w, n - 1)
+            else:
+                lb, rb = p - left_w, p + right_w
+            s = sidx[tokens[p]]
+            for k, j in enumerate(range(p - 1, lb - 1, -1)):
+                w = wf[k] if k < len(wf) else wf[-1]
+                self.emis_counts[s, oidx[tokens[j]]] += w
+            for k, j in enumerate(range(p + 1, rb + 1)):
+                w = wf[k] if k < len(wf) else wf[-1]
+                self.emis_counts[s, oidx[tokens[j]]] += w
+
+    def finish(self) -> HiddenMarkovModel:
         lp = self.laplace
         t = self.trans_counts + lp
         e = self.emis_counts + lp
@@ -247,6 +309,18 @@ class HiddenMarkovModelBuilder:
             t / t.sum(axis=1, keepdims=True),
             e / e.sum(axis=1, keepdims=True),
         )
+
+    def fit(self, state_seqs, obs_seqs) -> HiddenMarkovModel:
+        for ss, oo in zip(state_seqs, obs_seqs):
+            self.add(ss, oo)
+        return self.finish()
+
+    def fit_partially_tagged(self, token_seqs,
+                             window_function: Sequence[int]
+                             ) -> HiddenMarkovModel:
+        for tokens in token_seqs:
+            self.add_partially_tagged(tokens, window_function)
+        return self.finish()
 
 
 @partial(jax.jit, static_argnames=())

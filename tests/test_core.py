@@ -168,6 +168,87 @@ class TestConfig:
         assert cfg.scoped("dtb").get_int("max.depth.limit") == 2
 
 
+class TestHocon:
+    """HOCON loader for the Spark-surface config (resource/atmTrans.conf,
+    MarkovStateTransitionModel.scala:43-46)."""
+
+    CONF = textwrap.dedent(
+        """\
+        // spark job blocks
+        stateTransitionRate {
+            field.delim.in = ","
+            key.field.ordinals = [0]
+            state.values = ["10", "20", "30"]
+            rate.time.unit = "day"
+            trans.rate.output.precision = 9
+            debug.on = false
+        }
+        contTimeStateTransitionStats {
+            state.values = ["F", "P", "L"]
+            time.horizon = 4
+            state.trans.file.path="file:///tmp/tra"
+            target.states = ["L"]
+            nested {
+                inner.key = 7
+            }
+        }
+        """
+    )
+
+    def test_blocks_and_values(self, tmp_path):
+        from avenir_tpu.core.config import load_hocon
+
+        p = tmp_path / "jobs.conf"
+        p.write_text(self.CONF)
+        blocks = load_hocon(str(p))
+        assert set(blocks) == {"stateTransitionRate",
+                               "contTimeStateTransitionStats"}
+        str_blk = blocks["stateTransitionRate"]
+        assert str_blk["key.field.ordinals"] == "0"
+        assert str_blk["state.values"] == "10,20,30"
+        assert str_blk["rate.time.unit"] == "day"
+        cts = blocks["contTimeStateTransitionStats"]
+        assert cts["state.trans.file.path"] == "file:///tmp/tra"
+        assert cts["nested.inner.key"] == "7"
+
+    def test_jobconfig_over_block(self, tmp_path):
+        p = tmp_path / "jobs.conf"
+        p.write_text(self.CONF)
+        cfg = JobConfig.from_hocon(str(p), "contTimeStateTransitionStats",
+                                   prefix="cts")
+        assert cfg.get_list("state.values") == ["F", "P", "L"]
+        assert cfg.get_float("time.horizon") == 4.0
+        assert cfg.get_list("target.states") == ["L"]
+        with pytest.raises(MissingConfigError):
+            JobConfig.from_hocon(str(p), "noSuchJob")
+
+    def test_parses_actual_reference_conf(self):
+        import os
+
+        from avenir_tpu.core.config import load_hocon
+
+        ref = "/root/reference/resource/atmTrans.conf"
+        if not os.path.exists(ref):
+            pytest.skip("reference tree not mounted")
+        blocks = load_hocon(ref)
+        cts = blocks["contTimeStateTransitionStats"]
+        assert cts["state.values"].split(",") == [
+            "10", "20", "30", "40", "50", "60", "70", "80", "90", "100"]
+        assert cts["state.trans.stat"] == "stateDwellTime"
+        assert blocks["stateTransitionRate"]["rate.time.unit"] == "day"
+
+    def test_malformed_raises(self, tmp_path):
+        from avenir_tpu.core.config import load_hocon
+
+        p = tmp_path / "bad.conf"
+        p.write_text("jobA {\n key = 1\n")
+        with pytest.raises(ValueError, match="unclosed"):
+            load_hocon(str(p))
+        p.write_text("stray.key = 1\n")
+        with pytest.raises(ValueError, match="outside a job block"):
+            load_hocon(str(p))
+
+
 class TestDataset:
     def test_columns(self, ds):
         assert len(ds) == 4

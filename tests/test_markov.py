@@ -143,6 +143,62 @@ class TestHMM:
         oracle = [states[s] for s in path[::-1]]
         assert got == oracle
 
+    def test_partial_tagging_matches_oracle(self):
+        """Window-function spreading vs a hand-computed oracle
+        (HiddenMarkovModelBuilder.processPartiallyTagged:174-259, with the
+        documented half-the-gap window-bound fix)."""
+        states = ["S", "T"]
+        obs = ["a", "b", "c"]
+        # states at positions 2 and 6; gap 4 -> window 2 on each side
+        tokens = ["a", "b", "S", "c", "a", "b", "T", "c"]
+        b = HiddenMarkovModelBuilder(states, obs, laplace=0.0)
+        b.add_partially_tagged(tokens, window_function=[3, 1])
+        # initial: first tagged state S; transition S->T once
+        np.testing.assert_array_equal(b.init_counts, [1, 0])
+        np.testing.assert_array_equal(b.trans_counts, [[0, 1], [0, 0]])
+        # S at 2: left_w None, right_w = (6-2)//2 = 2 -> lb = 0, rb = 4
+        #   left: pos 1 ("b") w=3, pos 0 ("a") w=1
+        #   right: pos 3 ("c") w=3, pos 4 ("a") w=1
+        # T at 6: left_w = 2, right_w None -> lb = 4, rb = min(8, 7) = 7
+        #   left: pos 5 ("b") w=3, pos 4 ("a") w=1
+        #   right: pos 7 ("c") w=3
+        expect = np.array([
+            [2, 3, 3],     # S: a = 1 (pos 0) + 1 (pos 4), b=3, c=3
+            [1, 3, 3],     # T: a=1, b=3, c=3
+        ], dtype=float)
+        np.testing.assert_array_equal(b.emis_counts, expect)
+
+    def test_partial_tagging_single_state_and_window_tail(self):
+        states, obs = ["S"], ["a", "b"]
+        tokens = ["a", "b", "a", "b", "S", "a", "b", "a", "b"]
+        b = HiddenMarkovModelBuilder(states, obs, laplace=0.0)
+        # lone state at 4: lb = 4//2 = 2, rb = 4 + (8-4)//2 = 6
+        # left: pos 3 (b) w=5, pos 2 (a) w=5 (tail repeats last weight)
+        # right: pos 5 (a) w=5, pos 6 (b) w=5
+        b.add_partially_tagged(tokens, window_function=[5])
+        np.testing.assert_array_equal(b.emis_counts, [[10, 10]])
+        np.testing.assert_array_equal(b.init_counts, [1])
+
+    def test_hmm_builder_job_partial(self, tmp_path):
+        from avenir_tpu.runner import run_job
+
+        data = tmp_path / "seqs.csv"
+        data.write_text("id1,a,b,S,c,a,b,T,c\nid2,b,S,a,T,b\n")
+        out = str(tmp_path / "hmm.txt")
+        res = run_job("hiddenMarkovModelBuilder", {
+            "hmmb.model.states": "S,T",
+            "hmmb.model.observations": "a,b,c",
+            "hmmb.partially.tagged": "true",
+            "hmmb.window.function": "2,1",
+            "hmmb.skip.field.count": "1",
+        }, [str(data)], out)
+        hmm = HiddenMarkovModel.load(out)
+        assert hmm.states == ["S", "T"]
+        np.testing.assert_allclose(hmm.transition.sum(axis=1), 1.0, atol=1e-6)
+        np.testing.assert_allclose(hmm.emission.sum(axis=1), 1.0, atol=1e-6)
+        # both rows tag S before T -> S->T dominates S->S
+        assert hmm.transition[0, 1] > hmm.transition[0, 0]
+
     def test_hmm_file_roundtrip(self, hmm_data, tmp_path):
         states, obs, ss, oo, *_ = hmm_data
         hmm = HiddenMarkovModelBuilder(states, obs).fit(ss, oo)
@@ -151,6 +207,90 @@ class TestHMM:
         again = HiddenMarkovModel.load(str(p))
         np.testing.assert_allclose(again.transition, hmm.transition, atol=1e-5)
         np.testing.assert_allclose(again.emission, hmm.emission, atol=1e-5)
+
+
+class TestPerEntityMST:
+    """Per-entity (multi-tenant) matrices: the Spark MST semantics
+    (spark/sequence/MarkovStateTransitionModel.scala:34, keyed by
+    id.field.ordinals)."""
+
+    def test_job_builds_matrix_per_entity(self, tmp_path):
+        from avenir_tpu.runner import run_job
+
+        data = tmp_path / "atm.csv"
+        data.write_text(
+            "acct1,x,A,B,A,B\n"
+            "acct2,x,B,B,B,A\n"
+            "acct1,x,A,B\n"
+        )
+        out = str(tmp_path / "mst.txt")
+        run_job("markovStateTransitionModel", {
+            "mst.state.list": "A,B",
+            "mst.id.field.ordinals": "0",
+            "mst.seq.start.ordinal": "2",
+            "mst.trans.prob.scale": "100",
+        }, [str(data)], out)
+        text = open(out).read()
+        assert "entity:acct1" in text and "entity:acct2" in text
+        model = MarkovStateTransitionModel.load(out, scale=100)
+        assert set(model.class_labels) == {"acct1", "acct2"}
+        # acct1: transitions A->B x3, B->A x1 over its two rows
+        m1 = model.counts[model.class_labels.index("acct1")]
+        # stored as scaled row-normalized probs: A row all ->B
+        assert m1[0, 1] == 100 and m1[0, 0] == 0
+        # B->A 1 of 2 observed B-transitions (B->A, after A->B..)
+        m2 = model.counts[model.class_labels.index("acct2")]
+        assert m2[1, 1] > m2[1, 0] >= 0
+
+    def test_entity_class_combo_key(self, tmp_path):
+        from avenir_tpu.runner import run_job
+
+        data = tmp_path / "seq.csv"
+        data.write_text("e1,good,A,B\ne1,bad,B,A\n")
+        out = str(tmp_path / "mst.txt")
+        res = run_job("markovStateTransitionModel", {
+            "mst.state.list": "A,B",
+            "mst.id.field.ordinals": "0",
+            "mst.class.attr.ordinal": "1",
+            "mst.seq.start.ordinal": "2",
+        }, [str(data)], out)
+        assert res.counters["Entities:Count"] == 2
+        model = MarkovStateTransitionModel.load(out)
+        assert set(model.class_labels) == {"e1,good", "e1,bad"}
+
+    def test_cts_job_driven_by_reference_conf(self, tmp_path):
+        """The cts job consumes the reference's HOCON surface: same block
+        name, same key names (resource/atmTrans.conf) — only the
+        machine-local rate-matrix path differs."""
+        from avenir_tpu.runner import run_job
+
+        rates = tmp_path / "rates.txt"
+        rates.write_text("-0.2,0.2\n0.1,-0.1\n")
+        conf = tmp_path / "atm.conf"
+        conf.write_text(
+            'contTimeStateTransitionStats {\n'
+            '    field.delim.in = ","\n'
+            '    field.delim.out = ","\n'
+            '    key.field.len = 1\n'
+            '    state.values = ["up", "down"]\n'
+            '    time.horizon = 15\n'
+            f'    state.trans.file.path="{rates}"\n'
+            '    state.trans.stat = "stateDwellTime"\n'
+            '    target.states = ["down"]\n'
+            '    debug.on = false\n'
+            '    save.output = true\n'
+            '}\n'
+        )
+        data = tmp_path / "in.csv"
+        data.write_text("id1,up\nid2,down\n")
+        out = str(tmp_path / "cts.out")
+        res = run_job("contTimeStateTransitionStats", str(conf),
+                      [str(data)], out)
+        lines = open(out).read().splitlines()
+        assert len(lines) == 2
+        for ln in lines:
+            rid, v = ln.split(",")
+            assert 0.0 <= float(v) <= 15.0
 
 
 class TestPST:
