@@ -333,48 +333,111 @@ def relief_relevance(
     ds: Dataset,
     sample_size: Optional[int] = None,
     seed: int = 0,
+    block: int = 8192,
+    query_block: int = 8192,
 ) -> Dict[int, float]:
     """Relief: w_f += diff_f(x, nearest miss) - diff_f(x, nearest hit),
     averaged over sampled records (ReliefFeatureRelevance.java:49).
 
-    Vectorized: all-pairs distances within the (sampled) set; hit = nearest
-    same-class other record, miss = nearest other-class record. Features are
-    range-normalized like the reference's metric."""
+    Device-scale: nearest hit/miss come from per-class blocked streaming
+    top-k (ops.distance.blocked_topk_neighbors) with query chunking, so
+    peak memory is O(query_block x block) — never the [m, m] diff
+    matrices. The per-attribute-averaged manhattan metric of the search
+    is relief's own mean of range-normalized diffs, so hit/miss selection
+    is unchanged; the final per-feature weights evaluate those diffs only
+    at the selected (record, hit/miss) pairs. Ranges use the schema's
+    min/max with a data-derived fallback, as the reference's metric."""
+    from avenir_tpu.ops.distance import blocked_topk_neighbors, pad_train
+
     n = len(ds)
     rng = np.random.default_rng(seed)
     idx = (np.arange(n) if sample_size is None or sample_size >= n
            else rng.choice(n, sample_size, replace=False))
     sub = ds.take(idx)
     y = sub.labels()
+    m = len(sub)
+    k_classes = ds.schema.num_classes()
 
     num_fields = [f for f in ds.schema.feature_fields if f.is_numeric]
     cat_fields = [f for f in ds.schema.feature_fields if f.is_categorical]
-    xs = []
-    per_feature_diff = []  # list of [m, m] diff matrices per feature
-    m = len(sub)
+    num_cols, ranges = [], []
     for f in num_fields:
         col = sub.column(f.ordinal).astype(np.float32)
         rngf = (f.max - f.min) if f.max is not None and f.min is not None else (
-            col.max() - col.min() or 1.0)
-        d = np.abs(col[:, None] - col[None, :]) / max(rngf, _EPS)
-        per_feature_diff.append((f.ordinal, d))
-    for f in cat_fields:
-        col = sub.column(f.ordinal).astype(np.int64)
-        d = (col[:, None] != col[None, :]).astype(np.float32)
-        per_feature_diff.append((f.ordinal, d))
+            float(col.max() - col.min()) or 1.0)
+        num_cols.append(col)
+        ranges.append(max(rngf, _EPS))
+    x_num = (np.stack(num_cols, axis=1) if num_cols
+             else np.zeros((m, 0), np.float32))
+    ranges_arr = np.asarray(ranges, np.float32)
+    if cat_fields:
+        x_cat = np.stack([sub.column(f.ordinal).astype(np.int32)
+                          for f in cat_fields], axis=1)
+        bins = tuple(len(f.cardinality) for f in cat_fields)
+    else:
+        x_cat, bins = None, None
 
-    total = sum(d for _, d in per_feature_diff) / max(len(per_feature_diff), 1)
-    np.fill_diagonal(total, np.inf)
-    same = y[:, None] == y[None, :]
-    d_hit = np.where(same, total, np.inf)
-    d_miss = np.where(~same, total, np.inf)
-    hit = d_hit.argmin(axis=1)
-    miss = d_miss.argmin(axis=1)
+    # nearest neighbor of every record within each class (self excluded)
+    best_d = np.full((m, k_classes), np.inf, np.float32)
+    best_i = np.zeros((m, k_classes), np.int64)
+    q_num_j = jnp.asarray(x_num) if x_num.shape[1] else None
+    q_cat_j = jnp.asarray(x_cat) if x_cat is not None else None
+    rng_j = jnp.asarray(ranges_arr) if ranges_arr.size else None
+    for ki in range(k_classes):
+        rows_c = np.flatnonzero(y == ki)
+        if len(rows_c) == 0:
+            continue
+        blk = min(block, len(rows_c))
+        t_num, t_cat, n_valid = pad_train(
+            x_num[rows_c] if x_num.shape[1] else None,
+            x_cat[rows_c] if x_cat is not None else None, blk)
+        kk = min(2, len(rows_c))
+        t_num_j = jnp.asarray(t_num) if t_num is not None else None
+        t_cat_j = jnp.asarray(t_cat) if t_cat is not None else None
+        for qs in range(0, m, query_block):
+            qe = min(qs + query_block, m)
+            dist, nidx = blocked_topk_neighbors(
+                q_num_j[qs:qe] if q_num_j is not None else None,
+                t_num_j,
+                q_cat_j[qs:qe] if q_cat_j is not None else None,
+                t_cat_j,
+                cat_bins=bins, num_ranges=rng_j, k=kk, block=blk,
+                metric="manhattan", n_valid=n_valid)
+            dist, nidx = np.asarray(dist), np.asarray(nidx)
+            in_c = y[qs:qe] == ki
+            # in-class queries find themselves first: take the runner-up
+            sel = np.where(in_c, kk - 1, 0)
+            r = np.arange(qe - qs)
+            d = dist[r, sel]
+            j = nidx[r, sel]
+            if kk == 1:        # a singleton class has no non-self hit
+                d = np.where(in_c, np.inf, d)
+            best_d[qs:qe, ki] = d
+            best_i[qs:qe, ki] = rows_c[np.clip(j, 0, len(rows_c) - 1)]
+
+    rows = np.arange(m)
+    hit_i = best_i[rows, y]
+    hit_ok = np.isfinite(best_d[rows, y])
+    miss_view = best_d.copy()
+    miss_view[rows, y] = np.inf
+    miss_cls = miss_view.argmin(axis=1)
+    miss_i = best_i[rows, miss_cls]
+    miss_ok = np.isfinite(miss_view[rows, miss_cls])
+    valid = hit_ok & miss_ok
+    if not valid.any():
+        return {f.ordinal: 0.0 for f in num_fields + cat_fields}
 
     weights = {}
-    rows = np.arange(m)
-    for ordn, d in per_feature_diff:
-        weights[ordn] = float((d[rows, miss] - d[rows, hit]).mean())
+    for fi, f in enumerate(num_fields):
+        col = x_num[:, fi]
+        d_hit = np.abs(col - col[hit_i]) / ranges_arr[fi]
+        d_miss = np.abs(col - col[miss_i]) / ranges_arr[fi]
+        weights[f.ordinal] = float((d_miss - d_hit)[valid].mean())
+    for fi, f in enumerate(cat_fields):
+        col = x_cat[:, fi]
+        d_hit = (col != col[hit_i]).astype(np.float32)
+        d_miss = (col != col[miss_i]).astype(np.float32)
+        weights[f.ordinal] = float((d_miss - d_hit)[valid].mean())
     return weights
 
 
@@ -462,12 +525,16 @@ def bagging_sample(ds: Dataset, rate: float = 1.0, seed: int = 0) -> Dataset:
 # ---------------------------------------------------------------------------
 
 
-def top_matches_by_class(ds: Dataset, k: int = 3, block: int = 4096
+def top_matches_by_class(ds: Dataset, k: int = 3, block: int = 4096,
+                         query_block: int = 16384
                          ) -> Dict[str, Tuple[np.ndarray, np.ndarray]]:
     """Per class: k nearest same-class neighbors for each record of that
     class (TopMatchesByClass.java:47). Returns class -> (dist [m, k],
     global dataset row idx [m, k]); row r of the pair is the class's r-th
-    record in dataset order (np.flatnonzero(labels == class))."""
+    record in dataset order (np.flatnonzero(labels == class)).
+
+    Queries stream in `query_block` chunks against the blocked index, so
+    peak memory is O(query_block x block) however large the class."""
     from avenir_tpu.models.knn import NeighborIndex
 
     y = ds.labels()
@@ -478,9 +545,16 @@ def top_matches_by_class(ds: Dataset, k: int = 3, block: int = 4096
             continue
         sub = ds.take(rows)
         index = NeighborIndex(sub, k=min(k + 1, len(rows)), block=block)
-        dist, idx = index.neighbors(sub)
+        dists, idxs = [], []
+        for qs in range(0, len(rows), query_block):
+            d, i = index.neighbors(
+                sub.take(np.arange(qs, min(qs + query_block, len(rows)))))
+            dists.append(np.asarray(d))
+            idxs.append(np.asarray(i))
+        dist = np.concatenate(dists)
+        idx = np.concatenate(idxs)
         # first neighbor is self (distance 0); drop it
-        out[cv] = (np.asarray(dist)[:, 1:], rows[np.asarray(idx)[:, 1:]])
+        out[cv] = (dist[:, 1:], rows[idx[:, 1:]])
     return out
 
 

@@ -138,6 +138,48 @@ def distributed_lr_step_fn(mesh: Mesh, learning_rate: float = 1.0):
     )
 
 
+def distributed_markov_counts_fn(mesh: Mesh, n_states: int,
+                                 n_classes: int = 1):
+    """Build a jitted mesh-wide Markov bigram counter: padded sequences
+    shard over the mesh rows, each shard runs the keyed segment_sum
+    (models.markov._bigram_counts — the Hadoop/Spark shuffle of
+    MarkovStateTransitionModel as one reduction), psum merges the
+    [C, S, S] count tensors so every device holds the global matrix."""
+    from avenir_tpu.models.markov import _bigram_counts
+
+    axes = tuple(a for a in (DATA_AXIS, MODEL_AXIS) if a in mesh.axis_names)
+
+    def kernel(padded, labels):
+        c = _bigram_counts(padded, labels, n_states, n_classes)
+        return lax.psum(c, axes)
+
+    row = P(axes)
+    return jax.jit(
+        jax.shard_map(kernel, mesh=mesh, in_specs=(row, row), out_specs=P(),
+                      check_vma=False)
+    )
+
+
+def distributed_apriori_support_fn(mesh: Mesh, k: int):
+    """Build a jitted mesh-wide Apriori support counter: the multi-hot
+    transaction tile shards over the mesh rows, candidates replicate, each
+    shard counts containment via the MXU matmul
+    (models.association._contain_counts), and a psum yields global
+    supports — the per-k MR job (FrequentItemsApriori.java:51) as one
+    collective."""
+    from avenir_tpu.models.association import _contain_counts
+
+    axes = tuple(a for a in (DATA_AXIS, MODEL_AXIS) if a in mesh.axis_names)
+
+    def kernel(trans, cand):
+        return lax.psum(_contain_counts(trans, cand, k), axes)
+
+    return jax.jit(
+        jax.shard_map(kernel, mesh=mesh, in_specs=(P(axes), P()),
+                      out_specs=P(), check_vma=False)
+    )
+
+
 def distributed_crosscount_fn(mesh: Mesh, bins_a: int, bins_b: int):
     """Build a jitted mesh-wide contingency counter: the primitive behind
     mutual information / correlations (SURVEY §2.4) — per-shard one-hot

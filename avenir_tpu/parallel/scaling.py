@@ -25,6 +25,10 @@ import numpy as np
 
 from avenir_tpu.parallel.mesh import DATA_AXIS, data_mesh
 
+# NB weak-scaling workload dims, shared by _nb_rate and the analytic
+# per-device traffic fields in measure_scaling
+_NB_CLASSES, _NB_FEAT, _NB_BMAX = 2, 8, 10
+
 
 def _timed_scalar(many_fn, *args) -> float:
     """Best-of-2 wall clock of the jitted scalar-reducing many_fn, warmup
@@ -53,7 +57,7 @@ def _nb_rate(mesh, rows: int, iters: int) -> float:
 
     from avenir_tpu.parallel.distributed import distributed_nb_train_fn
 
-    k_classes, n_feat, bmax = 2, 8, 10
+    k_classes, n_feat, bmax = _NB_CLASSES, _NB_FEAT, _NB_BMAX
     rng = np.random.default_rng(0)
     codes = rng.integers(0, bmax, (rows, n_feat)).astype(np.int32)
     labels = rng.integers(0, k_classes, rows).astype(np.int32)
@@ -132,14 +136,27 @@ def measure_scaling(
             f"no requested device count fits the {len(devs)} available "
             f"devices; include a count <= {len(devs)} (e.g. 1)"
         )
+    # analytic per-device work/traffic per step — constant per-device work
+    # is the weak-scaling invariant, and the ring-all-reduce bytes
+    # (2(P-1)/P x tensor bytes) are the collective cost the efficiency
+    # number prices in; unlike the wall clock these hold on real chips and
+    # let a contended virtual run still validate the harness math
+    nb_tensor_bytes = (_NB_FEAT * _NB_CLASSES * _NB_BMAX
+                       + _NB_CLASSES) * 4        # [F,K,B] + [K] f32
     table = []
     for n in counts:
         mesh = data_mesh(devs[:n], model_parallel=1)
         nb = _nb_rate(mesh, nb_rows_per_device * n, iters)
         knn = _knn_rate(mesh, knn_queries_per_device * n, knn_train, iters)
-        table.append({"devices": n,
-                      "nb_rows_per_sec": round(nb, 1),
-                      "knn_queries_per_sec": round(knn, 1)})
+        table.append({
+            "devices": n,
+            "nb_rows_per_sec": round(nb, 1),
+            "knn_queries_per_sec": round(knn, 1),
+            "nb_rows_per_device_per_step": nb_rows_per_device,
+            "nb_allreduce_bytes_per_device": round(
+                2 * (n - 1) / n * nb_tensor_bytes),
+            "knn_queries_per_device_per_step": knn_queries_per_device,
+        })
     base = table[0]
     for row in table:
         # efficiency vs linear relative to the smallest measured mesh

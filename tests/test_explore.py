@@ -99,6 +99,40 @@ class TestRelief:
         # CSCalls (ord 3, planted strong signal) should beat acctAge bucket
         assert w[3] > 0.0
 
+    def test_blocked_path_matches_bruteforce_oracle(self):
+        """The streaming top-k hit/miss search must produce the same
+        weights as the naive all-pairs [m, m] construction it replaced,
+        including across query-chunk boundaries (tiny blocks force both
+        train tiling and query chunking). Numeric data keeps distances
+        (nearly) tie-free so neighbor choices are deterministic."""
+        from avenir_tpu.data import generate_elearn
+
+        sub = generate_elearn(300, seed=2)
+        w_blocked = relief_relevance(sub, query_block=64, block=32)
+
+        # brute-force oracle (the pre-device implementation)
+        y = sub.labels()
+        m = len(sub)
+        feats = []
+        for f in sub.schema.feature_fields:
+            if not f.is_numeric:
+                continue
+            col = sub.column(f.ordinal).astype(np.float64)
+            rngf = ((f.max - f.min)
+                    if f.max is not None and f.min is not None
+                    else float(col.max() - col.min()) or 1.0)
+            feats.append((f.ordinal,
+                          np.abs(col[:, None] - col[None, :]) / rngf))
+        total = sum(d for _, d in feats) / len(feats)
+        np.fill_diagonal(total, np.inf)
+        same = y[:, None] == y[None, :]
+        hit = np.where(same, total, np.inf).argmin(axis=1)
+        miss = np.where(~same, total, np.inf).argmin(axis=1)
+        rows = np.arange(m)
+        for ordn, d in feats:
+            expect = float((d[rows, miss] - d[rows, hit]).mean())
+            assert abs(w_blocked[ordn] - expect) < 1e-3, ordn
+
 
 class TestAffinityEncoding:
     def test_class_affinity(self, churn):
