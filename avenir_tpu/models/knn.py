@@ -50,6 +50,29 @@ KERNELS = ("none", "linearMultiplicative", "linearAdditive", "gaussian")
 from avenir_tpu.core.dataset import extract_mixed_features as _extract
 
 
+def _expand_mixed(x_num, ranges, x_cat, bins, metric: str):
+    """One-hot-expand categoricals into the numeric matrix so the MIXED
+    metric rides the numeric pallas kernels: a one-hot pair contributes
+    ||a-b||^2 = 2*[a != b] (and L1 = 2*[a != b]), so scaling the one-hot
+    by 1/sqrt(2) (euclidean) or 1/2 (manhattan) makes the kernel's summed
+    term exactly the hamming mismatch count of ops.distance's mixed
+    semantics. The caller divides by the SEMANTIC attribute count
+    (n_attrs) instead of the expanded column count."""
+    n = x_num.shape[0] if x_num is not None else x_cat.shape[0]
+    cols = []
+    if x_num is not None and x_num.shape[1]:
+        cols.append(np.asarray(x_num, np.float32)
+                    / np.maximum(np.asarray(ranges, np.float32), 1e-9))
+    scale = (1.0 / np.sqrt(2.0)) if metric == "euclidean" else 0.5
+    for f, b in enumerate(bins or ()):
+        oh = np.zeros((n, b), np.float32)
+        oh[np.arange(n), np.asarray(x_cat[:, f], np.int64)] = scale
+        cols.append(oh)
+    x = np.concatenate(cols, axis=1) if cols else np.zeros((n, 0), np.float32)
+    n_attrs = (x_num.shape[1] if x_num is not None else 0) + len(bins or ())
+    return x, n_attrs
+
+
 @partial(jax.jit, static_argnames=("kernel", "num_classes", "class_cond",
                                    "inverse_weighted"))
 def _vote(
@@ -121,20 +144,21 @@ class NeighborIndex:
         self.block = min(block, max(len(train), 1))
 
         x_num, ranges, x_cat, bins = _extract(train)
-        # the fused pallas kernel serves the numeric-only case on real TPU
-        # (the flop-heavy sifarish role); mixed categorical stays on jnp
+        # the pallas kernels serve numeric AND mixed data on real TPU (the
+        # flop-heavy sifarish role): categoricals one-hot-expand into the
+        # numeric matrix (_expand_mixed) so the hamming term is matmul work
         from avenir_tpu.ops.pallas_knn import pallas_available
 
+        has_features = (x_num.shape[1] + (x_cat.shape[1] if x_cat is not None
+                                          else 0)) > 0
         if use_pallas:
             # explicit opt-in still requires the kernel's preconditions
             if not pallas_available():
                 raise RuntimeError(
                     "pallas KNN kernel needs a TPU backend "
                     "(jax.default_backend() != 'tpu')")
-            if x_cat is not None or x_num.shape[1] == 0:
-                raise ValueError(
-                    "pallas KNN kernel handles numeric-only features; "
-                    "this schema has categorical features")
+            if not has_features:
+                raise ValueError("pallas KNN kernel: schema has no features")
             if metric not in ("euclidean", "manhattan"):
                 raise ValueError(f"pallas KNN kernel: unsupported metric {metric!r}")
             if approx:
@@ -143,16 +167,20 @@ class NeighborIndex:
                     "top-k; approx=True needs the jnp path (approx_min_k)")
         self.use_pallas = (
             use_pallas if use_pallas is not None
-            else (pallas_available() and x_cat is None and x_num.shape[1] > 0
+            else (pallas_available() and has_features
                   and metric in ("euclidean", "manhattan") and not approx)
         )
         self.packed = packed and self.use_pallas
+        self.n_attrs = None
+        self._expand_ranges = ranges
         if self.use_pallas:
-            # pre-normalize by ranges once; pad to the kernel block.
+            # normalize + one-hot-expand once; pad to the kernel block.
             # 256x8192 f32 tile = 8 MB VMEM, the measured sweet spot; the
             # lane-packed kernel carries global chunk ids so block_t has no
             # index-bit cap (corpus cap 524288 rows enforced by the kernel)
-            x_num = x_num / np.maximum(ranges, 1e-9)
+            x_num, self.n_attrs = _expand_mixed(x_num, ranges, x_cat, bins,
+                                                metric)
+            x_cat = None
             self.block = max(128, min(pad_rows(len(train), 128), 8192))
             t_num, x_cat, n_valid = pad_train(x_num, None, self.block)
         else:
@@ -172,7 +200,8 @@ class NeighborIndex:
         if self.use_pallas:
             from avenir_tpu.ops.pallas_knn import knn_topk_lanes, knn_topk_pallas
 
-            q = q_num / np.maximum(np.asarray(self.ranges), 1e-9)
+            q, _ = _expand_mixed(q_num, self._expand_ranges, q_cat,
+                                 self.cat_bins, self.metric)
             bq = 256
             nq = q.shape[0]
             pad = (-nq) % bq
@@ -182,12 +211,12 @@ class NeighborIndex:
                 dist, idx = knn_topk_lanes(
                     jnp.asarray(q), self.t_num, k=self.k, block_q=bq,
                     block_t=self.block, metric=self.metric,
-                    n_valid=self.n_valid)
+                    n_valid=self.n_valid, n_attrs=self.n_attrs)
             else:
                 dist, idx = knn_topk_pallas(
                     jnp.asarray(q), self.t_num, k=self.k, block_q=bq,
                     block_t=self.block, metric=self.metric,
-                    n_valid=self.n_valid)
+                    n_valid=self.n_valid, n_attrs=self.n_attrs)
             return dist[:nq], idx[:nq]
         return blocked_topk_neighbors(
             jnp.asarray(q_num) if self.t_num is not None else None,
@@ -202,6 +231,33 @@ class NeighborIndex:
             n_valid=self.n_valid,
             approx=self.approx,
         )
+
+    def classify_scores(self, test: Dataset, train_labels: jnp.ndarray,
+                        n_classes: int, kernel_fn: str,
+                        kernel_param: float) -> Optional[jnp.ndarray]:
+        """Fully fused device classification: kernel-weighted top-k vote
+        scores [nq, C] via ops.pallas_knn.knn_classify_lanes — the top-k
+        results never leave the kernel (non-class-conditional vote modes).
+        Returns None when this index can't serve the fused path (jnp
+        route, or a block too small for the lane kernel's pair fold)."""
+        if not self.use_pallas or self.block % 256 != 0:
+            return None
+        from avenir_tpu.ops.pallas_knn import knn_classify_lanes
+
+        q_num, _, q_cat, _ = _extract(test)
+        q, _ = _expand_mixed(q_num, self._expand_ranges, q_cat,
+                             self.cat_bins, self.metric)
+        bq = 256
+        nq = q.shape[0]
+        pad = (-nq) % bq
+        if pad:
+            q = np.concatenate([q, np.zeros((pad, q.shape[1]), q.dtype)])
+        scores = knn_classify_lanes(
+            jnp.asarray(q), self.t_num, train_labels, k=self.k,
+            n_classes=n_classes, n_attrs=self.n_attrs,
+            kernel_fn=kernel_fn, kernel_param=kernel_param, block_q=bq,
+            block_t=self.block, metric=self.metric, n_valid=self.n_valid)
+        return scores[:nq]
 
 
 class NearestNeighborClassifier:
@@ -221,9 +277,16 @@ class NearestNeighborClassifier:
         block: int = 4096,
         nb_model: Optional[NaiveBayesModel] = None,
         approx: bool = False,
+        fused: bool = False,
     ):
+        """fused=True opts into the in-kernel vote (knn_classify_lanes) for
+        the non-class-conditional modes: class scores come straight out of
+        the pallas kernel (distances quantized ~2^-21, ties biased toward
+        lower class codes). The default composes the exact top-k with the
+        jitted _vote."""
         self.index = NeighborIndex(train, k=top_match_count, metric=metric,
                                    block=block, approx=approx)
+        self.fused = fused
         self.schema = train.schema
         self.k = self.index.k
         self.kernel = kernel_function
@@ -262,14 +325,20 @@ class NearestNeighborClassifier:
     # --------------------------------------------------------------- predict
     def predict(self, test: Dataset) -> Tuple[np.ndarray, np.ndarray]:
         """Returns (predicted class codes [nq], class scores [nq, K])."""
-        dist, idx = self.neighbors(test)
-        neigh_labels = self.train_labels[idx]
-        neigh_post = self.train_post[idx]
-        scores = _vote(
-            dist, neigh_labels, neigh_post,
-            self.kernel, self.kernel_param, len(self.class_values),
-            self.class_cond, self.inverse_weighted,
-        )
+        scores = None
+        if self.fused and not self.class_cond:
+            scores = self.index.classify_scores(
+                test, self.train_labels, len(self.class_values),
+                self.kernel, self.kernel_param)
+        if scores is None:
+            dist, idx = self.neighbors(test)
+            neigh_labels = self.train_labels[idx]
+            neigh_post = self.train_post[idx]
+            scores = _vote(
+                dist, neigh_labels, neigh_post,
+                self.kernel, self.kernel_param, len(self.class_values),
+                self.class_cond, self.inverse_weighted,
+            )
         scores = np.asarray(scores)
         # the reference's threshold branch exists only in non-class-cond mode
         # (Neighborhood.classify(), :272-312: weighted path pure-argmaxes)

@@ -341,7 +341,7 @@ def _extract_lane_topk(keys: jnp.ndarray, k: int, pack_bits: int):
 @functools.partial(
     jax.jit,
     static_argnames=("k", "block_q", "block_t", "metric", "n_valid",
-                     "interpret", "compute_dtype"),
+                     "interpret", "compute_dtype", "n_attrs"),
 )
 def knn_topk_lanes(
     q: jnp.ndarray,                 # [nq, D] f32, nq % block_q == 0
@@ -353,6 +353,7 @@ def knn_topk_lanes(
     n_valid: Optional[int] = None,
     interpret: bool = False,
     compute_dtype: str = "float32",
+    n_attrs: Optional[int] = None,
 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """(dist [nq, k] ascending, index [nq, k]) via the lane-resident packed
     kernel — the fastest path. Distances are quantized to 2^-(23-pack_bits)
@@ -391,18 +392,209 @@ def knn_topk_lanes(
         interpret=interpret,
     )(q, t)
     best_d, best_i = _extract_lane_topk(keys, k, pack_bits)
+    # n_attrs: semantic attribute count when columns one-hot-expand fewer
+    # mixed attributes (ops.distance mixed semantics); defaults to columns
+    na = d if n_attrs is None else n_attrs
     if metric == "euclidean":
-        best_d = jnp.sqrt(jnp.maximum(best_d, 0.0) / max(d, 1))
+        best_d = jnp.sqrt(jnp.maximum(best_d, 0.0) / max(na, 1))
     else:
-        best_d = best_d / max(d, 1)
+        best_d = best_d / max(na, 1)
     best_i = jnp.where(jnp.isinf(best_d), -1, best_i)
     return best_d, best_i
+
+
+def _kernel_score(dist, kernel: str, kernel_param: float):
+    """Reference vote scores (Neighborhood.java:150-218, KERNEL_SCALE=100)
+    on [BQ] final attribute-averaged distances — the same formulas as
+    models.knn._vote, evaluated in-kernel."""
+    d = jnp.floor(dist * 100.0)
+    if kernel == "none":
+        return jnp.ones_like(d)
+    if kernel == "linearMultiplicative":
+        return jnp.where(d == 0, 200.0, jnp.floor(100.0 / jnp.maximum(d, 1.0)))
+    if kernel == "linearAdditive":
+        return jnp.maximum(100.0 - d, 0.0)
+    if kernel == "gaussian":
+        t = d / kernel_param
+        return jnp.floor(100.0 * jnp.exp(-0.5 * t * t))
+    raise ValueError(f"unknown kernel {kernel}")
+
+
+def _knn_kernel_lanes_vote(q_ref, t_ref, lab_ref, keys_ref, scores_ref, *,
+                           k: int, metric: str, block_t: int, n_valid: int,
+                           nt: int, label_bits: int, n_classes: int,
+                           n_attrs: int, kernel_fn: str, kernel_param: float,
+                           n_tb: int, compute_dtype=jnp.float32):
+    """Lane-resident top-k with a FUSED class vote epilogue.
+
+    Same carry structure as _knn_kernel_lanes, but the key's low mantissa
+    bits carry the train row's CLASS LABEL instead of its chunk id — the
+    fused classify job needs votes, not neighbor identities, and
+    label_bits (1-3) is far finer quantization than the 10-12 chunk-id
+    bits (2^-20ish vs 2^-12). On the final train block the kernel
+    extracts the row top-k from the carries and accumulates the
+    kernel-weighted one-hot vote into scores [BQ, C] — the only HBM
+    output that scales with k is gone (C columns instead of
+    (k + khi) * 128 packed lanes), attacking the measured output-rate
+    ceiling of the top-k kernel directly."""
+    chunks = block_t // _LANES
+    assert chunks % 2 == 0, "block_t must be a multiple of 256 (pair fold)"
+    tb = pl.program_id(1)
+    mask = jnp.int32((1 << label_bits) - 1)
+    khi = _hi_depth(k)
+
+    @pl.when(tb == 0)
+    def _init():
+        keys_ref[...] = jnp.full_like(keys_ref, _SENTINEL)
+        scores_ref[...] = jnp.zeros_like(scores_ref)
+
+    if metric == "euclidean":
+        qv = q_ref[...]
+        tv = t_ref[...]
+        qs = 0.25 * jnp.sum(qv * qv, axis=1)[:, None]
+        ts = jnp.sum(tv * tv, axis=1)[None, :]
+        dot = jax.lax.dot_general(
+            qv.astype(compute_dtype), tv.astype(compute_dtype),
+            (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32,
+            precision=_dot_precision(compute_dtype))
+        tile = jnp.maximum(qs + ts + dot, 0.0)
+    else:
+        tile = _tile_distance(q_ref[...], t_ref[...], metric, compute_dtype)
+    bits = jax.lax.bitcast_convert_type(tile, jnp.int32)
+    labels = lab_ref[...]                                # [1, block_t] int32
+    base_chunk = tb * chunks
+    if n_valid < nt:
+        lane = jax.lax.broadcasted_iota(jnp.int32, (1, _LANES), 1)
+
+    carr_lo = [keys_ref[:, j * _LANES:(j + 1) * _LANES] for j in range(k)]
+    carr_hi = [keys_ref[:, (k + j) * _LANES:(k + j + 1) * _LANES]
+               for j in range(khi)]
+
+    def packed_chunk(c):
+        x = jnp.bitwise_or(
+            jnp.bitwise_and(bits[:, c * _LANES:(c + 1) * _LANES], ~mask),
+            labels[:, c * _LANES:(c + 1) * _LANES],
+        )
+        if n_valid < nt:
+            col = (base_chunk + c) * _LANES + lane
+            x = jnp.where(col < n_valid, x, _SENTINEL)
+        return x
+
+    def insert(carries, x):
+        depth = len(carries)
+        for j in range(depth):
+            lo = jnp.minimum(carries[j], x)
+            if j < depth - 1:
+                x = jnp.maximum(carries[j], x)
+            carries[j] = lo
+
+    for c in range(0, chunks, 2):
+        x0 = packed_chunk(c)
+        x1 = packed_chunk(c + 1)
+        insert(carr_lo, jnp.minimum(x0, x1))
+        if khi:
+            insert(carr_hi, jnp.maximum(x0, x1))
+    keys_ref[...] = jnp.concatenate(carr_lo + carr_hi, axis=1)
+
+    @pl.when(tb == n_tb - 1)
+    def _vote_epilogue():
+        cand = keys_ref[...]
+        pos = jax.lax.broadcasted_iota(jnp.int32, cand.shape, 1)
+        bq = cand.shape[0]
+        cols = [jnp.zeros((bq,), jnp.float32) for _ in range(n_classes)]
+        imax = jnp.int32(np.iinfo(np.int32).max)
+        for _ in range(k):
+            m = jnp.min(cand, axis=1)                       # [BQ] packed
+            am = jnp.argmin(cand, axis=1).astype(jnp.int32)
+            cand = jnp.where(pos == am[:, None], imax, cand)
+            empty = m >= _SENTINEL
+            d2 = jax.lax.bitcast_convert_type(
+                jnp.bitwise_and(m, ~mask), jnp.float32)
+            if metric == "euclidean":
+                dist = jnp.sqrt(jnp.maximum(d2, 0.0) / max(n_attrs, 1))
+            else:
+                dist = d2 / max(n_attrs, 1)
+            s = jnp.where(empty, 0.0, _kernel_score(dist, kernel_fn,
+                                                    kernel_param))
+            lab = jnp.bitwise_and(m, mask)
+            for c in range(n_classes):
+                cols[c] = cols[c] + jnp.where(lab == c, s, 0.0)
+        scores_ref[...] = jnp.stack(cols, axis=1)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("k", "n_classes", "n_attrs", "kernel_fn",
+                     "kernel_param", "block_q", "block_t", "metric",
+                     "n_valid", "interpret", "compute_dtype"),
+)
+def knn_classify_lanes(
+    q: jnp.ndarray,                 # [nq, D] f32, nq % block_q == 0
+    t: jnp.ndarray,                 # [nt, D] f32, nt % block_t == 0
+    t_labels: jnp.ndarray,          # [nt] int32 class codes
+    k: int = 8,
+    n_classes: int = 2,
+    n_attrs: Optional[int] = None,
+    kernel_fn: str = "none",
+    kernel_param: float = 1.0,
+    block_q: int = 512,
+    block_t: int = 4096,
+    metric: str = "euclidean",
+    n_valid: Optional[int] = None,
+    interpret: bool = False,
+    compute_dtype: str = "float32",
+) -> jnp.ndarray:
+    """Fully fused KNN classification: class scores [nq, n_classes] of the
+    kernel-weighted top-k vote (Neighborhood semantics, non-class-cond
+    modes), computed without the top-k results ever leaving the kernel.
+    `n_attrs` overrides the distance-normalization divisor when columns
+    are a one-hot expansion of fewer semantic attributes (mixed data)."""
+    nq, d = q.shape
+    nt = t.shape[0]
+    assert nq % block_q == 0, f"pad queries to a multiple of {block_q}"
+    assert nt % block_t == 0, f"pad train rows to a multiple of {block_t}"
+    assert block_t % (2 * _LANES) == 0, "pair fold needs block_t % 256 == 0"
+    assert k <= block_t
+    label_bits = max(1, (n_classes - 1).bit_length())
+    assert label_bits <= 6, f"{n_classes} classes need > 6 label bits"
+    nv = nt if n_valid is None else n_valid
+    na = d if n_attrs is None else n_attrs
+    if metric == "euclidean":
+        q = q * jnp.float32(-2.0)
+    n_tb = nt // block_t
+
+    kernel = functools.partial(
+        _knn_kernel_lanes_vote, k=k, metric=metric, block_t=block_t,
+        n_valid=nv, nt=nt, label_bits=label_bits, n_classes=n_classes,
+        n_attrs=na, kernel_fn=kernel_fn, kernel_param=float(kernel_param),
+        n_tb=n_tb, compute_dtype=jnp.dtype(compute_dtype).type)
+    grid = (nq // block_q, n_tb)
+    width = (k + _hi_depth(k)) * _LANES
+    _, scores = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((block_q, d), lambda i, j: (i, 0)),
+            pl.BlockSpec((block_t, d), lambda i, j: (j, 0)),
+            pl.BlockSpec((1, block_t), lambda i, j: (0, j)),
+        ],
+        out_specs=[
+            pl.BlockSpec((block_q, width), lambda i, j: (i, 0)),
+            pl.BlockSpec((block_q, n_classes), lambda i, j: (i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((nq, width), jnp.int32),
+            jax.ShapeDtypeStruct((nq, n_classes), jnp.float32),
+        ],
+        interpret=interpret,
+    )(q, t, t_labels.astype(jnp.int32)[None, :])
+    return scores
 
 
 @functools.partial(
     jax.jit,
     static_argnames=("k", "block_q", "block_t", "metric", "n_valid",
-                     "interpret", "compute_dtype", "packed"),
+                     "interpret", "compute_dtype", "packed", "n_attrs"),
 )
 def knn_topk_pallas(
     q: jnp.ndarray,                 # [nq, D] f32, nq % block_q == 0
@@ -415,6 +607,7 @@ def knn_topk_pallas(
     interpret: bool = False,
     compute_dtype: str = "float32",
     packed: bool = False,
+    n_attrs: Optional[int] = None,
 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """(dist [nq, k] ascending, index [nq, k]) of the k nearest train rows.
 
@@ -465,11 +658,12 @@ def knn_topk_pallas(
         ],
         interpret=interpret,
     )(q, t)
+    na = d if n_attrs is None else n_attrs
     if metric == "euclidean":
         # kernel carries squared sums; finish to attribute-averaged sqrt
-        best_d = jnp.sqrt(jnp.maximum(best_d, 0.0) / max(d, 1))
+        best_d = jnp.sqrt(jnp.maximum(best_d, 0.0) / max(na, 1))
     else:
-        best_d = best_d / max(d, 1)
+        best_d = best_d / max(na, 1)
     best_i = jnp.where(jnp.isinf(best_d), -1, best_i)
     return best_d, best_i
 

@@ -281,7 +281,29 @@ def bench_knn(dim: int):
     dt = _timed(classify_many, q, t, t_labels)
     qps = KNN_QUERIES * KNN_STEPS / dt
     flops = 2.0 * KNN_QUERIES * KNN_TRAIN * dim * KNN_STEPS / dt
-    return qps, flops
+
+    fused_qps = float("nan")
+    if use_pallas:
+        from avenir_tpu.ops.pallas_knn import knn_classify_lanes
+
+        @jax.jit
+        def classify_fused_many(q, t, t_labels):
+            def step(i):
+                scores = knn_classify_lanes(
+                    jnp.roll(q, i, axis=0), t, t_labels, k=KNN_K,
+                    n_classes=2, kernel_fn="gaussian", kernel_param=30.0,
+                    block_q=1024, block_t=4096, metric="euclidean",
+                    compute_dtype="bfloat16")
+                return jnp.sum(scores)
+            return jax.lax.map(step, jnp.arange(1, KNN_STEPS + 1)).sum()
+
+        try:
+            dtf = _timed(classify_fused_many, q, t, t_labels)
+            fused_qps = KNN_QUERIES * KNN_STEPS / dtf
+        except Exception as e:  # a fused-kernel failure must not sink the bench
+            print(f"# fused classify kernel unavailable: {e!r}",
+                  file=sys.stderr)
+    return qps, flops, fused_qps
 
 
 def bench_random_forest():
@@ -313,7 +335,13 @@ def bench_random_forest():
     levels = sum(
         max(len(p.predicates) for p in tree.paths) for tree in rf2.trees
     )
-    return RF_ROWS * levels / dt, levels
+    # model application: the batched device path evaluator vs host loop
+    rf2.predict(ds, device=True)  # warmup compiles the path kernel
+    t0 = time.perf_counter()
+    pred = rf2.predict(ds, device=True)
+    predict_rps = RF_ROWS / (time.perf_counter() - t0)
+    assert pred.shape == (RF_ROWS,)
+    return RF_ROWS * levels / dt, levels, predict_rps
 
 
 def bench_apriori():
@@ -431,11 +459,11 @@ def main():
     peak = PEAK_FLOPS.get(dev.device_kind, DEFAULT_PEAK)
     train_rps, predict_rps, nb_rps = bench_naive_bayes()
     stream_rps, stream_csv_rps, parse_rps, rss_mb = bench_nb_stream()
-    rf_rls, rf_levels = bench_random_forest()
+    rf_rls, rf_levels, rf_predict_rps = bench_random_forest()
     ap_txs, ap_rounds, ap_found = bench_apriori()
     bandit_gds = bench_bandit()
-    knn_qps, knn_flops = bench_knn(8)
-    knn_qps_hi, knn_flops_hi = bench_knn(128)
+    knn_qps, knn_flops, knn_fused_qps = bench_knn(8)
+    knn_qps_hi, knn_flops_hi, knn_fused_qps_hi = bench_knn(128)
     on_tpu = dev.platform == "tpu"
     ceiling = bench_knn_matmul_ceiling(128) if on_tpu else float("nan")
     combined = 2.0 / (1.0 / nb_rps + 1.0 / knn_qps)
@@ -475,6 +503,7 @@ def main():
         "vs_baseline_all5_geomean": round(vs_baseline_all5, 2),
         "rf_row_levels_per_sec": round(rf_rls, 1),
         "rf_levels": rf_levels,
+        "rf_predict_rows_per_sec": round(rf_predict_rps, 1),
         "rf_speedup": round(rf_speedup, 2),
         "apriori_tx_scans_per_sec": round(ap_txs, 1),
         "apriori_rounds": ap_rounds,
@@ -508,7 +537,14 @@ def main():
                           "measured reference numbers; the reference "
                           "publishes none (BASELINE.md)"),
         "knn_d8_qps": round(knn_qps, 1),
+        "knn_d8_fused_classify_qps": round(knn_fused_qps, 1),
         "knn_d128_qps": round(knn_qps_hi, 1),
+        "knn_d128_fused_classify_qps": round(knn_fused_qps_hi, 1),
+        "fused_note": ("fused = in-kernel label-packed vote "
+                       "(knn_classify_lanes): class scores leave the "
+                       "kernel instead of (k + hi) * 128 packed key "
+                       "lanes, attacking the measured output-rate "
+                       "ceiling; composed qps = top-k kernel + XLA vote"),
         "knn_d128_tflops": round(knn_flops_hi / 1e12, 2),
         "knn_d128_mfu": round(mfu_d128, 4),
         "knn_d128_shape_ceiling_tflops": round(ceiling / 1e12, 2),

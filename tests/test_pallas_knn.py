@@ -187,3 +187,95 @@ def test_packed_kernel_rejects_oversize_block():
     with pytest.raises(AssertionError, match="packed"):
         knn_topk_pallas(jnp.asarray(q), jnp.asarray(t), k=2, block_q=128,
                         block_t=8192, interpret=True, packed=True)
+
+
+@pytest.mark.parametrize("kernel_fn,metric", [
+    ("none", "euclidean"), ("gaussian", "euclidean"),
+    ("linearAdditive", "manhattan"), ("linearMultiplicative", "euclidean"),
+])
+def test_fused_classify_matches_composed_vote(kernel_fn, metric):
+    """knn_classify_lanes (in-kernel vote, label-packed keys) must produce
+    the composed top-k + _vote class scores: same kernel formulas, same
+    padding semantics; distance quantization is 2^-21ish so scores match
+    to the floor-boundary tolerance."""
+    from avenir_tpu.models.knn import _vote
+    from avenir_tpu.ops.pallas_knn import knn_classify_lanes
+
+    rng = np.random.default_rng(9)
+    nq, d, k, C = 128, 6, 5, 3
+    q = rng.normal(size=(nq, d)).astype(np.float32)
+    t = rng.normal(size=(700, d)).astype(np.float32)
+    labels = rng.integers(0, C, 700).astype(np.int32)
+    t_pad, _, n_valid = pad_train(t, None, 256)
+    lab_pad = np.zeros(t_pad.shape[0], np.int32)
+    lab_pad[:700] = labels
+
+    scores = np.asarray(knn_classify_lanes(
+        jnp.asarray(q), jnp.asarray(t_pad), jnp.asarray(lab_pad), k=k,
+        n_classes=C, kernel_fn=kernel_fn, kernel_param=30.0, block_q=128,
+        block_t=256, metric=metric, n_valid=n_valid, interpret=True))
+
+    dist, idx = knn_topk_lanes(
+        jnp.asarray(q), jnp.asarray(t_pad), k=k, block_q=128, block_t=256,
+        metric=metric, n_valid=n_valid, interpret=True)
+    ref = np.asarray(_vote(dist, jnp.asarray(lab_pad)[jnp.maximum(idx, 0)],
+                           jnp.ones_like(dist), kernel_fn, 30.0, C,
+                           False, False))
+    # the two paths quantize distances differently (label bits vs chunk-id
+    # bits); floor(d*100) can differ by one step on boundary-sitting
+    # distances, moving one neighbor's score between classes
+    assert np.abs(scores - ref).max() <= 2.0 or np.allclose(scores, ref)
+    agree = (scores.argmax(1) == ref.argmax(1)).mean()
+    assert agree >= 0.99, f"fused vs composed argmax agreement {agree}"
+
+
+def test_fused_classify_unfilled_slots_and_small_corpus():
+    from avenir_tpu.ops.pallas_knn import knn_classify_lanes
+
+    rng = np.random.default_rng(10)
+    q = rng.normal(size=(128, 4)).astype(np.float32)
+    t = rng.normal(size=(3, 4)).astype(np.float32)
+    labels = np.array([0, 1, 1], np.int32)
+    t_pad, _, n_valid = pad_train(t, None, 256)
+    lab_pad = np.zeros(256, np.int32)
+    lab_pad[:3] = labels
+    scores = np.asarray(knn_classify_lanes(
+        jnp.asarray(q), jnp.asarray(t_pad), jnp.asarray(lab_pad), k=5,
+        n_classes=2, kernel_fn="none", block_q=128, block_t=256,
+        n_valid=n_valid, interpret=True))
+    # only 3 real neighbors exist: every query's total vote mass is 3
+    np.testing.assert_allclose(scores.sum(axis=1), 3.0)
+    np.testing.assert_allclose(scores[:, 0], 1.0)
+
+
+def test_mixed_expansion_matches_jnp_mixed_distance():
+    """One-hot-expanded mixed data through the numeric kernel must equal
+    ops.distance's mixed pairwise semantics (the route churn-shaped data
+    takes on TPU now)."""
+    from avenir_tpu.models.knn import _expand_mixed
+    from avenir_tpu.ops.distance import blocked_topk_neighbors
+
+    rng = np.random.default_rng(11)
+    n, dn, dc = 300, 3, 2
+    bins = (4, 3)
+    x_num = rng.normal(size=(n, dn)).astype(np.float32) * 5
+    ranges = np.array([10.0, 10.0, 10.0], np.float32)
+    x_cat = np.stack([rng.integers(0, b, n) for b in bins], 1).astype(np.int32)
+    q_num, q_cat = x_num[:64], x_cat[:64]
+
+    for metric in ("euclidean", "manhattan"):
+        ref_d, ref_i = blocked_topk_neighbors(
+            jnp.asarray(q_num), jnp.asarray(x_num), jnp.asarray(q_cat),
+            jnp.asarray(x_cat), cat_bins=bins,
+            num_ranges=jnp.asarray(ranges), k=4, block=100, metric=metric)
+
+        xe, n_attrs = _expand_mixed(x_num, ranges, x_cat, bins, metric)
+        qe, _ = _expand_mixed(q_num, ranges, q_cat, bins, metric)
+        assert n_attrs == dn + dc
+        t_pad, _, n_valid = pad_train(xe, None, 256)
+        got_d, got_i = knn_topk_lanes(
+            jnp.asarray(np.ascontiguousarray(qe[:64])), jnp.asarray(t_pad),
+            k=4, block_q=64, block_t=256, metric=metric, n_valid=n_valid,
+            n_attrs=n_attrs, interpret=True)
+        np.testing.assert_allclose(np.asarray(got_d), np.asarray(ref_d),
+                                   rtol=3e-3, atol=1e-4)

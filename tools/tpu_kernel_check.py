@@ -110,6 +110,62 @@ def main():
             results.append(
                 check(f"lanes-bf16/{label}", db, ib, q, t, k, metric, 2e-2))
 
+    # fused in-kernel vote vs composed top-k + _vote, compiled
+    from avenir_tpu.models.knn import _vote
+    from avenir_tpu.ops.pallas_knn import knn_classify_lanes
+
+    for kernel_fn, metric in (("none", "euclidean"), ("gaussian", "euclidean"),
+                              ("linearAdditive", "manhattan")):
+        nq, d, k, C = 256, 8, 5, 3
+        q = rng.normal(size=(nq, d)).astype(np.float32)
+        t = rng.normal(size=(3000, d)).astype(np.float32)
+        labels = rng.integers(0, C, 3000).astype(np.int32)
+        t_pad, _, n_valid = pad_train(t, None, 512)
+        lab_pad = np.zeros(t_pad.shape[0], np.int32)
+        lab_pad[:3000] = labels
+        scores = np.asarray(knn_classify_lanes(
+            jnp.asarray(q), jnp.asarray(t_pad), jnp.asarray(lab_pad), k=k,
+            n_classes=C, kernel_fn=kernel_fn, kernel_param=30.0, block_q=256,
+            block_t=512, metric=metric, n_valid=n_valid))
+        dist, idx = knn_topk_lanes(jnp.asarray(q), jnp.asarray(t_pad), k=k,
+                                   block_q=256, block_t=512, metric=metric,
+                                   n_valid=n_valid)
+        ref = np.asarray(_vote(dist, jnp.asarray(lab_pad)[jnp.maximum(idx, 0)],
+                               jnp.ones_like(dist), kernel_fn, 30.0, C,
+                               False, False))
+        agree = float((scores.argmax(1) == ref.argmax(1)).mean())
+        ok = agree >= 0.99 and np.abs(scores - ref).max() <= 2.0
+        print(f"{'PASS' if ok else 'FAIL'} fused-vote/{kernel_fn}-{metric}"
+              + ("" if ok else f": agree={agree:.3f}"))
+        results.append(ok)
+
+    # mixed categorical data through the one-hot expansion, compiled
+    from avenir_tpu.models.knn import _expand_mixed
+    from avenir_tpu.ops.distance import blocked_topk_neighbors
+
+    bins = (4, 3)
+    x_num = rng.normal(size=(2000, 3)).astype(np.float32) * 5
+    ranges = np.full(3, 10.0, np.float32)
+    x_cat = np.stack([rng.integers(0, b, 2000) for b in bins], 1).astype(
+        np.int32)
+    q_num, q_cat = x_num[:256], x_cat[:256]
+    for metric in ("euclidean", "manhattan"):
+        ref_d, _ = blocked_topk_neighbors(
+            jnp.asarray(q_num), jnp.asarray(x_num), jnp.asarray(q_cat),
+            jnp.asarray(x_cat), cat_bins=bins, num_ranges=jnp.asarray(ranges),
+            k=4, block=2000, metric=metric)
+        xe, n_attrs = _expand_mixed(x_num, ranges, x_cat, bins, metric)
+        qe, _ = _expand_mixed(q_num, ranges, q_cat, bins, metric)
+        t_pad, _, n_valid = pad_train(xe, None, 512)
+        got_d, _ = knn_topk_lanes(
+            jnp.asarray(np.ascontiguousarray(qe)), jnp.asarray(t_pad), k=4,
+            block_q=256, block_t=512, metric=metric, n_valid=n_valid,
+            n_attrs=n_attrs)
+        ok = np.allclose(np.asarray(got_d), np.asarray(ref_d), rtol=3e-3,
+                         atol=1e-4)
+        print(f"{'PASS' if ok else 'FAIL'} mixed-onehot/{metric}")
+        results.append(ok)
+
     # same-lane collision stress for the lane kernel, compiled
     q = np.zeros((128, 4), np.float32)
     t = rng.normal(size=(2048, 4)).astype(np.float32) * 10
