@@ -41,7 +41,8 @@ class CsvBlockReader:
     extend in place — see dataset._discover_cardinality)."""
 
     def __init__(self, path: str, schema: FeatureSchema, delim: str = ",",
-                 block_bytes: int = DEFAULT_BLOCK_BYTES, engine: str = "auto"):
+                 block_bytes: int = DEFAULT_BLOCK_BYTES, engine: str = "auto",
+                 keep_raw: bool = False):
         if not os.path.exists(path):
             raise FileNotFoundError(f"no such CSV file: {path!r}")
         if block_bytes < 1:
@@ -51,6 +52,7 @@ class CsvBlockReader:
         self.delim = delim
         self.block_bytes = block_bytes
         self.engine = engine
+        self.keep_raw = keep_raw
 
     def __iter__(self) -> Iterator[Dataset]:
         carry = b""
@@ -71,14 +73,16 @@ class CsvBlockReader:
 
     def _parse(self, chunk: bytes) -> Dataset:
         return Dataset.from_csv(chunk, self.schema, delim=self.delim,
-                                engine=self.engine)
+                                engine=self.engine, keep_raw=self.keep_raw)
 
 
 def iter_csv_chunks(path: str, schema: FeatureSchema, delim: str = ",",
                     block_bytes: int = DEFAULT_BLOCK_BYTES,
-                    engine: str = "auto") -> Iterator[Dataset]:
+                    engine: str = "auto",
+                    keep_raw: bool = False) -> Iterator[Dataset]:
     """Yield Dataset chunks of `path`; a small file yields one chunk."""
-    return iter(CsvBlockReader(path, schema, delim, block_bytes, engine))
+    return iter(CsvBlockReader(path, schema, delim, block_bytes, engine,
+                               keep_raw))
 
 
 _DONE = object()
@@ -87,22 +91,55 @@ _DONE = object()
 def prefetched(items: Iterable[T], depth: int = 2) -> Iterator[T]:
     """Run `items` in a background daemon thread, keeping up to `depth`
     results queued ahead of the consumer. Exceptions re-raise at the
-    consumer's next pull; order is preserved."""
+    consumer's next pull; order is preserved. Abandoning the generator
+    (consumer exception / close) cancels the worker, so its thread and any
+    file handle inside `items` don't outlive the consumer."""
     q: "queue.Queue" = queue.Queue(maxsize=max(depth, 1))
+    cancel = threading.Event()
+
+    def _put(item) -> bool:
+        while not cancel.is_set():
+            try:
+                q.put(item, timeout=0.1)
+                return True
+            except queue.Full:
+                continue
+        return False
 
     def worker() -> None:
+        it = iter(items)
         try:
-            for item in items:
-                q.put(item)
-            q.put(_DONE)
+            for item in it:
+                if not _put(item):
+                    break
+            else:
+                _put(_DONE)
         except BaseException as exc:  # re-raised on the consumer side
-            q.put(exc)
+            _put(exc)
+        finally:
+            close = getattr(it, "close", None)
+            if close is not None:
+                close()
 
     threading.Thread(target=worker, daemon=True).start()
-    while True:
-        item = q.get()
-        if item is _DONE:
-            return
-        if isinstance(item, BaseException):
-            raise item
-        yield item
+    try:
+        while True:
+            item = q.get()
+            if item is _DONE:
+                return
+            if isinstance(item, BaseException):
+                raise item
+            yield item
+    finally:
+        cancel.set()
+
+
+def stream_job_inputs(cfg, inputs: Iterable[str], schema: FeatureSchema,
+                      keep_raw: bool = False) -> Iterator[Dataset]:
+    """Per-job streaming input helper: prefetched block chunks of every
+    input path, sized by the `stream.block.size.mb` config key (default
+    64). The one way runner jobs consume CSV inputs at unbounded size."""
+    block = int(cfg.get_float("stream.block.size.mb", 64.0) * (1 << 20))
+    for path in inputs:
+        yield from prefetched(iter_csv_chunks(
+            path, schema, cfg.field_delim_regex, block, keep_raw=keep_raw))

@@ -182,7 +182,7 @@ def bayesian_distribution(cfg: JobConfig, inputs: List[str], output: str) -> Job
                          {"Distribution Data:Records": len(texts)},
                          [out], tmodel)
 
-    from avenir_tpu.core.stream import iter_csv_chunks, prefetched
+    from avenir_tpu.core.stream import stream_job_inputs
     from avenir_tpu.models.naive_bayes import NaiveBayesModel
 
     schema = _schema(cfg)
@@ -191,25 +191,22 @@ def bayesian_distribution(cfg: JobConfig, inputs: List[str], output: str) -> Job
     # the mapper's one-line-at-a-time contract at block granularity
     # (BayesianDistribution.java:137); counts are additive so chunking
     # cannot change the model
-    block = int(cfg.get_float("stream.block.size.mb", 64.0) * (1 << 20))
     rows = 0
-    for path in inputs:
-        for ds in prefetched(iter_csv_chunks(
-                path, schema, cfg.field_delim_regex, block)):
-            if model is None:
-                # after the first parse, so data-discovered categorical
-                # vocabularies are sized into the count tensors
-                model = NaiveBayesModel.empty(schema)
-            codes, bins = ds.feature_codes(model.binned_fields)
-            if bins != model.bins:
-                raise ValueError(
-                    "categorical vocabulary grew mid-stream (a chunk saw a "
-                    "value absent from the first chunk / declared "
-                    "cardinality); declare full cardinalities in the schema "
-                    "to stream")
-            x_cont = ds.feature_matrix(model.cont_fields)
-            model.accumulate(codes, ds.labels(), x_cont, defer=True)
-            rows += len(ds)
+    for ds in stream_job_inputs(cfg, inputs, schema):
+        if model is None:
+            # after the first parse, so data-discovered categorical
+            # vocabularies are sized into the count tensors
+            model = NaiveBayesModel.empty(schema)
+        codes, bins = ds.feature_codes(model.binned_fields)
+        if bins != model.bins:
+            raise ValueError(
+                "categorical vocabulary grew mid-stream (a chunk saw a "
+                "value absent from the first chunk / declared "
+                "cardinality); declare full cardinalities in the schema "
+                "to stream")
+        x_cont = ds.feature_matrix(model.cont_fields)
+        model.accumulate(codes, ds.labels(), x_cont, defer=True)
+        rows += len(ds)
     if model is None:
         model = NaiveBayesModel.empty(schema)
     model.flush()
@@ -250,10 +247,11 @@ def bayesian_predictor(cfg: JobConfig, inputs: List[str], output: str) -> JobRes
     cls_vals = schema.class_values()
     actual: List[np.ndarray] = []
     predicted: List[np.ndarray] = []
+    # map-only job: test rows stream in blocks (host RSS O(block))
+    from avenir_tpu.core.stream import stream_job_inputs
+
     with open(out, "w") as fh:
-        for path in inputs:
-            ds = Dataset.from_csv(path, schema, delim=cfg.field_delim_regex,
-                                  keep_raw=True)
+        for ds in stream_job_inputs(cfg, inputs, schema, keep_raw=True):
             if prob_only:
                 probs = pred.feature_prob(ds)
                 for rid, p in zip(ds.ids(), probs):
@@ -288,7 +286,7 @@ def nearest_neighbor(cfg: JobConfig, inputs: List[str], output: str) -> JobResul
     `class.condtion.weighted` spelling, NearestNeighbor.java:92)."""
     from avenir_tpu.models.knn import NearestNeighborClassifier
 
-    from avenir_tpu.core.stream import iter_csv_chunks, prefetched
+    from avenir_tpu.core.stream import stream_job_inputs
 
     train_path, test_path = inputs[0], inputs[-1]
     schema = _schema(cfg)
@@ -327,11 +325,10 @@ def nearest_neighbor(cfg: JobConfig, inputs: List[str], output: str) -> JobResul
         clf.positive_class = pos_i
     # queries stream in blocks against the resident train index — test-set
     # size never bounds host RSS (the model is the index, not the queries)
-    block = int(cfg.get_float("stream.block.size.mb", 64.0) * (1 << 20))
     actual: List[np.ndarray] = []
     predicted: List[np.ndarray] = []
     with open(out, "w") as fh:
-        for test in prefetched(iter_csv_chunks(test_path, schema, delim, block)):
+        for test in stream_job_inputs(cfg, [test_path], schema):
             codes, scores = clf.predict(test)
             if arbitrator is not None:
                 # getClassProb int-percent scale (Neighborhood.java:319-334)
@@ -1273,13 +1270,25 @@ class Pipeline:
     stages over one shared properties file; stage outputs feed later stage
     inputs by path (e.g. the knn.sh 5-stage flow, SURVEY §3.3). Run all
     stages or a single named one — the same way the shell scripts were
-    invoked per-stage by hand."""
+    invoked per-stage by hand.
 
-    def __init__(self, conf, stages: Sequence[Stage]):
+    Failure handling (SURVEY §5): the reference delegates retry to Hadoop
+    (`mapreduce.map.maxattempts=2`, knn.properties:5-6) and relies on jobs
+    being re-runnable because all state is files. The same two properties
+    hold here: a failed stage re-runs up to `mapreduce.map.maxattempts`
+    times (every job rewrites its outputs from its inputs, so a retry is
+    exactly a Hadoop task re-attempt), and `on_retry` is the observability
+    hook (attempt log / fault-injection point in tests)."""
+
+    def __init__(self, conf, stages: Sequence[Stage], on_retry=None):
         self.props = (load_properties(conf) if isinstance(conf, str)
                       else dict(conf))
         self.stages = list(stages)
         self.results: Dict[str, JobResult] = {}
+        self.max_attempts = max(
+            int(self.props.get("mapreduce.map.maxattempts", "2")), 1)
+        self.on_retry = on_retry
+        self.attempts: Dict[str, int] = {}
 
     def run(self, only: Optional[str] = None) -> Dict[str, JobResult]:
         for st in self.stages:
@@ -1287,8 +1296,17 @@ class Pipeline:
                 continue
             props = dict(self.props)
             props.update(st.conf_overrides)
-            self.results[st.name] = run_job(st.job, props, st.inputs,
-                                            st.output)
+            for attempt in range(1, self.max_attempts + 1):
+                self.attempts[st.name] = attempt
+                try:
+                    self.results[st.name] = run_job(st.job, props, st.inputs,
+                                                    st.output)
+                    break
+                except Exception as exc:
+                    if attempt >= self.max_attempts:
+                        raise
+                    if self.on_retry is not None:
+                        self.on_retry(st.name, attempt, exc)
         return self.results
 
 

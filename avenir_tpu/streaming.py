@@ -118,13 +118,20 @@ class LearnerStream:
     def __init__(self, learner_type: str, action_ids: Sequence[str],
                  config: Dict,
                  reward_reader: Optional[RewardReader] = None,
-                 action_writer: Optional[ActionWriter] = None):
+                 action_writer: Optional[ActionWriter] = None,
+                 max_replays: int = 3):
         self.learner = create_learner(learner_type, action_ids, config)
         self.reward_reader = reward_reader or QueueRewardReader()
         self.action_writer = action_writer or QueueActionWriter()
         self.events: "queue.Queue[Optional[Tuple[str, int]]]" = queue.Queue()
         self.thread: Optional[threading.Thread] = None
         self.processed = 0
+        # Storm ack/replay analog (chombo GenericSpout pendingMsgHolder,
+        # RedisSpout.java:39): an event whose processing raises is replayed
+        # up to max_replays times, then dropped onto the failed list
+        self.max_replays = max_replays
+        self.replays: Dict[str, int] = {}
+        self.failed: List[Tuple[str, str]] = []   # (event_id, error)
 
     # ------------------------------------------------------ bolt semantics
     def process_event(self, event_id: str, round_num: int = 0) -> List[Action]:
@@ -147,8 +154,25 @@ class LearnerStream:
             while True:
                 item = self.events.get()
                 if item is None:
-                    return
-                self.process_event(*item)
+                    # a replayed tuple may have been re-enqueued behind the
+                    # stop sentinel; keep draining until the queue is quiet
+                    if self.events.empty():
+                        return
+                    self.events.put(None)
+                    continue
+                try:
+                    self.process_event(*item)
+                    self.replays.pop(item[0], None)    # acked
+                except Exception as exc:
+                    n = self.replays.get(item[0], 0) + 1
+                    self.replays[item[0]] = n
+                    if n <= self.max_replays:
+                        self.events.put(item)          # Storm tuple replay
+                    else:
+                        # clear the counter: a future submission of the same
+                        # event id starts with a fresh replay budget
+                        self.replays.pop(item[0], None)
+                        self.failed.append((item[0], repr(exc)))
 
         self.thread = threading.Thread(target=loop, daemon=True)
         self.thread.start()

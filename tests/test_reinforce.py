@@ -286,6 +286,44 @@ class TestLearnerStream:
         stream.stop()
         assert stream.processed == 50
 
+    def test_failed_event_replays_then_drops(self):
+        """Storm ack/replay analog (RedisSpout pendingMsgHolder): a tuple
+        whose processing raises is replayed up to max_replays, a
+        persistently failing one lands on the failed list, and the loop
+        keeps serving subsequent events."""
+        import time
+
+        stream = LearnerStream("randomGreedy", ACTIONS, BASE_CONFIG,
+                               max_replays=2)
+        calls = {"n": 0}
+        orig = stream.learner.next_actions
+
+        def flaky():
+            calls["n"] += 1
+            if calls["n"] == 1:
+                raise RuntimeError("transient")
+            return orig()
+
+        stream.learner.next_actions = flaky
+        stream.start()
+        stream.submit_event("e1", 1)          # fails once, replays, succeeds
+        msg = stream.action_writer.pop(timeout=5)
+        assert msg is not None and msg.startswith("e1,")
+        assert not stream.failed
+
+        stream.learner.next_actions = lambda: (_ for _ in ()).throw(
+            RuntimeError("permanent"))
+        stream.submit_event("dead", 2)
+        deadline = time.time() + 5
+        while not stream.failed and time.time() < deadline:
+            time.sleep(0.01)
+        assert stream.failed and stream.failed[0][0] == "dead"
+        stream.learner.next_actions = orig
+        stream.submit_event("e2", 3)          # loop still alive after drop
+        msg = stream.action_writer.pop(timeout=5)
+        assert msg is not None and msg.startswith("e2,")
+        stream.stop()
+
     def test_reward_tuples_processed_directly(self):
         stream = LearnerStream("upperConfidenceBoundOne", ACTIONS, BASE_CONFIG)
         stream.process_reward("b", 70)

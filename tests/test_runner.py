@@ -419,3 +419,44 @@ def test_cli_surface(churn_files, tmp_path, capsys):
     assert os.path.exists(out)
     printed = json.loads(capsys.readouterr().out)
     assert printed["job"] == "bayesianDistr"
+
+
+def test_pipeline_retries_failed_stage(tmp_path, churn_files):
+    """The reference's failure story is Hadoop task retry
+    (mapreduce.map.maxattempts=2, knn.properties:5-6) + file-state
+    re-runnability; Pipeline honors the same key with a fault-injection
+    hook. A stage failing transiently succeeds on re-attempt; a stage
+    failing persistently raises after maxattempts."""
+    from avenir_tpu.runner import job
+
+    calls = {"n": 0}
+
+    @job("_flakyTestJob", "flk")
+    def _flaky(cfg, inputs, output):
+        calls["n"] += 1
+        if calls["n"] < 2:
+            raise RuntimeError("transient fault")
+        from avenir_tpu.runner import JobResult
+        with open(output, "w") as fh:
+            fh.write("ok\n")
+        return JobResult("_flakyTestJob", {"Attempts": calls["n"]}, [output])
+
+    retries = []
+    p = Pipeline(
+        {"mapreduce.map.maxattempts": "3"},
+        [Stage("flaky", "_flakyTestJob", [], str(tmp_path / "out.txt"))],
+        on_retry=lambda name, attempt, exc: retries.append((name, attempt)),
+    )
+    res = p.run()
+    assert res["flaky"].counters["Attempts"] == 2
+    assert retries == [("flaky", 1)]
+    assert p.attempts["flaky"] == 2
+    assert open(tmp_path / "out.txt").read() == "ok\n"
+
+    calls["n"] = -10  # always fails within the attempt budget
+    p2 = Pipeline({"mapreduce.map.maxattempts": "2"},
+                  [Stage("flaky", "_flakyTestJob", [],
+                         str(tmp_path / "out2.txt"))])
+    with pytest.raises(RuntimeError, match="transient fault"):
+        p2.run()
+    assert p2.attempts["flaky"] == 2

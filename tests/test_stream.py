@@ -110,3 +110,23 @@ def test_bayesian_distr_job_streams_block_size_invariant(churn_csv, tmp_path):
         assert res.counters["Distribution Data:Records"] == 3000
         outs.append(open(out).read())
     assert outs[0] == outs[1]
+
+
+def test_prefetched_abandonment_cancels_worker(churn_csv):
+    """Abandoning the consumer (exception mid-stream) must cancel the
+    worker thread and close the underlying file — the leak path a job
+    retry would otherwise multiply."""
+    import threading
+
+    before = threading.active_count()
+    schema = churn_schema()
+    for _ in range(8):
+        it = prefetched(iter_csv_chunks(churn_csv["csv"], schema,
+                                        block_bytes=512), depth=1)
+        next(it)       # start the worker, then abandon mid-stream
+        it.close()
+    deadline = __import__("time").time() + 5
+    while threading.active_count() > before and \
+            __import__("time").time() < deadline:
+        __import__("time").sleep(0.05)
+    assert threading.active_count() <= before + 1
