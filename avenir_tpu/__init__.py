@@ -21,14 +21,20 @@ CSV distribution models).
 __version__ = "0.1.0"
 
 from avenir_tpu.core.schema import FeatureSchema, FeatureField
-from avenir_tpu.core.config import JobConfig, load_properties
+from avenir_tpu.core.config import JobConfig, load_hocon, load_properties
 from avenir_tpu.core.dataset import Dataset
+from avenir_tpu.core.stream import (CsvBlockReader, iter_csv_chunks,
+                                    prefetched)
 
 __all__ = [
     "FeatureSchema",
     "FeatureField",
     "JobConfig",
     "load_properties",
+    "load_hocon",
     "Dataset",
+    "CsvBlockReader",
+    "iter_csv_chunks",
+    "prefetched",
     "__version__",
 ]
