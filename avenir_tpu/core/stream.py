@@ -22,7 +22,7 @@ from __future__ import annotations
 import os
 import queue
 import threading
-from typing import Iterable, Iterator, TypeVar
+from typing import Iterable, Iterator, Optional, Tuple, TypeVar
 
 from avenir_tpu.core.dataset import Dataset
 from avenir_tpu.core.schema import FeatureSchema
@@ -42,34 +42,82 @@ class CsvBlockReader:
 
     def __init__(self, path: str, schema: FeatureSchema, delim: str = ",",
                  block_bytes: int = DEFAULT_BLOCK_BYTES, engine: str = "auto",
-                 keep_raw: bool = False):
+                 keep_raw: bool = False,
+                 byte_range: Optional[Tuple[int, int]] = None):
+        """byte_range=(start, end) restricts the reader to one INPUT SPLIT
+        of the file with the Hadoop LineRecordReader boundary contract
+        (the multi-host ingest analog of an HDFS split): a split starting
+        mid-line skips forward past its first newline (the previous split
+        owns that line), and a split owns every line that STARTS before
+        `end` — reading past `end` to finish the boundary line. Covering
+        [0, size) with disjoint ranges therefore yields every line exactly
+        once."""
         if not os.path.exists(path):
             raise FileNotFoundError(f"no such CSV file: {path!r}")
         if block_bytes < 1:
             raise ValueError(f"block_bytes must be positive, got {block_bytes}")
+        if byte_range is not None:
+            s, e = byte_range
+            if s < 0 or e < s:
+                raise ValueError(f"invalid byte_range {byte_range}")
         self.path = path
         self.schema = schema
         self.delim = delim
         self.block_bytes = block_bytes
         self.engine = engine
         self.keep_raw = keep_raw
+        self.byte_range = byte_range
 
     def __iter__(self) -> Iterator[Dataset]:
-        carry = b""
+        size = os.path.getsize(self.path)
+        start, end = self.byte_range if self.byte_range else (0, size)
+        end = min(end, size)
         with open(self.path, "rb") as fh:
-            while True:
+            if start > 0:
+                # skip the partial boundary line (it belongs to the prior
+                # split) UNLESS start falls exactly on a line start — the
+                # byte before it tells which (LineRecordReader seeks to
+                # start-1 and always discards one line for the same effect)
+                fh.seek(start - 1)
+                if fh.read(1) != b"\n":
+                    fh.readline()
+            pos = fh.tell()
+            carry = b""
+            while pos < end:
                 block = fh.read(self.block_bytes)
                 if not block:
                     break
-                block = carry + block
-                cut = block.rfind(b"\n")
-                if cut < 0:  # no line boundary yet: keep reading
-                    carry = block
+                pos += len(block)
+                data = carry + block
+                if pos >= end:
+                    # index of byte `end` within data; we own every line
+                    # starting before it, so finish the line containing
+                    # end-1 (reading further if its newline isn't buffered)
+                    b = len(data) - (pos - end)
+                    if b > 0 and data[b - 1:b] == b"\n":
+                        cut = b
+                    else:
+                        nl = data.find(b"\n", b)
+                        while nl < 0:
+                            extra = fh.read(self.block_bytes)
+                            if not extra:
+                                break
+                            off = len(data)
+                            data += extra
+                            nl = data.find(b"\n", off)
+                        cut = (nl + 1) if nl >= 0 else len(data)
+                    if data[:cut].strip():
+                        yield self._parse(data[:cut])
+                    carry = b""
+                    break
+                cut = data.rfind(b"\n")
+                if cut < 0:        # no line boundary yet: keep reading
+                    carry = data
                     continue
-                carry = block[cut + 1:]
-                yield self._parse(block[: cut + 1])
-        if carry.strip():
-            yield self._parse(carry)
+                carry = data[cut + 1:]
+                yield self._parse(data[: cut + 1])
+            if carry.strip():
+                yield self._parse(carry)
 
     def _parse(self, chunk: bytes) -> Dataset:
         return Dataset.from_csv(chunk, self.schema, delim=self.delim,

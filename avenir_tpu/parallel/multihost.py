@@ -57,6 +57,16 @@ def host_shard_bounds(n_rows_global: int) -> tuple:
     return lo, min(lo + per, n_rows_global)
 
 
+def host_csv_byte_range(path: str) -> tuple:
+    """This host's input split of ONE big CSV file: a contiguous byte
+    range to hand to CsvBlockReader(byte_range=...), which applies the
+    Hadoop LineRecordReader boundary contract so the per-host splits
+    partition the lines exactly. With host_shard_bounds this covers both
+    ingest layouts the reference's HDFS splits served: one file per host,
+    or one huge file split by offset."""
+    return host_shard_bounds(os.path.getsize(path))
+
+
 def global_rows(mesh: Mesh, local_rows: np.ndarray) -> jax.Array:
     """Assemble a globally row-sharded array from this host's local rows
     (each host passes only its own shard; shapes must agree across hosts

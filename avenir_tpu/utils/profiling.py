@@ -141,3 +141,22 @@ class RunningStats:
     @property
     def std(self) -> float:
         return math.sqrt(self.variance)
+
+
+def enable_persistent_compilation_cache(
+        cache_dir: str = "/tmp/jax_comp_cache",
+        min_compile_secs: float = 1.0) -> bool:
+    """Persistent XLA compilation cache, best-effort: cold compiles through
+    a remote-chip tunnel cost tens of seconds per shape, and the bench /
+    kernel-check programs are shape-stable across runs. Shared by every
+    entry point so the cache location changes in one place. Returns
+    whether the config was accepted (custom platforms may decline)."""
+    import jax
+
+    try:
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                          min_compile_secs)
+        return True
+    except Exception:
+        return False

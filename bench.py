@@ -454,7 +454,9 @@ def bench_knn_matmul_ceiling(dim: int):
 
 def main():
     import jax
+    from avenir_tpu.utils.profiling import enable_persistent_compilation_cache
 
+    enable_persistent_compilation_cache()
     dev = jax.devices()[0]
     peak = PEAK_FLOPS.get(dev.device_kind, DEFAULT_PEAK)
     train_rps, predict_rps, nb_rps = bench_naive_bayes()

@@ -130,3 +130,47 @@ def test_prefetched_abandonment_cancels_worker(churn_csv):
             __import__("time").time() < deadline:
         __import__("time").sleep(0.05)
     assert threading.active_count() <= before + 1
+
+
+class TestByteRangeSplits:
+    """Input-split semantics (Hadoop LineRecordReader contract): disjoint
+    byte ranges covering the file partition the LINES exactly — boundary
+    lines belong to the split they start in."""
+
+    def test_disjoint_ranges_partition_rows(self, churn_csv):
+        schema = churn_schema()
+        whole = Dataset.from_csv(churn_csv["csv"], schema)
+        size = os.path.getsize(churn_csv["csv"])
+        for n_splits in (2, 3, 7):
+            per = (size + n_splits - 1) // n_splits
+            got_ids = []
+            for s in range(n_splits):
+                rng = (min(s * per, size), min((s + 1) * per, size))
+                for chunk in CsvBlockReader(churn_csv["csv"], schema,
+                                            block_bytes=777, byte_range=rng):
+                    got_ids.extend(chunk.ids().tolist())
+            assert len(got_ids) == len(whole), n_splits
+            assert got_ids == whole.ids().tolist(), n_splits
+
+    def test_boundary_exactly_on_newline(self, churn_csv):
+        schema = churn_schema()
+        whole = Dataset.from_csv(churn_csv["csv"], schema)
+        first_nl = open(churn_csv["csv"], "rb").read().find(b"\n")
+        a = sum(len(c) for c in CsvBlockReader(
+            churn_csv["csv"], schema, byte_range=(0, first_nl + 1)))
+        b = sum(len(c) for c in CsvBlockReader(
+            churn_csv["csv"], schema,
+            byte_range=(first_nl + 1, os.path.getsize(churn_csv["csv"]))))
+        assert a == 1 and a + b == len(whole)
+
+    def test_split_inside_one_line_is_empty(self, churn_csv):
+        schema = churn_schema()
+        # a range strictly inside the first line owns no line starts
+        chunks = list(CsvBlockReader(churn_csv["csv"], schema,
+                                     byte_range=(2, 5)))
+        assert chunks == []
+
+    def test_bad_range_rejected(self, churn_csv):
+        with pytest.raises(ValueError):
+            CsvBlockReader(churn_csv["csv"], churn_schema(),
+                           byte_range=(10, 5))

@@ -67,6 +67,10 @@ def main():
     from avenir_tpu.ops.distance import pad_train
     from avenir_tpu.ops.pallas_knn import knn_topk_lanes, knn_topk_pallas
 
+    from avenir_tpu.utils.profiling import enable_persistent_compilation_cache
+
+    enable_persistent_compilation_cache()
+
     if jax.default_backend() != "tpu":
         print(json.dumps({"metric": "tpu_kernel_check", "skipped": True,
                           "reason": "no TPU backend"}))
