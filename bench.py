@@ -497,7 +497,18 @@ def main():
         f"(parse {parse_rps:.3e} r/s) peak_rss={rss_mb:.0f}MB",
         file=sys.stderr,
     )
-    print(json.dumps({
+    def _json_safe(obj):
+        """NaN/inf (e.g. a skipped optional section) would emit invalid
+        JSON tokens; the driver parses this line, so null them."""
+        if isinstance(obj, dict):
+            return {k: _json_safe(v) for k, v in obj.items()}
+        if isinstance(obj, (list, tuple)):
+            return [_json_safe(v) for v in obj]
+        if isinstance(obj, float) and not np.isfinite(obj):
+            return None
+        return obj
+
+    print(json.dumps(_json_safe({
         "metric": "nb_knn_rows_per_sec_per_chip",
         "value": round(combined, 1),
         "unit": "rows/sec",
@@ -560,7 +571,7 @@ def main():
         "timing_note": ("scan-amortized, scalar-forced timing; NOT "
                         "comparable to BENCH_r01 (block_until_ready through "
                         "the axon tunnel returns early, inflating r01)"),
-    }))
+    })))
 
 
 if __name__ == "__main__":
