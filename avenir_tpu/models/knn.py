@@ -37,7 +37,6 @@ import jax
 import jax.numpy as jnp
 
 from avenir_tpu.core.dataset import Dataset, pad_rows
-from avenir_tpu.core.schema import FeatureSchema
 from avenir_tpu.models.naive_bayes import NaiveBayesModel
 from avenir_tpu.ops.distance import blocked_topk_neighbors, pad_train
 from avenir_tpu.utils.metrics import ConfusionMatrix

@@ -15,7 +15,6 @@ file format), and the same three convergence criteria apply.
 
 from __future__ import annotations
 
-from functools import partial
 from typing import List, Optional, Tuple
 
 import numpy as np

@@ -18,7 +18,6 @@ clustering) read back via `read_distance_file`.
 
 from __future__ import annotations
 
-import os
 from typing import Dict, Iterator, List, Optional, Sequence, Tuple
 
 import numpy as np

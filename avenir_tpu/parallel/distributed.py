@@ -10,8 +10,6 @@ k*P candidates per query instead of n_train, so the ICI traffic is tiny.
 
 from __future__ import annotations
 
-from typing import Optional, Tuple
-
 import jax
 import jax.numpy as jnp
 from jax import lax

@@ -15,7 +15,7 @@ with one process and `global_rows` is then just a device_put.
 from __future__ import annotations
 
 import os
-from typing import Optional, Sequence
+from typing import Optional
 
 import numpy as np
 import jax

@@ -9,7 +9,7 @@ on device in one vectorized pass and surfaced as a plain dict of counters.
 
 from __future__ import annotations
 
-from typing import Dict, List, Optional, Sequence
+from typing import Dict, Sequence
 
 import numpy as np
 
