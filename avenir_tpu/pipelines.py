@@ -49,8 +49,12 @@ def knn_pipeline(conf, train_csv: str, test_csv: str, work_dir: str,
         Stage("bayesianDistr", "bayesianDistr", [train_csv], model_path,
               dict(overrides)),
         Stage("featurePosterior", "bayesianPredictor", [train_csv],
-              os.path.join(work_dir, "pprob.txt"),
+              os.path.join(work_dir, "condProb.txt"),
               {**overrides, "bap.output.feature.prob.only": "true"}),
+        Stage("join", "featureCondProbJoiner",
+              [os.path.join(work_dir, "simi.txt"),
+               os.path.join(work_dir, "condProb.txt")],
+              os.path.join(work_dir, "join.txt"), dict(overrides)),
         Stage("nearestNeighbor", "nearestNeighbor", [train_csv, test_csv],
               os.path.join(work_dir, "knn_out.txt"), dict(overrides)),
     ])

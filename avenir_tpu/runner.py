@@ -421,6 +421,59 @@ def grouped_similarity_job(cfg: JobConfig, inputs: List[str], output: str) -> Jo
     return JobResult("groupedRecordSimilarity", {"Similarity:Pairs": n}, [out])
 
 
+@job("featureCondProbJoiner", "fcb", "org.avenir.knn.FeatureCondProbJoiner")
+def feature_cond_prob_joiner(cfg: JobConfig, inputs: List[str], output: str
+                             ) -> JobResult:
+    """Stage (4) of the 5-job KNN pipeline: join the pairwise-distance
+    file (recordSimilarity output, `id1,id2,dist` tail fields) with the
+    per-train-entity feature posterior file (bayesianPredictor
+    bap.output.feature.prob.only output, `id,prob` rows) on the train
+    entity. The fused nearestNeighbor job computes this weighting
+    in-process; this job keeps the stage individually addressable for
+    drop-in pipeline parity (FeatureCondProbJoiner.java:46; input split
+    detection by filename prefix, :97-98 — here via
+    fcb.feature.cond.prob.split.prefix, falling back to treating the LAST
+    input as the probability file). Output rows:
+    testId,trainId,distance,trainFeaturePostProb."""
+    # both inputs are sibling-job OUTPUTS: split with the output delim
+    # (field_delim_regex is the user-input delimiter and may differ)
+    delim = cfg.field_delim
+    prefix = cfg.get("feature.cond.prob.split.prefix", "condProb")
+    prob_files = [p for p in inputs
+                  if os.path.basename(p).startswith(prefix)]
+    dist_files = [p for p in inputs if p not in prob_files]
+    if not prob_files:
+        prob_files, dist_files = [inputs[-1]], inputs[:-1]
+    probs: Dict[str, str] = {}
+    for p in prob_files:
+        for ln in _read_lines(p):
+            toks = [t.strip() for t in ln.split(delim)]
+            probs[toks[0]] = toks[-1]
+    # the distance file's column order follows the sts job's own key
+    id_first = cfg.scoped("sts").get_bool("output.id.first", True)
+    out = _out_file(output)
+    od = cfg.field_delim
+    n = 0
+    with open(out, "w") as fh:
+        for p in dist_files:
+            for ln in _read_lines(p):
+                toks = [t.strip() for t in ln.split(delim)]
+                if id_first:
+                    id1, id2, dist = toks[-3], toks[-2], toks[-1]
+                else:
+                    dist, id1, id2 = toks[-3], toks[-2], toks[-1]
+                pr = probs.get(id2)
+                if pr is None and id1 in probs:
+                    # distance rows carry (test, train) in either slot
+                    id1, id2 = id2, id1
+                    pr = probs[id2]
+                if pr is None:
+                    continue
+                fh.write(od.join([id1, id2, dist, pr]) + "\n")
+                n += 1
+    return JobResult("featureCondProbJoiner", {"Join:Pairs": n}, [out])
+
+
 # ======================================================================= tree
 def _tree_builder(cfg: JobConfig, schema: FeatureSchema):
     from avenir_tpu.models.tree import DecisionTreeBuilder

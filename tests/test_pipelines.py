@@ -37,12 +37,19 @@ def test_knn_pipeline_all_stages(elearn_env, tmp_path):
                         schema_path=elearn_env["schema"])
     results = pipe.run()
     assert set(results) == {"similarity", "bayesianDistr", "featurePosterior",
-                            "nearestNeighbor"}
+                            "join", "nearestNeighbor"}
     assert results["similarity"].counters["Similarity:Pairs"] == 300 * 80
+    # every (test, train) distance pair joins a train feature posterior
+    assert results["join"].counters["Join:Pairs"] == 300 * 80
     assert results["nearestNeighbor"].counters["Validation:Accuracy"] > 60
     # all the tutorial's intermediate files exist
-    for f in ["simi.txt", "distr.csv", "pprob.txt", "knn_out.txt"]:
+    for f in ["simi.txt", "distr.csv", "condProb.txt", "join.txt",
+              "knn_out.txt"]:
         assert os.path.exists(os.path.join(work, f)), f
+    # joined rows: testId, trainId, distance, featurePostProb
+    toks = open(os.path.join(work, "join.txt")).readline().strip().split(",")
+    assert len(toks) == 4
+    float(toks[2]), float(toks[3])
 
 
 def test_decision_tree_pipeline(tmp_path):
