@@ -128,3 +128,39 @@ class TestRegression:
         q = elearn_test.feature_matrix()[:, 0]
         pred = reg.predict(elearn_test, query_input=q)
         np.testing.assert_allclose(pred, 3.0 * q + 1.0, rtol=1e-3, atol=1e-2)
+
+
+def test_classifier_fused_path_matches_composed(monkeypatch):
+    """NearestNeighborClassifier(fused=True) end to end on the interpret
+    kernels: the in-kernel vote must agree with the composed top-k +
+    _vote path on real mixed churn data (argmax agreement; scores within
+    the floor-boundary tolerance)."""
+    import functools
+
+    import avenir_tpu.ops.pallas_knn as pk
+    from avenir_tpu.models.knn import NearestNeighborClassifier
+
+    monkeypatch.setattr(pk, "pallas_available", lambda: True)
+    for name in ("knn_classify_lanes", "knn_topk_lanes", "knn_topk_pallas"):
+        monkeypatch.setattr(pk, name,
+                            functools.partial(getattr(pk, name),
+                                              interpret=True))
+
+    train = generate_churn(700, seed=31)
+    test = generate_churn(150, seed=32)
+    base = dict(top_match_count=5, kernel_function="gaussian",
+                kernel_param=30.0, metric="euclidean")
+    fused = NearestNeighborClassifier(train, fused=True, **base)
+    assert fused.index.use_pallas and fused.index.n_attrs == 5
+    composed = NearestNeighborClassifier(train, fused=False, **base)
+    pf, sf = fused.predict(test)
+    pc, sc = composed.predict(test)
+    agree = (pf == pc).mean()
+    assert agree >= 0.98, agree
+    # churn features are heavily quantized, so equal-distance neighbor sets
+    # are common and the two paths may legally pick different tied members
+    # (different labels): total vote mass must match exactly, and rows
+    # whose scores differ at all must be rare
+    np.testing.assert_allclose(sf.sum(axis=1), sc.sum(axis=1), atol=1e-3)
+    exact = (np.abs(sf - sc).max(axis=1) <= 2.0).mean()
+    assert exact >= 0.95, exact
