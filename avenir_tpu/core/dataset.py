@@ -39,11 +39,16 @@ class Dataset:
         columns: Dict[int, np.ndarray],
         n_rows: int,
         raw_rows: Optional[List[List[str]]] = None,
+        lazy: Optional[Dict[int, object]] = None,
     ):
         self.schema = schema
         self.columns = columns          # ordinal -> np array (codes / floats / object)
         self.n_rows = n_rows
         self.raw_rows = raw_rows        # kept when passthrough output is needed
+        # string/id columns parse lazily (thunks): most algorithms never
+        # touch ids, and materializing millions of python strings halves
+        # the native ingest rate (the 1B-row streaming path skips it)
+        self._lazy = dict(lazy) if lazy else {}
 
     # ------------------------------------------------------------------ load
     @classmethod
@@ -150,10 +155,12 @@ class Dataset:
                    if not f.is_numeric and not f.is_categorical]
         strings += [f.ordinal for f in undeclared]
         try:
-            n, columns = parse_csv_native(data, delim, numeric, categorical,
-                                          strings)
+            n, columns, lazy = parse_csv_native(data, delim, numeric,
+                                                categorical, strings,
+                                                lazy_strings=True)
             for fld in undeclared:
-                toks = columns[fld.ordinal]
+                # discovery needs the tokens now; materialize eagerly
+                toks = lazy.pop(fld.ordinal)()
                 _discover_cardinality(fld, toks.tolist())
                 index = fld.cardinality_index()
                 columns[fld.ordinal] = np.array(
@@ -171,7 +178,7 @@ class Dataset:
                             + f" not in declared cardinality of field "
                             f"{fld.name!r}") from None
             raise
-        return cls(schema, columns, n)
+        return cls(schema, columns, n, lazy=lazy)
 
     @classmethod
     def from_rows(
@@ -206,20 +213,22 @@ class Dataset:
 
     # ----------------------------------------------------------------- views
     def column(self, ordinal: int) -> np.ndarray:
+        if ordinal not in self.columns and ordinal in self._lazy:
+            self.columns[ordinal] = self._lazy.pop(ordinal)()
         return self.columns[ordinal]
 
     def ids(self) -> np.ndarray:
         idf = self.schema.id_field
         if idf is None:
             return np.array([str(i) for i in range(self.n_rows)], dtype=object)
-        return self.columns[idf.ordinal]
+        return self.column(idf.ordinal)
 
     def labels(self) -> np.ndarray:
         """Encoded class attribute codes, int32 [n]."""
         cf = self.schema.class_field
         if cf is None:
             raise ValueError("schema has no class attribute")
-        col = self.columns[cf.ordinal]
+        col = self.column(cf.ordinal)
         if col.dtype == object:  # class field declared as plain string
             index = cf.cardinality_index()
             return np.array([index[v] for v in col], dtype=np.int32)
@@ -243,7 +252,7 @@ class Dataset:
             nb = fld.num_bins()
             if nb <= 0:
                 continue
-            col = self.columns[fld.ordinal]
+            col = self.column(fld.ordinal)
             if fld.is_categorical:
                 cols.append(col.astype(np.int32))
             else:
@@ -266,7 +275,7 @@ class Dataset:
         """float32 [n, D] of numeric feature values (raw, unbinned)."""
         if fields is None:
             fields = [f for f in self.schema.feature_fields if f.is_numeric]
-        cols = [self.columns[f.ordinal].astype(np.float32) for f in fields]
+        cols = [self.column(f.ordinal).astype(np.float32) for f in fields]
         if not cols:
             return np.zeros((self.n_rows, 0), dtype=np.float32)
         return np.stack(cols, axis=1)
@@ -291,7 +300,7 @@ class Dataset:
         for i in range(self.n_rows):
             toks = [""] * width
             for fld in self.schema.fields:
-                col = self.columns[fld.ordinal]
+                col = self.column(fld.ordinal)
                 if fld.is_categorical:
                     tok = fld.decode_value(int(col[i]))
                 elif fld.is_numeric:
@@ -308,9 +317,16 @@ class Dataset:
 
     def take(self, idx: np.ndarray) -> "Dataset":
         """Row subset (numpy fancy index) — used by samplers and CV splits."""
+        # lazy columns stay lazy: compose the subset onto the thunk so a
+        # sampler over an id-bearing dataset still never materializes ids
+        # unless someone reads them
+        sub_idx = np.asarray(idx)
+        lazy = {o: (lambda o=o: self.column(o)[sub_idx])
+                for o in self._lazy}
         cols = {o: c[idx] for o, c in self.columns.items()}
         raw = [self.raw_rows[i] for i in idx] if self.raw_rows is not None else None
-        return Dataset(self.schema, cols, int(np.asarray(idx).shape[0]), raw)
+        return Dataset(self.schema, cols, int(sub_idx.shape[0]), raw,
+                       lazy=lazy)
 
     def __len__(self) -> int:
         return self.n_rows

@@ -14,7 +14,6 @@
 #include <cstdlib>
 #include <cstring>
 #include <string>
-#include <unordered_map>
 #include <vector>
 
 namespace {
@@ -25,9 +24,84 @@ inline void trim(const char*& b, const char*& e) {
     while (e > b && (e[-1] == ' ' || e[-1] == '\t' || e[-1] == '\r')) --e;
 }
 
+// Allocation-free categorical vocabulary: open-addressing over the value
+// list, probed with (ptr, len) so the hot loop never constructs a
+// std::string per token (the former unordered_map<string> lookup was the
+// parse-rate bottleneck together with strtof).
 struct Vocab {
-    std::unordered_map<std::string, int32_t> index;
+    std::vector<std::string> values;
+    std::vector<int32_t> slots;   // open addressing, -1 empty
+    size_t mask = 0;
+
+    static uint64_t hash(const char* b, size_t n) {
+        uint64_t h = 1469598103934665603ull;          // FNV-1a
+        for (size_t i = 0; i < n; ++i) {
+            h ^= static_cast<unsigned char>(b[i]);
+            h *= 1099511628211ull;
+        }
+        return h;
+    }
+
+    void build() {
+        size_t cap = 8;
+        while (cap < values.size() * 2) cap <<= 1;
+        slots.assign(cap, -1);
+        mask = cap - 1;
+        for (size_t v = 0; v < values.size(); ++v) {
+            size_t h = hash(values[v].data(), values[v].size()) & mask;
+            while (slots[h] >= 0) h = (h + 1) & mask;
+            slots[h] = static_cast<int32_t>(v);
+        }
+    }
+
+    int32_t find(const char* b, size_t n) const {
+        size_t h = hash(b, n) & mask;
+        while (slots[h] >= 0) {
+            const std::string& s = values[slots[h]];
+            if (s.size() == n && memcmp(s.data(), b, n) == 0) return slots[h];
+            h = (h + 1) & mask;
+        }
+        return -1;
+    }
 };
+
+const double kPow10[10] = {1e0, 1e1, 1e2, 1e3, 1e4, 1e5, 1e6, 1e7, 1e8, 1e9};
+
+// Fast path for plain [+-]digits[.digits] tokens (the overwhelming CSV
+// case); returns false for exponents/specials so the caller can fall back
+// to strtof.
+inline bool parse_float_fast(const char* b, const char* e, float* out) {
+    bool neg = false;
+    const char* p = b;
+    if (p < e && (*p == '-' || *p == '+')) { neg = *p == '-'; ++p; }
+    int64_t ip = 0;
+    int nd = 0;
+    while (p < e && *p >= '0' && *p <= '9') {
+        if (nd == 18) return false;   // before the multiply: no signed overflow
+        ip = ip * 10 + (*p - '0');
+        ++p;
+        ++nd;
+    }
+    if (nd == 0) return false;
+    double v;
+    if (p == e) {
+        v = static_cast<double>(ip);
+    } else {
+        if (*p != '.') return false;
+        ++p;
+        int64_t fp = 0;
+        int fd = 0;
+        while (p < e && *p >= '0' && *p <= '9') {
+            fp = fp * 10 + (*p - '0');
+            ++p;
+            if (++fd > 9) return false;
+        }
+        if (p != e) return false;
+        v = static_cast<double>(ip) + static_cast<double>(fp) / kPow10[fd];
+    }
+    *out = static_cast<float>(neg ? -v : v);
+    return true;
+}
 
 }  // namespace
 
@@ -83,10 +157,10 @@ int64_t csv_parse(const char* buf, int64_t len, char delim, int32_t max_ord,
         kind[cat_ords[c]] = 2;
         slot[cat_ords[c]] = c;
         for (int32_t v = 0; v < vocab_counts[c]; ++v) {
-            std::string s(vp);
-            vocabs[c].index.emplace(std::move(s), v);
+            vocabs[c].values.emplace_back(vp);
             vp += strlen(vp) + 1;
         }
+        vocabs[c].build();
     }
 
     const char* p = buf;
@@ -116,7 +190,8 @@ int64_t csv_parse(const char* buf, int64_t len, char delim, int32_t max_ord,
                         float v;
                         if (e == b) {
                             v = __builtin_nanf("");
-                        } else {
+                        } else if (!parse_float_fast(b, e, &v)) {
+                            // exponents/specials: fall back to strtof
                             char* endp = nullptr;
                             std::string tok(b, e - b);
                             v = strtof(tok.c_str(), &endp);
@@ -130,16 +205,14 @@ int64_t csv_parse(const char* buf, int64_t len, char delim, int32_t max_ord,
                         }
                         num_out[static_cast<int64_t>(slot[ord]) * n_rows + row] = v;
                     } else {
-                        std::string tok(b, e - b);
-                        auto& vc = vocabs[slot[ord]];
-                        auto it = vc.index.find(tok);
-                        if (it == vc.index.end()) {
+                        int32_t code = vocabs[slot[ord]].find(b, e - b);
+                        if (code < 0) {
                             *err_row = row;
                             *err_ord = ord;
                             return -1;
                         }
                         cat_out[static_cast<int64_t>(slot[ord]) * n_rows + row] =
-                            it->second;
+                            code;
                     }
                 }
                 ++ord;

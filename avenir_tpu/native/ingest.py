@@ -87,13 +87,17 @@ def parse_csv_native(
     numeric_ordinals: List[int],
     categorical: List[Tuple[int, List[str]]],   # (ordinal, cardinality)
     string_ordinals: List[int],
-) -> Tuple[int, Dict[int, np.ndarray]]:
-    """One native pass: (n_rows, {ordinal: column array}).
+    lazy_strings: bool = False,
+) -> Tuple[int, Dict[int, np.ndarray], Dict[int, object]]:
+    """One native pass: (n_rows, {ordinal: column array}, {ordinal: thunk}).
 
     Numeric columns come back float32 (missing -> NaN), categorical int32
     codes against the given cardinalities (unknown value raises ValueError,
     matching the Python parser's contract), string/id columns as numpy
-    object arrays."""
+    object arrays — or, with lazy_strings=True, as zero-arg thunks in the
+    third return value (materializing millions of python strings costs
+    more than the whole numeric/categorical parse; algorithms that never
+    read ids skip it entirely)."""
     lib = _get_lib()
     if lib is None:
         raise RuntimeError("native CSV ingest unavailable (no g++?)")
@@ -143,16 +147,32 @@ def parse_csv_native(
                 f"value '' not in declared cardinality of ordinal {o} "
                 f"(row {row} is short)")
         columns[o] = cat_out[i]
+    lazy: Dict[int, object] = {}
     for o in string_ordinals:
-        columns[o] = np.array(_extract_column(lib, data, d, o), dtype=object)
-    return got, columns
+        if lazy_strings:
+            # the native extraction runs now into a COMPACT per-column
+            # buffer (so the thunk does not pin the whole CSV block); only
+            # the python-string materialization — the expensive part — is
+            # deferred
+            raw = _extract_column_bytes(lib, data, d, o)
+            lazy[o] = (lambda r=raw: np.array(
+                r.decode().split("\n")[:-1], dtype=object))
+        else:
+            columns[o] = np.array(_extract_column(lib, data, d, o),
+                                  dtype=object)
+    return got, columns, lazy
 
 
-def _extract_column(lib, data: bytes, d: bytes, ordinal: int) -> List[str]:
+def _extract_column_bytes(lib, data: bytes, d: bytes, ordinal: int) -> bytes:
     cap = int(lib.csv_column_bytes(data, len(data), d, np.int32(ordinal)))
     buf = ctypes.create_string_buffer(max(cap, 1))
     w = int(lib.csv_extract_column(data, len(data), d, np.int32(ordinal),
                                    buf, np.int64(cap)))
-    if w <= 0:
+    return buf.raw[:w] if w > 0 else b""
+
+
+def _extract_column(lib, data: bytes, d: bytes, ordinal: int) -> List[str]:
+    raw = _extract_column_bytes(lib, data, d, ordinal)
+    if not raw:
         return []
-    return buf.raw[:w].decode().split("\n")[:-1]
+    return raw.decode().split("\n")[:-1]
