@@ -489,18 +489,28 @@ class DevicePathEvaluator:
             axis=1) if self.cat_fields else np.zeros((len(ds), 1), np.int32)
         return jnp.asarray(x_num), jnp.asarray(x_cat)
 
-    def per_tree_predict(self, ds: Dataset) -> np.ndarray:
+    def per_tree_predict(self, ds: Dataset,
+                         row_block: int = 262_144) -> np.ndarray:
         """[n, T] predicted class codes, first matching path in path order
-        (rows matching no valid path predict class 0, as the host loop)."""
+        (rows matching no valid path predict class 0, as the host loop).
+        Rows evaluate in `row_block` chunks: the kernel's broadcast
+        intermediates are O(rows x trees x paths x depth), so blocking
+        keeps device memory bounded at any corpus size."""
         x_num, x_cat = self._features(ds)
-        matches = _path_match_kernel(x_num, x_cat, *self.tables)
-        matches = matches & self.path_valid[None]
-        first = jnp.argmax(matches, axis=-1)                    # [n, T]
-        pred = jnp.take_along_axis(
-            jnp.broadcast_to(self.path_class[None], matches.shape),
-            first[..., None], axis=-1)[..., 0]
-        any_match = matches.any(axis=-1)
-        return np.asarray(jnp.where(any_match, pred, 0).astype(jnp.int32))
+        out = []
+        for s in range(0, len(ds), row_block):
+            matches = _path_match_kernel(x_num[s:s + row_block],
+                                         x_cat[s:s + row_block], *self.tables)
+            matches = matches & self.path_valid[None]
+            first = jnp.argmax(matches, axis=-1)                # [b, T]
+            pred = jnp.take_along_axis(
+                jnp.broadcast_to(self.path_class[None], matches.shape),
+                first[..., None], axis=-1)[..., 0]
+            any_match = matches.any(axis=-1)
+            out.append(np.asarray(
+                jnp.where(any_match, pred, 0).astype(jnp.int32)))
+        return np.concatenate(out) if out else np.zeros((0, self.n_trees),
+                                                        np.int32)
 
     def predict(self, ds: Dataset) -> np.ndarray:
         """[n] class codes: single tree pass-through, or majority vote
