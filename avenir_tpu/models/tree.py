@@ -487,7 +487,9 @@ class DevicePathEvaluator:
         x_cat = np.stack(
             [ds.column(f.ordinal).astype(np.int32) for f in self.cat_fields],
             axis=1) if self.cat_fields else np.zeros((len(ds), 1), np.int32)
-        return jnp.asarray(x_num), jnp.asarray(x_cat)
+        # host arrays: per_tree_predict transfers one row block at a time,
+        # so device memory stays bounded at any corpus size
+        return x_num, x_cat
 
     def per_tree_predict(self, ds: Dataset,
                          row_block: int = 262_144) -> np.ndarray:
@@ -499,8 +501,9 @@ class DevicePathEvaluator:
         x_num, x_cat = self._features(ds)
         out = []
         for s in range(0, len(ds), row_block):
-            matches = _path_match_kernel(x_num[s:s + row_block],
-                                         x_cat[s:s + row_block], *self.tables)
+            matches = _path_match_kernel(jnp.asarray(x_num[s:s + row_block]),
+                                         jnp.asarray(x_cat[s:s + row_block]),
+                                         *self.tables)
             matches = matches & self.path_valid[None]
             first = jnp.argmax(matches, axis=-1)                # [b, T]
             pred = jnp.take_along_axis(

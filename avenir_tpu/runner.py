@@ -23,6 +23,7 @@ Markov matrix files, LR coefficient history.
 from __future__ import annotations
 
 import json
+import math
 import os
 from dataclasses import dataclass, field
 from typing import Callable, Dict, List, Optional, Sequence, Tuple
@@ -609,6 +610,59 @@ def ctmc_stats_job(cfg: JobConfig, inputs: List[str], output: str) -> JobResult:
     return JobResult("contTimeStateTransitionStats", {}, [out], stats)
 
 
+@job("stateTransitionRate", "str",
+     "org.avenir.spark.markov.StateTransitionRate")
+def state_transition_rate_job(cfg: JobConfig, inputs: List[str],
+                              output: str) -> JobResult:
+    """Per-entity CTMC transition-rate matrices from timestamped state
+    rows (StateTransitionRate.scala:30): group by str.key.field.ordinals,
+    sort by the epoch-time field, rate(i->j) = count(i->j) / dwell(i)
+    with dwell scaled to str.rate.time.unit (hour/day/week) and diagonal
+    set to -sum(off-diagonal row) as the Scala job does. Input timestamps
+    are ms, sec, or s-since-epoch per str.input.time.unit."""
+    from avenir_tpu.models.markov import StateTransitionRate
+
+    key_ords = cfg.get_int_list("key.field.ordinals", [0])
+    time_ord = cfg.assert_int("time.field.ordinal")
+    state_ord = cfg.assert_int("state.field.ordinal")
+    states = cfg.assert_list("state.values")
+    in_unit = cfg.get("input.time.unit", "ms")
+    try:
+        to_ms = {"ms": 1.0, "sec": 1000.0, "s": 1000.0}[in_unit]
+    except KeyError:
+        raise ValueError(f"invalid input time unit {in_unit!r}")
+    rate_unit = cfg.get("rate.time.unit", "hour")
+    try:
+        unit_ms = {"hour": 3.6e6, "day": 8.64e7, "week": 6.048e8}[rate_unit]
+    except KeyError:
+        raise ValueError(f"invalid rate time unit {rate_unit!r}")
+    prec = cfg.get_int("trans.rate.output.precision", 6)
+
+    by_key: Dict[str, List[Tuple[float, str]]] = {}
+    for p in inputs:
+        for ln in _read_lines(p):
+            toks = [t.strip() for t in ln.split(cfg.field_delim_regex)]
+            key = cfg.field_delim.join(toks[o] for o in key_ords)
+            by_key.setdefault(key, []).append(
+                (float(toks[time_ord]) * to_ms, toks[state_ord]))
+    out = _out_file(output)
+    delim = cfg.field_delim
+    models: Dict[str, StateTransitionRate] = {}
+    with open(out, "w") as fh:
+        for key, events in sorted(by_key.items()):
+            events.sort(key=lambda e: e[0])
+            seq = [(s, t / unit_ms) for t, s in events]
+            model = StateTransitionRate(states).fit([seq])
+            models[key] = model
+            q = model.rates()
+            q = q - np.diag(q.sum(axis=1))
+            for i, s in enumerate(states):
+                row = delim.join(f"{v:.{prec}f}" for v in q[i])
+                fh.write(f"{key}{delim}{s}{delim}{row}\n")
+    return JobResult("stateTransitionRate",
+                     {"Basic:Entities": len(by_key)}, [out], models)
+
+
 # ==================================================================== explore
 @job("mutualInformation", "mut", "org.avenir.explore.MutualInformation")
 def mutual_information_job(cfg: JobConfig, inputs: List[str], output: str) -> JobResult:
@@ -969,6 +1023,49 @@ def event_time_job(cfg: JobConfig, inputs: List[str], output: str) -> JobResult:
                      {"Basic:Entities": len(by_id)}, [out], hist)
 
 
+@job("sequenceGenerator", "seg",
+     "org.avenir.spark.sequence.SequenceGenerator")
+def sequence_generator_job(cfg: JobConfig, inputs: List[str],
+                           output: str) -> JobResult:
+    """Sequence formation from event rows (SequenceGenerator.scala:31):
+    group rows by seg.id.field.ordinals, project seg.val.field.ordinals,
+    sort each group's value records by seg.seq.field (an index INTO the
+    projected value record, matching the Scala withSortFields contract),
+    emit one line per entity: key fields then the sorted value records
+    flattened."""
+    key_ords = cfg.get_int_list("id.field.ordinals", [0])
+    val_ords = cfg.assert_list("val.field.ordinals")
+    val_ords = [int(v) for v in val_ords]
+    seq_field = cfg.assert_int("seq.field")
+
+    def sort_key(rec: List[str]) -> Tuple[float, str]:
+        v = rec[seq_field]
+        try:
+            f = float(v)
+            # NaN sort keys would silently scramble the group order
+            if math.isnan(f):
+                return (float("inf"), v)
+            return (f, "")
+        except ValueError:
+            return (float("inf"), v)
+
+    by_key: Dict[str, List[List[str]]] = {}
+    for p in inputs:
+        for ln in _read_lines(p):
+            toks = [t.strip() for t in ln.split(cfg.field_delim_regex)]
+            key = cfg.field_delim.join(toks[o] for o in key_ords)
+            by_key.setdefault(key, []).append([toks[o] for o in val_ords])
+    out = _out_file(output)
+    delim = cfg.field_delim
+    with open(out, "w") as fh:
+        for key, recs in sorted(by_key.items()):
+            recs.sort(key=sort_key)
+            flat = delim.join(tok for rec in recs for tok in rec)
+            fh.write(f"{key}{delim}{flat}\n")
+    return JobResult("sequenceGenerator",
+                     {"Basic:Entities": len(by_key)}, [out], by_key)
+
+
 # ================================================================ association
 @job("frequentItemsApriori", "fia",
      "org.avenir.association.FrequentItemsApriori", "apriori")
@@ -1021,6 +1118,43 @@ def rule_miner_job(cfg: JobConfig, inputs: List[str], output: str) -> JobResult:
                      f"{delim}{r.confidence:.6f}{delim}{r.support:.6f}\n")
     return JobResult("associationRuleMiner", {"Rules:Count": len(rules)},
                      [out], rules)
+
+
+@job("infrequentItemMarker", "iim",
+     "org.avenir.association.InfrequentItemMarker")
+def infrequent_item_marker_job(cfg: JobConfig, inputs: List[str],
+                               output: str) -> JobResult:
+    """Map-only pass replacing items not in the frequent-1-itemset file
+    with a marker token (InfrequentItemMarker.java:41-46, run after the
+    k=1 Apriori round to shrink later scans). Reads iim.item.set.file.path
+    (must hold length-1 itemsets), iim.infreq.item.marker (default '*'),
+    iim.skip.field.count (default 1)."""
+    from avenir_tpu.models.association import InfrequentItemMarker, ItemSetList
+
+    length = cfg.get_int("item.set.length", 1)
+    if length != 1:
+        raise ValueError("expecting item set of length 1")
+    isl = ItemSetList.load(
+        cfg.assert_get("item.set.file.path"), length,
+        with_trans_ids=cfg.get_bool("contains.trans.id", True),
+        delim=cfg.get("itemset.delim", ","))
+    marker = InfrequentItemMarker(
+        frequent_items=(s.items[0] for s in isl.item_sets),
+        marker=cfg.get("infreq.item.marker", "*"),
+        skip_field_count=cfg.get_int("skip.field.count", 1))
+    out = _out_file(output)
+    delim = cfg.field_delim
+    n = marked = 0
+    with open(out, "w") as fh:
+        for path in inputs:
+            for ln in _read_lines(path):
+                row = [t.strip() for t in ln.split(cfg.field_delim_regex)]
+                marked_row = marker.mark_row(row)
+                marked += sum(a != b for a, b in zip(row, marked_row))
+                n += 1
+                fh.write(delim.join(marked_row) + "\n")
+    return JobResult("infrequentItemMarker",
+                     {"Basic:Records": n, "Marker:Replaced": marked}, [out])
 
 
 # ===================================================================== markov

@@ -460,3 +460,90 @@ def test_pipeline_retries_failed_stage(tmp_path, churn_files):
     with pytest.raises(RuntimeError, match="transient fault"):
         p2.run()
     assert p2.attempts["flaky"] == 2
+
+
+def test_state_transition_rate_job(tmp_path):
+    """Per-entity CTMC rates (StateTransitionRate.scala:30): entity e1
+    spends 2h in A before each A->B, 1h in B before each B->A; with
+    rate.time.unit=hour that is rate(A->B)=0.5, rate(B->A)=1.0, and the
+    diagonal is the negated row sum."""
+    data = tmp_path / "events.csv"
+    rows = []
+    t = 0
+    for _ in range(3):                       # e1: A(2h) -> B(1h) -> ...
+        rows.append(f"e1,{t},A")
+        t += 2 * 3_600_000
+        rows.append(f"e1,{t},B")
+        t += 1 * 3_600_000
+    rows.append(f"e1,{t},A")                 # close the last B dwell
+    rows.append("e2,0,A")                    # e2: one A->B after 4h
+    rows.append(f"e2,{4 * 3_600_000},B")
+    data.write_text("\n".join(rows) + "\n")
+    out = str(tmp_path / "rates.csv")
+    res = run_job("stateTransitionRate", {
+        "str.time.field.ordinal": "1",
+        "str.state.field.ordinal": "2",
+        "str.state.values": "A,B",
+        "str.rate.time.unit": "hour",
+    }, [str(data)], out)
+    assert res.counters["Basic:Entities"] == 2
+    lines = {}
+    for ln in open(out):
+        ent, state, *vals = ln.strip().split(",")
+        lines[(ent, state)] = [float(v) for v in vals]
+    assert lines[("e1", "A")] == pytest.approx([-0.5, 0.5])
+    assert lines[("e1", "B")] == pytest.approx([1.0, -1.0])
+    assert lines[("e2", "A")] == pytest.approx([-0.25, 0.25])
+    assert lines[("e2", "B")] == pytest.approx([0.0, 0.0])
+    # HOCON-driven invocation (the Spark-surface config contract)
+    conf = tmp_path / "rates.conf"
+    conf.write_text(
+        'stateTransitionRate {\n'
+        '  time.field.ordinal = 1\n'
+        '  state.field.ordinal = 2\n'
+        '  state.values = ["A", "B"]\n'
+        '  rate.time.unit = "hour"\n'
+        '}\n')
+    res2 = run_job("stateTransitionRate", str(conf), [str(data)],
+                   str(tmp_path / "rates2.csv"))
+    assert res2.counters == res.counters
+    assert open(res2.outputs[0]).read() == open(out).read()
+
+
+def test_sequence_generator_job(tmp_path):
+    """Group by id, project value fields, sort by the seq field WITHIN the
+    projected record (SequenceGenerator.scala:31 withSortFields)."""
+    data = tmp_path / "events.csv"
+    data.write_text(
+        "u1,login,3\n"
+        "u2,buy,1\n"
+        "u1,browse,1\n"
+        "u1,cart,2\n")
+    out = str(tmp_path / "seqs.csv")
+    res = run_job("sequenceGenerator", {
+        "seg.id.field.ordinals": "0",
+        "seg.val.field.ordinals": "1,2",
+        "seg.seq.field": "1",        # index into (event, seq) projection
+    }, [str(data)], out)
+    assert res.counters["Basic:Entities"] == 2
+    lines = open(out).read().splitlines()
+    assert lines == ["u1,browse,1,cart,2,login,3", "u2,buy,1"]
+
+
+def test_infrequent_item_marker_job(tmp_path):
+    """Items absent from the frequent-1-itemset file become the marker;
+    the transaction-id field (skip.field.count) passes through
+    (InfrequentItemMarker.java:41-46)."""
+    freq = tmp_path / "itemsets-1.txt"
+    freq.write_text("milk,0.6\nbread,0.5\n")
+    data = tmp_path / "tx.csv"
+    data.write_text("t1,milk,caviar\n"
+                    "t2,bread,milk,truffle\n")
+    out = str(tmp_path / "marked.csv")
+    res = run_job("infrequentItemMarker", {
+        "iim.item.set.file.path": str(freq),
+        "iim.contains.trans.id": "false",
+    }, [str(data)], out)
+    assert res.counters["Marker:Replaced"] == 2
+    assert open(out).read().splitlines() == [
+        "t1,milk,*", "t2,bread,milk,*"]
