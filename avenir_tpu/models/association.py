@@ -178,6 +178,26 @@ def _contain_mask(trans: jnp.ndarray, cand: jnp.ndarray, k: int):
     return (trans @ cand.T) >= k                   # [B, C] bool
 
 
+@partial(jax.jit, static_argnames=("k", "block"), donate_argnums=())
+def _contain_counts_resident(trans: jnp.ndarray, cand: jnp.ndarray,
+                             k: int, block: int):
+    """One-call support count over a DEVICE-RESIDENT uint8 multi-hot
+    matrix (rows padded to a multiple of `block`): the per-tile loop runs
+    as a lax.scan inside the executable, so the whole per-k round costs
+    one dispatch instead of N/block host->device transfers — the
+    difference between tunnel-latency-bound and MXU-bound mining."""
+    n, v = trans.shape
+    tiles = trans.reshape(n // block, block, v)
+
+    def step(acc, tile):
+        overlap = tile.astype(jnp.float32) @ cand.T        # [B, C]
+        return acc + jnp.sum(overlap >= k, axis=0, dtype=jnp.int32), None
+
+    counts, _ = jax.lax.scan(
+        step, jnp.zeros((cand.shape[0],), jnp.int32), tiles)
+    return counts
+
+
 def _count_support(multihot: np.ndarray, cand_rows: np.ndarray, k: int,
                    block: int = 8192,
                    want_mask: bool = False):
@@ -252,15 +272,24 @@ class FrequentItemsApriori:
         out.append(self._pack(
             tx, freq_ids, 1, [int(col_counts[i]) for (i,) in freq_ids]))
 
+        # one upload, device-resident across all k rounds; zero-padded
+        # rows contain no candidate (overlap 0 < k), so they never count
+        pad_n = (-n) % self.block
+        trans_dev = jnp.asarray(np.pad(tx.multihot, ((0, pad_n), (0, 0))))
+
         for k in range(2, self.max_length + 1):
             cands = _generate_candidates(freq_ids, k)
             if not cands:
                 break
-            cand_rows = np.zeros((len(cands), tx.multihot.shape[1]),
-                                 dtype=np.uint8)
+            # pad the candidate axis to a bucket size so recurring rounds
+            # reuse the compiled executable; zero candidate rows count 0
+            c_pad = max(64, 1 << (len(cands) - 1).bit_length())
+            cand_rows = np.zeros((c_pad, tx.multihot.shape[1]),
+                                 dtype=np.float32)
             for ci, items in enumerate(cands):
-                cand_rows[ci, list(items)] = 1
-            counts, _ = _count_support(tx.multihot, cand_rows, k, self.block)
+                cand_rows[ci, list(items)] = 1.0
+            counts = np.asarray(_contain_counts_resident(
+                trans_dev, jnp.asarray(cand_rows), k, self.block))[:len(cands)]
             kept = [(c, int(cnt)) for c, cnt in zip(cands, counts)
                     if cnt > min_count]
             if not kept:

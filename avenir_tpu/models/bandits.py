@@ -80,6 +80,19 @@ class GroupBanditData:
                 mask[gi, ai] = True
         return cls(order, item_ids, counts, rewards, mask)
 
+    def to_device(self) -> "GroupBanditData":
+        """A copy whose stat arrays live on the device, making the
+        per-round `jnp.asarray` in every select() a no-op.
+
+        Uploading 3 x [G, A] arrays per round makes large-G selection
+        transfer-bound; resident state eliminates the reference's analog
+        cost (re-reading the reward-aggregate file in each round job).
+        Deliberately a copy, not a cache: in-place edits of host arrays
+        keep working on the original, with no staleness hazard."""
+        return GroupBanditData(
+            self.group_ids, self.item_ids, jnp.asarray(self.counts),
+            jnp.asarray(self.rewards), jnp.asarray(self.mask))
+
     def selections_to_rows(self, sel: np.ndarray,
                            output_decision_count: bool = False
                            ) -> List[List[str]]:
@@ -215,9 +228,10 @@ class GreedyRandomBandit:
     def select(self, data: GroupBanditData, round_num: int) -> np.ndarray:
         self.key, sub = jax.random.split(self.key)
         if self.algo in ("linear", "logLinear"):
+            rewards_d = jnp.asarray(data.rewards)
+            mask_d = jnp.asarray(data.mask)
             picks = _eps_greedy_kernel(
-                sub, jnp.asarray(data.rewards),
-                jnp.asarray(data.mask), float(round_num),
+                sub, rewards_d, mask_d, float(round_num),
                 self.prob, self.const, self.min_prob,
                 self.batch_size, self.algo == "logLinear", self.unique)
         elif self.algo == "auerGreedy":
@@ -229,29 +243,34 @@ class GreedyRandomBandit:
     def _auer_greedy(self, key, data: GroupBanditData, round_num: int):
         """AuerGreedy (GreedyRandomBandit.greedyAuerSelect): ε scaled by the
         relative gap d of the two best rewards, ε = c·k/(d²·t) capped at 1;
-        untried items are taken first."""
-        r = np.where(data.mask, data.rewards, -np.inf)
-        top2 = -np.sort(-r, axis=1)[:, :2]
-        best, second = top2[:, 0], (top2[:, 1] if r.shape[1] > 1 else top2[:, 0])
-        d = np.where(best > 0, (best - second) / np.maximum(best, 1e-9), 0.0)
-        kcnt = data.mask.sum(axis=1)
+        untried items are taken first. All math on device so to_device()
+        round state stays resident (no per-round host round trip)."""
+        counts_d = jnp.asarray(data.counts)
+        rewards_d = jnp.asarray(data.rewards)
+        mask_d = jnp.asarray(data.mask)
+        r = jnp.where(mask_d, rewards_d, -jnp.inf)
+        if r.shape[1] > 1:
+            top2 = jax.lax.top_k(r, 2)[0]
+            best, second = top2[:, 0], top2[:, 1]
+        else:
+            best = second = r[:, 0]
+        d = jnp.where(best > 0, (best - second) / jnp.maximum(best, 1e-9), 0.0)
+        kcnt = mask_d.sum(axis=1)
         t = max((round_num - 1) * self.batch_size, 1)
-        eps = jnp.asarray(np.where(
+        eps = jnp.where(
             d <= 0, 1.0,
-            np.minimum(self.auer_const * kcnt / (np.maximum(d, 1e-9) ** 2 * t), 1.0),
-        ).astype(np.float32))
+            jnp.minimum(
+                self.auer_const * kcnt / (jnp.maximum(d, 1e-9) ** 2 * t), 1.0),
+        ).astype(jnp.float32)
         k1, k2 = jax.random.split(key)
-        rnd = _random_explore_kernel(k1, jnp.asarray(data.mask),
-                                     self.batch_size)
+        rnd = _random_explore_kernel(k1, mask_d, self.batch_size)
         # untried items come first (greedyAuerSelect collects not-tried
         # before value-ranked picks), then by reward
-        greedy_score = jnp.where(jnp.asarray(data.counts) > 0,
-                                 jnp.asarray(data.rewards), jnp.inf)
-        greedy_score = jnp.where(jnp.asarray(data.mask), greedy_score, NEG)
-        greedy = _ranked_batch(greedy_score, jnp.asarray(data.mask),
-                               self.batch_size)
+        greedy_score = jnp.where(counts_d > 0, rewards_d, jnp.inf)
+        greedy_score = jnp.where(mask_d, greedy_score, NEG)
+        greedy = _ranked_batch(greedy_score, mask_d, self.batch_size)
         explore = jax.random.uniform(
-            k2, (len(data.group_ids), self.batch_size)) < eps[:, None]
+            k2, (mask_d.shape[0], self.batch_size)) < eps[:, None]
         return jnp.where(explore, rnd, greedy)
 
 
@@ -296,12 +315,12 @@ class RandomFirstGreedyBandit:
 
     def select(self, data: GroupBanditData, round_num: int) -> np.ndarray:
         self.key, sub = jax.random.split(self.key)
-        rnd = np.asarray(_random_explore_kernel(
-            sub, jnp.asarray(data.mask), self.batch_size))
-        greedy_score = jnp.where(jnp.asarray(data.mask),
-                                 jnp.asarray(data.rewards), NEG)
-        greedy = np.asarray(_ranked_batch(
-            greedy_score, jnp.asarray(data.mask), self.batch_size))
+        rewards_d = jnp.asarray(data.rewards)
+        mask_d = jnp.asarray(data.mask)
+        rnd = np.asarray(_random_explore_kernel(sub, mask_d, self.batch_size))
+        greedy_score = jnp.where(mask_d, rewards_d, NEG)
+        greedy = np.asarray(_ranked_batch(greedy_score, mask_d,
+                                          self.batch_size))
         expl = np.array([
             round_num <= self.exploration_rounds(len(items))
             for items in data.item_ids

@@ -259,6 +259,31 @@ def _advance_leaves(leaf_id, seg_matrix, best_split_of_leaf, child_offset):
     return jnp.where(split >= 0, off + seg, leaf_id)
 
 
+@partial(jax.jit, static_argnames=("n_leaves", "n_splits", "smax", "k"))
+def _level_histogram_forest(leaf_ids, seg_matrix, labels, weights,
+                            n_leaves: int, n_splits: int, smax: int, k: int):
+    """[T, L, NS, S, K]: every tree's level histogram in ONE dispatch.
+
+    The forest's trees differ only in leaf routing and bootstrap row
+    weights; the segment matrix and labels are shared, so vmapping over
+    (leaf_ids, weights) turns T histogram round-trips per level into one —
+    the per-level dispatch latency (the reference's one-MR-job-per-level
+    cost, detr.sh:34-54) stops multiplying by the tree count."""
+    return jax.vmap(
+        lambda lid, w: _level_histogram(lid, seg_matrix, labels, w,
+                                        n_leaves, n_splits, smax, k)
+    )(leaf_ids, weights)
+
+
+@jax.jit
+def _advance_leaves_forest(leaf_ids, seg_matrix, best_split_of_leaf,
+                           child_offset):
+    """Vmapped _advance_leaves over the tree axis ([T, n] leaf ids)."""
+    return jax.vmap(
+        lambda lid, b, c: _advance_leaves(lid, seg_matrix, b, c)
+    )(leaf_ids, best_split_of_leaf, child_offset)
+
+
 # ---------------------------------------------------------------------------
 # model: DecisionPathList-compatible
 # ---------------------------------------------------------------------------
@@ -592,17 +617,9 @@ class DecisionTreeBuilder:
 
         # host-side tree state: leaf -> (predicate chain, used attrs)
         leaves: List[Dict] = [{"preds": [], "used": set(), "stopped": False}]
-        done_paths: List[DecisionPath] = []
-
-        impurity_fn = (_np_bits_entropy if self.algo in ("entropy", "infoGain")
-                       else _np_gini)
 
         for depth in range(self.max_depth):
-            active = [
-                i for i, lf in enumerate(leaves)
-                if not lf["stopped"] and "split" not in lf
-            ]
-            if not active:
+            if not self._active_leaves(leaves):
                 break
             # pad the leaf axis to the next power of two: n_leaves is a
             # static (compile-time) dimension, and letting it take every
@@ -613,63 +630,8 @@ class DecisionTreeBuilder:
             counts = np.asarray(_level_histogram(
                 leaf_id, seg_d, labels_d, w, lpad, ns, self.smax, k
             ))[: len(leaves)]                                 # [L, NS, S, K]
-            seg_tot = counts.sum(axis=3)                      # [L, NS, S]
-            leaf_tot = seg_tot.sum(axis=2)                    # [L, NS] (same per split)
-
-            # weighted impurity per (leaf, split)
-            imp = impurity_fn(counts, axis=-1)                # [L,NS,S]
-            wimp = (seg_tot * imp).sum(axis=2) / np.maximum(leaf_tot, 1e-9)
-
-            # lpad-sized for the same compile-stability reason as counts
-            best_split_of_leaf = np.full(lpad, -1, np.int32)
-            child_offset = np.full(lpad, -1, np.int32)
-            new_leaves: List[Dict] = []
-
-            for li in active:
-                lf = leaves[li]
-                pop = float(leaf_tot[li].max())
-                # class counts of this leaf: any split column's segment-sum
-                cls_counts = counts[li, 0].sum(axis=0) if ns else np.zeros(k)
-                node_imp = float(impurity_fn(cls_counts))
-
-                allowed = self._allowed_splits(lf)
-                if pop <= 0 or not allowed or node_imp <= 0.0:
-                    # pure nodes cannot improve; splitting them only burns
-                    # device passes and bloats the path list
-                    lf["stopped"] = True
-                    continue
-                cand = wimp[li, allowed]
-                bi = int(allowed[int(np.argmin(cand))])
-                gain = node_imp - float(wimp[li, bi])
-
-                # stopping strategies (DecisionPathStoppingStrategy.java:57-70;
-                # maxDepth is enforced by the level-loop bound itself)
-                stop = False
-                if self.stopping == "minInfoGain" and self.min_info_gain >= 0:
-                    stop = gain < self.min_info_gain
-                elif self.stopping == "minPopulation" and self.min_population >= 0:
-                    stop = pop < self.min_population
-                if stop:
-                    lf["stopped"] = True
-                    continue
-
-                sp = self.splits[bi]
-                best_split_of_leaf[li] = bi
-                child_offset[li] = len(leaves) + len(new_leaves)
-                for s in range(self.smax):
-                    if s < sp.n_segments:
-                        new_leaves.append({
-                            "preds": lf["preds"] + [sp.predicates[s]],
-                            "used": lf["used"] | {sp.attribute},
-                            "stopped": False,
-                        })
-                    else:
-                        # pad children so child ids stay contiguous per leaf;
-                        # never emitted as paths (no rows can route here)
-                        new_leaves.append({"preds": lf["preds"], "used": lf["used"],
-                                           "stopped": True, "pad": True})
-                lf["split"] = bi           # parent becomes an internal node
-
+            best_split_of_leaf, child_offset, new_leaves = self._grow_level(
+                leaves, counts, lpad)
             if not new_leaves:
                 break
             # materialize finished leaves for paths that stopped this level
@@ -680,12 +642,91 @@ class DecisionTreeBuilder:
             # children get smax slots per split parent; re-index leaves
             leaves = leaves + new_leaves
 
-        # emit final paths: any leaf never split
-        model_paths: List[DecisionPath] = []
         counts_final = np.asarray(_level_histogram(
             leaf_id, seg_d, labels_d, w,
             1 << (len(leaves) - 1).bit_length(), max(ns, 1), self.smax, k
         ))[: len(leaves)] if ns else None
+        return self._emit_paths(leaves, counts_final)
+
+    @staticmethod
+    def _active_leaves(leaves: List[Dict]) -> List[int]:
+        return [i for i, lf in enumerate(leaves)
+                if not lf["stopped"] and "split" not in lf]
+
+    def _grow_level(self, leaves: List[Dict], counts: np.ndarray, lpad: int
+                    ) -> Tuple[np.ndarray, np.ndarray, List[Dict]]:
+        """Host-side split selection for one level, given the [L, NS, S, K]
+        class histogram of every (leaf, candidate split, segment). Returns
+        (best_split_of_leaf [lpad], child_offset [lpad], new_leaves);
+        mutates `leaves` entries (split chosen / stopped)."""
+        k = len(self.class_values)
+        ns = len(self.splits)
+        impurity_fn = (_np_bits_entropy if self.algo in ("entropy", "infoGain")
+                       else _np_gini)
+        seg_tot = counts.sum(axis=3)                      # [L, NS, S]
+        leaf_tot = seg_tot.sum(axis=2)                    # [L, NS] (same per split)
+
+        # weighted impurity per (leaf, split)
+        imp = impurity_fn(counts, axis=-1)                # [L,NS,S]
+        wimp = (seg_tot * imp).sum(axis=2) / np.maximum(leaf_tot, 1e-9)
+
+        # lpad-sized for the same compile-stability reason as counts
+        best_split_of_leaf = np.full(lpad, -1, np.int32)
+        child_offset = np.full(lpad, -1, np.int32)
+        new_leaves: List[Dict] = []
+
+        for li in self._active_leaves(leaves):
+            lf = leaves[li]
+            pop = float(leaf_tot[li].max())
+            # class counts of this leaf: any split column's segment-sum
+            cls_counts = counts[li, 0].sum(axis=0) if ns else np.zeros(k)
+            node_imp = float(impurity_fn(cls_counts))
+
+            allowed = self._allowed_splits(lf)
+            if pop <= 0 or not allowed or node_imp <= 0.0:
+                # pure nodes cannot improve; splitting them only burns
+                # device passes and bloats the path list
+                lf["stopped"] = True
+                continue
+            cand = wimp[li, allowed]
+            bi = int(allowed[int(np.argmin(cand))])
+            gain = node_imp - float(wimp[li, bi])
+
+            # stopping strategies (DecisionPathStoppingStrategy.java:57-70;
+            # maxDepth is enforced by the level-loop bound itself)
+            stop = False
+            if self.stopping == "minInfoGain" and self.min_info_gain >= 0:
+                stop = gain < self.min_info_gain
+            elif self.stopping == "minPopulation" and self.min_population >= 0:
+                stop = pop < self.min_population
+            if stop:
+                lf["stopped"] = True
+                continue
+
+            sp = self.splits[bi]
+            best_split_of_leaf[li] = bi
+            child_offset[li] = len(leaves) + len(new_leaves)
+            for s in range(self.smax):
+                if s < sp.n_segments:
+                    new_leaves.append({
+                        "preds": lf["preds"] + [sp.predicates[s]],
+                        "used": lf["used"] | {sp.attribute},
+                        "stopped": False,
+                    })
+                else:
+                    # pad children so child ids stay contiguous per leaf;
+                    # never emitted as paths (no rows can route here)
+                    new_leaves.append({"preds": lf["preds"], "used": lf["used"],
+                                       "stopped": True, "pad": True})
+            lf["split"] = bi           # parent becomes an internal node
+        return best_split_of_leaf, child_offset, new_leaves
+
+    def _emit_paths(self, leaves: List[Dict],
+                    counts_final: Optional[np.ndarray]) -> DecisionPathList:
+        """Final paths: any leaf never split, with class distribution from
+        the final level histogram."""
+        k = len(self.class_values)
+        model_paths: List[DecisionPath] = []
         for li, lf in enumerate(leaves):
             if "split" in lf or lf.get("pad"):
                 continue                   # internal node / padded child slot
@@ -763,22 +804,78 @@ class RandomForestBuilder:
         self._evaluator: Optional[DevicePathEvaluator] = None
 
     def fit(self, ds: Dataset) -> "RandomForestBuilder":
+        """All trees grow together, one batched device call per level:
+        trees share the (segment matrix, labels) upload and differ only in
+        bootstrap weights and leaf routing, so the whole forest costs
+        max_depth histogram+advance dispatches instead of
+        num_trees x (max_depth x 2 + 1) round trips."""
         n = len(ds)
         rng = np.random.default_rng(self.seed)
         self.trees = []
         self._evaluator = None
+        ws = np.empty((self.num_trees, n), np.float32)
         for t in range(self.num_trees):
             if self.sampling == "withReplace":
                 idx = rng.integers(0, n, n)
-                w = np.bincount(idx, minlength=n).astype(np.float32)
+                ws[t] = np.bincount(idx, minlength=n).astype(np.float32)
             elif self.sampling == "withoutReplace":
-                w = (rng.random(n) < self.sample_rate).astype(np.float32)
+                ws[t] = (rng.random(n) < self.sample_rate).astype(np.float32)
             else:
-                w = np.ones(n, np.float32)
-            builder = DecisionTreeBuilder(
-                self.schema, seed=self.seed + t, **self.tree_kwargs
-            )
-            self.trees.append(builder.fit(ds, row_weights=w))
+                ws[t] = 1.0
+        builders = [
+            DecisionTreeBuilder(self.schema, seed=self.seed + t,
+                                **self.tree_kwargs)
+            for t in range(self.num_trees)
+        ]
+        b0 = builders[0]
+        ns, k, smax = len(b0.splits), len(b0.class_values), b0.smax
+        seg = np.stack(
+            [sp.segment_of(np.asarray(ds.column(sp.attribute)))
+             for sp in b0.splits], axis=1,
+        ).astype(np.int8)
+        seg_d = jnp.asarray(seg)
+        labels_d = jnp.asarray(ds.labels())
+        ws_d = jnp.asarray(ws)
+        leaf_ids = jnp.zeros((self.num_trees, n), jnp.int32)
+        leaves_t: List[List[Dict]] = [
+            [{"preds": [], "used": set(), "stopped": False}]
+            for _ in range(self.num_trees)
+        ]
+
+        for depth in range(b0.max_depth):
+            if not any(DecisionTreeBuilder._active_leaves(lv)
+                       for lv in leaves_t):
+                break
+            lpad = 1 << (max(len(lv) for lv in leaves_t) - 1).bit_length()
+            counts_all = np.asarray(_level_histogram_forest(
+                leaf_ids, seg_d, labels_d, ws_d, lpad, ns, smax, k))
+            bests, offsets = [], []
+            any_new = False
+            for t, b in enumerate(builders):
+                best, child, new_l = b._grow_level(
+                    leaves_t[t], counts_all[t][: len(leaves_t[t])], lpad)
+                if new_l:
+                    any_new = True
+                    leaves_t[t] = leaves_t[t] + new_l
+                bests.append(best)
+                offsets.append(child)
+            if not any_new:
+                break
+            leaf_ids = _advance_leaves_forest(
+                leaf_ids, seg_d, jnp.asarray(np.stack(bests)),
+                jnp.asarray(np.stack(offsets)))
+
+        lpad = 1 << (max(len(lv) for lv in leaves_t) - 1).bit_length()
+        counts_fin = np.asarray(_level_histogram_forest(
+            leaf_ids, seg_d, labels_d, ws_d, lpad, max(ns, 1), smax, k
+        )) if ns else None
+        self.trees = [
+            b._emit_paths(
+                leaves_t[t],
+                counts_fin[t][: len(leaves_t[t])]
+                if counts_fin is not None else None)
+            for t, b in enumerate(builders)
+        ]
         return self
 
     def predict(self, ds: Dataset, device: bool = False) -> np.ndarray:

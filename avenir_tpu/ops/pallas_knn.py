@@ -27,6 +27,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
 
 _NEG_INF = float("-inf")
 _INF = float("inf")
@@ -460,24 +461,22 @@ def _knn_kernel_lanes_vote(q_ref, t_ref, lab_ref, keys_ref, scores_ref, *,
     else:
         tile = _tile_distance(q_ref[...], t_ref[...], metric, compute_dtype)
     bits = jax.lax.bitcast_convert_type(tile, jnp.int32)
-    labels = lab_ref[...]                                # [1, block_t] int32
-    base_chunk = tb * chunks
+    # full-tile label OR + validity mask: Mosaic rejects 128-lane chunk
+    # slices of the [1, block_t] labels block ("Invalid input layout"),
+    # but lowers the whole-tile broadcast fine — chunk AFTER packing,
+    # exactly like the topk kernel chunks its column-packed keys
+    key_full = jnp.bitwise_or(jnp.bitwise_and(bits, ~mask), lab_ref[...])
     if n_valid < nt:
-        lane = jax.lax.broadcasted_iota(jnp.int32, (1, _LANES), 1)
+        col = jax.lax.broadcasted_iota(jnp.int32, key_full.shape, 1)
+        key_full = jnp.where(tb * block_t + col < n_valid, key_full,
+                             _SENTINEL)
 
     carr_lo = [keys_ref[:, j * _LANES:(j + 1) * _LANES] for j in range(k)]
     carr_hi = [keys_ref[:, (k + j) * _LANES:(k + j + 1) * _LANES]
                for j in range(khi)]
 
     def packed_chunk(c):
-        x = jnp.bitwise_or(
-            jnp.bitwise_and(bits[:, c * _LANES:(c + 1) * _LANES], ~mask),
-            labels[:, c * _LANES:(c + 1) * _LANES],
-        )
-        if n_valid < nt:
-            col = (base_chunk + c) * _LANES + lane
-            x = jnp.where(col < n_valid, x, _SENTINEL)
-        return x
+        return key_full[:, c * _LANES:(c + 1) * _LANES]
 
     def insert(carries, x):
         depth = len(carries)
@@ -497,24 +496,38 @@ def _knn_kernel_lanes_vote(q_ref, t_ref, lab_ref, keys_ref, scores_ref, *,
 
     @pl.when(tb == n_tb - 1)
     def _vote_epilogue():
+        # k min-extraction rounds with NO argmin: Mosaic only lowers
+        # index-reductions for f32 and the packed keys are int32, so each
+        # round consumes ALL lanes equal to the row minimum at once and
+        # weights the vote by the duplicate count (clipped to the k-budget
+        # left). Identical semantics to one-at-a-time extraction —
+        # duplicate packed keys carry the same (distance, label) and so
+        # the same vote — and fewer reduction passes when ties exist.
         cand = keys_ref[...]
-        pos = jax.lax.broadcasted_iota(jnp.int32, cand.shape, 1)
         bq = cand.shape[0]
         cols = [jnp.zeros((bq,), jnp.float32) for _ in range(n_classes)]
         imax = jnp.int32(np.iinfo(np.int32).max)
+        remaining = jnp.full((bq,), k, jnp.int32)
         for _ in range(k):
             m = jnp.min(cand, axis=1)                       # [BQ] packed
-            am = jnp.argmin(cand, axis=1).astype(jnp.int32)
-            cand = jnp.where(pos == am[:, None], imax, cand)
+            eq = cand == m[:, None]
+            cnt = jnp.sum(eq.astype(jnp.int32), axis=1)
+            cand = jnp.where(eq, imax, cand)
             empty = m >= _SENTINEL
+            take = jnp.where(empty, 0, jnp.minimum(cnt, remaining))
+            remaining = remaining - take
             d2 = jax.lax.bitcast_convert_type(
                 jnp.bitwise_and(m, ~mask), jnp.float32)
             if metric == "euclidean":
                 dist = jnp.sqrt(jnp.maximum(d2, 0.0) / max(n_attrs, 1))
             else:
                 dist = d2 / max(n_attrs, 1)
-            s = jnp.where(empty, 0.0, _kernel_score(dist, kernel_fn,
-                                                    kernel_param))
+            # select, don't multiply: once every lane is consumed m is
+            # int32 max, whose label-masked bits BITCAST TO NaN — and
+            # NaN * 0 is NaN, which would poison the class columns
+            s = jnp.where(take > 0,
+                          _kernel_score(dist, kernel_fn, kernel_param)
+                          * take.astype(jnp.float32), 0.0)
             lab = jnp.bitwise_and(m, mask)
             for c in range(n_classes):
                 cols[c] = cols[c] + jnp.where(lab == c, s, 0.0)
@@ -585,6 +598,13 @@ def knn_classify_lanes(
             jax.ShapeDtypeStruct((nq, width), jnp.int32),
             jax.ShapeDtypeStruct((nq, n_classes), jnp.float32),
         ],
+        # the full-tile packed-key intermediate (block_q x block_t i32, on
+        # top of the f32 distance tile) overflows the 16M default scoped-
+        # vmem stack at the bench shapes (1024x4096) by ~2M; raise the cap
+        # modestly (a 96M cap sent the mosaic allocator into a search that
+        # did not terminate within 20 minutes)
+        compiler_params=None if interpret else pltpu.CompilerParams(
+            vmem_limit_bytes=24 * 1024 * 1024),
         interpret=interpret,
     )(q, t, t_labels.astype(jnp.int32)[None, :])
     return scores

@@ -391,7 +391,7 @@ def bench_bandit():
         counts=rng.integers(0, 50, (g, a)).astype(np.int32),
         rewards=rng.random((g, a)).astype(np.float32) * 100.0,
         mask=np.ones((g, a), bool),
-    )
+    ).to_device()   # resident round state: one upload, not 3 arrays/round
     bandit = GreedyRandomBandit(batch_size=3, random_selection_prob=0.5,
                                 prob_reduction_constant=2.0, seed=3)
     _ = bandit.select(data, 1)  # warmup compile

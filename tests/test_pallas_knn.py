@@ -248,6 +248,28 @@ def test_fused_classify_unfilled_slots_and_small_corpus():
     np.testing.assert_allclose(scores[:, 0], 1.0)
 
 
+def test_fused_classify_exhausted_rounds_stay_finite():
+    """Regression: when the candidate buffer runs dry before k rounds
+    (tiny corpus), later rounds read the int32-max fill value, whose
+    label-masked bits BITCAST to NaN; with a real kernel function the
+    epilogue must select 0, not multiply the NaN by a zero take."""
+    from avenir_tpu.ops.pallas_knn import knn_classify_lanes
+
+    rng = np.random.default_rng(12)
+    q = rng.normal(size=(128, 4)).astype(np.float32)
+    t = rng.normal(size=(3, 4)).astype(np.float32)
+    labels = np.array([0, 1, 1], np.int32)
+    t_pad, _, n_valid = pad_train(t, None, 256)
+    lab_pad = np.zeros(256, np.int32)
+    lab_pad[:3] = labels
+    for kernel_fn in ("gaussian", "linearAdditive", "linearMultiplicative"):
+        scores = np.asarray(knn_classify_lanes(
+            jnp.asarray(q), jnp.asarray(t_pad), jnp.asarray(lab_pad), k=5,
+            n_classes=2, kernel_fn=kernel_fn, kernel_param=30.0,
+            block_q=128, block_t=256, n_valid=n_valid, interpret=True))
+        assert np.isfinite(scores).all(), kernel_fn
+
+
 def test_mixed_expansion_matches_jnp_mixed_distance():
     """One-hot-expanded mixed data through the numeric kernel must equal
     ops.distance's mixed pairwise semantics (the route churn-shaped data
