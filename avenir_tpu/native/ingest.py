@@ -33,7 +33,7 @@ def _build() -> Optional[ctypes.CDLL]:
         try:
             subprocess.run(
                 ["g++", "-O3", "-march=native", "-shared", "-fPIC",
-                 "-o", _LIB, _SRC],
+                 "-pthread", "-o", _LIB, _SRC],
                 check=True, capture_output=True, timeout=120,
             )
         except (OSError, subprocess.SubprocessError):
@@ -60,6 +60,8 @@ def _build() -> Optional[ctypes.CDLL]:
         p_i32, i32, c_char_p, p_i32, p_i32, i64,
         p_i64, ctypes.POINTER(i32),
     ]
+    lib.csv_parse_mt.restype = i64
+    lib.csv_parse_mt.argtypes = lib.csv_parse.argtypes + [i32]
     lib.csv_column_bytes.restype = i64
     lib.csv_column_bytes.argtypes = [c_char_p, i64, ctypes.c_char, i32]
     lib.csv_extract_column.restype = i64
@@ -88,6 +90,7 @@ def parse_csv_native(
     categorical: List[Tuple[int, List[str]]],   # (ordinal, cardinality)
     string_ordinals: List[int],
     lazy_strings: bool = False,
+    threads: int = 0,
 ) -> Tuple[int, Dict[int, np.ndarray], Dict[int, object]]:
     """One native pass: (n_rows, {ordinal: column array}, {ordinal: thunk}).
 
@@ -121,11 +124,16 @@ def parse_csv_native(
     cat_out = np.full((len(cat_ords), n), -1, np.int32)
     err_row = ctypes.c_int64(-1)
     err_ord = ctypes.c_int32(-1)
-    got = int(lib.csv_parse(
+    # threads=0 lets the library pick hardware_concurrency; stripes are
+    # capped so small buffers stay on the sequential path (identical
+    # semantics either way — the MT entry splits at newline boundaries
+    # into disjoint global row ranges)
+    got = int(lib.csv_parse_mt(
         data, len(data), d, np.int32(max_ord),
         num_ords, len(num_ords), num_out,
         cat_ords, len(cat_ords), vocab_blob, vocab_counts, cat_out,
         np.int64(n), ctypes.byref(err_row), ctypes.byref(err_ord),
+        np.int32(threads),
     ))
     if got < 0:
         # recover the offending token for the standard error message

@@ -18,8 +18,9 @@ the harness (and the sharding programs it runs) is identical either way.
 
 from __future__ import annotations
 
+import re
 import time
-from typing import Optional, Sequence
+from typing import Dict, List, Optional, Sequence
 
 import numpy as np
 
@@ -47,6 +48,93 @@ def _timed_scalar(many_fn, *args) -> float:
         _ = float(many_fn(*shifted))
         best = min(best, time.perf_counter() - t0)
     return best
+
+
+_DTYPE_BYTES = {"f64": 8, "s64": 8, "u64": 8, "f32": 4, "s32": 4, "u32": 4,
+                "bf16": 2, "f16": 2, "s16": 2, "u16": 2, "s8": 1, "u8": 1,
+                "pred": 1}
+# matches sync collectives AND the async '-start' form (the XLA:TPU
+# default in compiled HLO); '-done' halves are skipped so an async pair
+# counts its payload once
+_COLLECTIVE_LINE = re.compile(
+    r"(?<!%)\b(all-reduce|all-gather|reduce-scatter|all-to-all"
+    r"|collective-permute)(-start)?\s*\(")
+_SHAPE = re.compile(r"\b(" + "|".join(_DTYPE_BYTES) + r")\[([0-9,]*)\]")
+
+
+def hlo_collective_payloads(compiled_text: str) -> List[Dict]:
+    """Collective ops in a compiled HLO module with their payload bytes.
+
+    This is the VALIDATION side of the scaling story: the analytic
+    per-device traffic model (ring all-reduce moves 2(P-1)/P x payload)
+    is only as good as its payload numbers, and those can silently grow
+    when XLA reduces more than the model assumes. Parsing the compiled
+    module pins them to what actually ships over the interconnect.
+    Returns [{op, payload_bytes}] for each collective instruction (the
+    payload is the summed byte size of the op's result shapes; for a
+    tuple all-reduce that is the full reduced state)."""
+    out = []
+    for ln in compiled_text.splitlines():
+        eq = ln.find("=")
+        if eq < 0:
+            continue
+        # the result shapes sit between '=' and the op name; search only
+        # the right-hand side, and reject %references to collective
+        # instructions appearing as operands of other ops
+        rhs = ln[eq + 1:]
+        m = _COLLECTIVE_LINE.search(rhs)
+        if not m:
+            continue
+        size = 0
+        for dt, dims in _SHAPE.findall(rhs[: m.start()]):
+            n = 1
+            for d in dims.split(","):
+                if d:
+                    n *= int(d)
+            size += n * _DTYPE_BYTES[dt]
+        out.append({"op": m.group(1), "payload_bytes": size})
+    return out
+
+
+def project_efficiency(
+    per_device_step_seconds: float,
+    allreduce_payload_bytes: float,
+    counts: Sequence[int] = (8, 64, 256),
+    ici_bytes_per_sec: float = 9.0e10,
+    ici_hop_latency_s: float = 1.0e-6,
+) -> List[Dict]:
+    """Weak-scaling efficiency projection for P chips on one ICI domain.
+
+    efficiency(P) = t_compute / (t_compute + t_comm(P)). The collective
+    model is a dimension-wise all-reduce on a (near-)square 2D torus —
+    the v5e pod topology: bandwidth term 2(P-1)/P x payload / bw, latency
+    term 2 x sum(2(dim-1)) hops. Bandwidth/latency defaults are public
+    v5e ICI ballparks (O(100) GB/s per chip, ~1us per hop).
+
+    What the model says for this workload family: payloads are
+    sub-kilobyte, so the bandwidth term is always noise and the knee is
+    pure hop latency — ~60us at 256 chips. Against the bench's measured
+    ~440us NB step (65k rows/device) that costs ~12%; the chunked
+    streaming fold (accumulate(defer=True), multi-million-row chunks per
+    device between flushes) pushes steps to multi-millisecond and the
+    projection back to ~1.0. Scale-out is therefore an amortization knob
+    the framework already exposes, not a redesign."""
+    rows = []
+    for p in counts:
+        # near-square 2D torus factorization of p
+        d1 = int(np.sqrt(p))
+        while p % d1:
+            d1 -= 1
+        d2 = p // d1
+        hops = 2 * ((d1 - 1) + (d2 - 1)) if p > 1 else 0
+        t_comm = (2.0 * (p - 1) / p * allreduce_payload_bytes
+                  / ici_bytes_per_sec + hops * ici_hop_latency_s)
+        eff = per_device_step_seconds / (per_device_step_seconds + t_comm)
+        rows.append({"devices": int(p), "projected_efficiency": round(eff, 4),
+                     "torus": [d1, d2],
+                     "t_compute_us": round(per_device_step_seconds * 1e6, 1),
+                     "t_collective_us": round(t_comm * 1e6, 2)})
+    return rows
 
 
 def _nb_rate(mesh, rows: int, iters: int) -> float:
@@ -79,6 +167,27 @@ def _nb_rate(mesh, rows: int, iters: int) -> float:
         return jax.lax.map(body, jnp.arange(1, iters + 1)).sum()
 
     return rows * iters / _timed_scalar(many, codes_d, labels_d, w_d)
+
+
+def _nb_compiled_collectives(mesh) -> List[Dict]:
+    """Compile the sharded NB train step on `mesh` and return its
+    collective instructions (hlo_collective_payloads)."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from avenir_tpu.parallel.distributed import distributed_nb_train_fn
+
+    rows = 8 * len(mesh.devices.flat)
+    shard = NamedSharding(mesh, P(mesh.axis_names))
+    step = distributed_nb_train_fn(mesh, _NB_CLASSES, _NB_BMAX)
+    args = [
+        jax.device_put(np.zeros((rows, _NB_FEAT), np.int32), shard),
+        jax.device_put(np.zeros((rows,), np.int32), shard),
+        jax.device_put(np.ones((rows,), np.float32), shard),
+    ]
+    compiled = jax.jit(step).lower(*args).compile()
+    return hlo_collective_payloads(compiled.as_text())
 
 
 def _knn_rate(mesh, queries: int, train: int, iters: int, k: int = 5) -> float:
@@ -168,6 +277,16 @@ def measure_scaling(
             3)
     last = table[-1]
     virtual = devs[0].platform == "cpu"
+    # HLO-validated traffic: parse the compiled sharded program's
+    # collectives and check the analytic payload against what XLA emits
+    hlo = _nb_compiled_collectives(data_mesh(devs[: last["devices"]],
+                                            model_parallel=1))
+    hlo_payload = sum(o["payload_bytes"] for o in hlo
+                      if o["op"] == "all-reduce")
+    # projection to pod scale from the measured per-device step time; on
+    # virtual devices the compute side is contention-distorted, flagged
+    step_s = nb_rows_per_device / (base["nb_rows_per_sec"]
+                                   / base["devices"])
     out = {
         "table": table,
         "efficiency_at_max": {
@@ -175,6 +294,11 @@ def measure_scaling(
             "nb": last["nb_efficiency"],
             "knn": last["knn_efficiency"],
         },
+        "nb_hlo_collectives": hlo,
+        "nb_hlo_allreduce_payload_bytes": hlo_payload,
+        "nb_analytic_payload_bytes": nb_tensor_bytes,
+        "payload_model_validated": hlo_payload == nb_tensor_bytes,
+        "projection_8_to_256": project_efficiency(step_s, hlo_payload),
         "virtual_devices": virtual,
     }
     if virtual:

@@ -456,18 +456,11 @@ def _backend_reachable(timeout_s: float = 180.0) -> bool:
     """Probe the accelerator backend in a subprocess with a hard timeout:
     a down tunnel makes jax.devices() hang indefinitely in-process, which
     would hang the whole bench; a probe failure turns into an explicit
-    JSON error line instead."""
-    import subprocess
-    import sys as _sys
+    JSON error line instead. The probe itself is shared with the
+    multi-chip bootstrap (__graft_entry__)."""
+    from __graft_entry__ import _accelerator_reachable
 
-    try:
-        proc = subprocess.run(
-            [_sys.executable, "-c",
-             "import jax; jax.devices(); print('ok')"],
-            capture_output=True, timeout=timeout_s, text=True)
-        return proc.returncode == 0 and "ok" in proc.stdout
-    except subprocess.TimeoutExpired:
-        return False
+    return _accelerator_reachable(timeout_s)
 
 
 def main():
@@ -569,7 +562,9 @@ def main():
                         "fold from host parse); csv figures stream "
                         f"{STREAM_CSV_ROWS//10**6}M on-disk rows through "
                         "CsvBlockReader+prefetched() and are bounded by "
-                        "this host's single core (nproc=1)"),
+                        "this host's single core (nproc=1; the native "
+                        "csv_parse_mt stripes the parse across all cores "
+                        "on real multi-core hosts)"),
         "baseline_note": ("vs_baseline divides by DOCUMENTED ESTIMATES of a "
                           "32-node Hadoop cluster (1.0e6 NB rows/sec, 3.2e7 "
                           "pair-distances/sec — see module docstring), not "
@@ -597,7 +592,31 @@ def main():
         "timing_note": ("scan-amortized, scalar-forced timing; NOT "
                         "comparable to BENCH_r01 (block_until_ready through "
                         "the axon tunnel returns early, inflating r01)"),
+        "scaling_projection_8_to_256": _scaling_projection(train_rps),
+        "scaling_projection_note": (
+            "weak-scaling efficiency projected from THIS chip's measured "
+            "NB step time and the HLO-validated 648B all-reduce payload "
+            "(see parallel/scaling.py: 2D-torus dimension-wise collective, "
+            "public v5e ICI ballparks); rows give 65k-rows/device bench "
+            "steps and the 4M-row streaming-fold steps that amortize hop "
+            "latency away"),
     })))
+
+
+def _scaling_projection(train_rps: float):
+    """Pod-scale projection grounded in the measured single-chip rate."""
+    from avenir_tpu.parallel.scaling import (_NB_BMAX, _NB_CLASSES, _NB_FEAT,
+                                             project_efficiency)
+
+    # the [F,K,B] count tensor + [K] class counts, f32 — the payload the
+    # scaling harness validates against the compiled HLO
+    payload = (_NB_FEAT * _NB_CLASSES * _NB_BMAX + _NB_CLASSES) * 4
+    return {
+        "bench_step_65k_rows": project_efficiency(65_536 / train_rps,
+                                                  payload),
+        "stream_step_4m_rows": project_efficiency(4_000_000 / train_rps,
+                                                  payload),
+    }
 
 
 if __name__ == "__main__":

@@ -14,13 +14,16 @@ import json
 import sys
 
 
-def main(n_devices: int = 8):
+def main(n_devices: int = 8, quick: bool = False):
     from __graft_entry__ import _bootstrap_devices
 
     devices = _bootstrap_devices(n_devices)
     from avenir_tpu.parallel.scaling import measure_scaling
 
-    result = measure_scaling(devices)
+    # --quick: smoke-scale workloads (single-core hosts; CI)
+    kw = dict(nb_rows_per_device=4_096, knn_queries_per_device=64,
+              knn_train=1_024, iters=2) if quick else {}
+    result = measure_scaling(devices, **kw)
     eff = result["efficiency_at_max"]
     value = float((eff["nb"] * eff["knn"]) ** 0.5)
     platform = devices[0].platform
@@ -33,6 +36,10 @@ def main(n_devices: int = 8):
         "platform": platform,
         "table": result["table"],
     }
+    # HLO-validated collective-payload model + pod-scale projection
+    for key in ("nb_hlo_allreduce_payload_bytes", "nb_analytic_payload_bytes",
+                "payload_model_validated", "projection_8_to_256"):
+        line[key] = result[key]
     if result.get("virtual_devices"):
         line["virtual_devices"] = True
         line["note"] = result["note"]
@@ -40,4 +47,5 @@ def main(n_devices: int = 8):
 
 
 if __name__ == "__main__":
-    main(int(sys.argv[1]) if len(sys.argv) > 1 else 8)
+    args = [a for a in sys.argv[1:] if a != "--quick"]
+    main(int(args[0]) if args else 8, quick="--quick" in sys.argv[1:])

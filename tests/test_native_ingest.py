@@ -135,3 +135,41 @@ def test_auto_engine_used_by_default(tmp_path):
     auto = Dataset.from_csv(csv_text, schema)
     py = Dataset.from_csv(csv_text, schema, engine="python")
     np.testing.assert_array_equal(auto.labels(), py.labels())
+
+
+def test_multithreaded_parse_matches_sequential():
+    """csv_parse_mt stripes the buffer at newline boundaries into disjoint
+    global row ranges; outputs must be byte-identical to the sequential
+    path on a buffer big enough to actually split (> 2 x 4MB stripes)."""
+    from avenir_tpu.native.ingest import native_available, parse_csv_native
+
+    if not native_available():
+        pytest.skip("no native lib")
+    rng = np.random.default_rng(3)
+    n = 360_000                     # ~9MB with these fields
+    cats = ["red", "green", "blue", "violet"]
+    rows = []
+    for i in range(n):
+        rows.append(f"id{i},{rng.random()*100:.4f},{cats[i % 4]},"
+                    f"{rng.integers(0, 1000)}")
+    blob = ("\n".join(rows) + "\n").encode()
+    assert len(blob) > 8 * (1 << 20)
+    args = (",", [1, 3], [(2, cats)], [0])
+    got_seq, cols_seq, _ = parse_csv_native(blob, *args, threads=1)
+    got_mt, cols_mt, _ = parse_csv_native(blob, *args, threads=2)
+    assert got_seq == got_mt == n
+    for o in (1, 3):
+        np.testing.assert_array_equal(cols_seq[o], cols_mt[o])
+    np.testing.assert_array_equal(cols_seq[2], cols_mt[2])
+
+    # an error deep in the second stripe reports the same global row
+    bad_rows = rows[:]
+    bad_rows[300_000] = "idX,not_a_number,red,7"
+    bad_blob = ("\n".join(bad_rows) + "\n").encode()
+    with pytest.raises(ValueError, match="not_a_number"):
+        parse_csv_native(bad_blob, *args, threads=2)
+    # unknown categorical in stripe 2
+    bad_rows[300_000] = "idX,1.0,chartreuse,7"
+    with pytest.raises(ValueError, match="chartreuse"):
+        parse_csv_native(("\n".join(bad_rows) + "\n").encode(), *args,
+                         threads=2)

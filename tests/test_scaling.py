@@ -43,3 +43,67 @@ def test_measure_scaling_baseline_not_one_device():
     assert result["table"][0]["nb_efficiency"] == 1.0
     with pytest.raises(ValueError, match="no requested device count"):
         measure_scaling(jax.devices()[:1], counts=(2, 4))
+
+
+def test_hlo_collective_payload_matches_analytic_model():
+    """The analytic ring-all-reduce traffic model is validated against the
+    compiled sharded program: the NB train step must emit exactly one
+    all-reduce whose payload is the [F,K,B] count tensor + [K] class
+    counts in f32."""
+    from avenir_tpu.parallel.mesh import data_mesh
+    from avenir_tpu.parallel.scaling import (_NB_BMAX, _NB_CLASSES, _NB_FEAT,
+                                             _nb_compiled_collectives)
+
+    mesh = data_mesh(jax.devices()[:4], model_parallel=1)
+    ops = _nb_compiled_collectives(mesh)
+    ars = [o for o in ops if o["op"] == "all-reduce"]
+    assert len(ars) == 1, ops
+    expected = (_NB_FEAT * _NB_CLASSES * _NB_BMAX + _NB_CLASSES) * 4
+    assert ars[0]["payload_bytes"] == expected
+
+
+def test_projection_math_and_report_fields():
+    from avenir_tpu.parallel.scaling import project_efficiency
+
+    # sub-kilobyte payload against the bench's ~440us step: hop latency
+    # is the only cost, ~12% at a 16x16 torus
+    rows = project_efficiency(440e-6, 648, counts=(8, 64, 256))
+    assert [r["devices"] for r in rows] == [8, 64, 256]
+    assert rows[0]["projected_efficiency"] > 0.97
+    assert rows[-1]["projected_efficiency"] > 0.85
+    assert rows[-1]["torus"] == [16, 16]
+    # efficiency monotonically falls with device count
+    effs = [r["projected_efficiency"] for r in rows]
+    assert effs == sorted(effs, reverse=True)
+    # the streaming fold's multi-ms steps amortize the latency away
+    big = project_efficiency(6.7e-3, 648, counts=(256,))
+    assert big[0]["projected_efficiency"] > 0.99
+    # a bandwidth-bound regime: giant payload tanks the projection
+    bad = project_efficiency(1e-6, 1 << 30, counts=(256,))
+    assert bad[0]["projected_efficiency"] < 0.01
+
+    result = measure_scaling(
+        jax.devices()[:2], counts=(1, 2), nb_rows_per_device=1_024,
+        knn_queries_per_device=16, knn_train=256, iters=1,
+    )
+    assert result["payload_model_validated"] is True
+    assert result["nb_hlo_allreduce_payload_bytes"] == \
+        result["nb_analytic_payload_bytes"]
+    proj = result["projection_8_to_256"]
+    assert [r["devices"] for r in proj] == [8, 64, 256]
+
+
+def test_hlo_payload_parses_async_collectives():
+    """XLA:TPU emits async all-reduce-start/-done pairs; the payload must
+    count once (at -start) and %references must not count at all."""
+    from avenir_tpu.parallel.scaling import hlo_collective_payloads
+
+    txt = """
+  %all-reduce-start.1 = (f32[8,2,10]{2,1,0}, f32[2]{0}) all-reduce-start(%fusion, %wrapped), channel_id=1
+  %all-reduce-done.1 = (f32[8,2,10]{2,1,0}, f32[2]{0}) all-reduce-done(%all-reduce-start.1)
+  %gte = f32[2]{0} get-tuple-element(%all-reduce-done.1), index=1
+  ROOT %ar = f32[16]{0} all-reduce(%x), replica_groups={}
+"""
+    ops = hlo_collective_payloads(txt)
+    assert [(o["op"], o["payload_bytes"]) for o in ops] == [
+        ("all-reduce", (8 * 2 * 10 + 2) * 4), ("all-reduce", 64)]
