@@ -219,25 +219,48 @@ int64_t count_range(const char* p, const char* end) {
     return rows;
 }
 
+// Stripe [buf, buf+len) into n newline-aligned ranges; bounds[i..i+1]
+// delimits stripe i.
+std::vector<const char*> stripe_bounds(const char* buf, int64_t len,
+                                       int32_t n) {
+    std::vector<const char*> bounds(n + 1);
+    bounds[0] = buf;
+    bounds[n] = buf + len;
+    for (int32_t i = 1; i < n; ++i) {
+        const char* p = buf + len * i / n;
+        const char* nl = static_cast<const char*>(
+            memchr(p, '\n', buf + len - p));
+        bounds[i] = nl ? nl + 1 : buf + len;
+    }
+    return bounds;
+}
+
+// Run fn(i) on n threads; false if spawning failed (work may be partially
+// done — callers must treat false as "redo sequentially").
+template <typename Fn>
+bool run_threads(int32_t n, Fn fn) {
+    std::vector<std::thread> ts;
+    ts.reserve(n);
+    try {
+        for (int32_t i = 0; i < n; ++i) ts.emplace_back([fn, i] { fn(i); });
+    } catch (...) {
+        // std::system_error from thread creation (pid/memory limits):
+        // join what started, report failure — throwing across the
+        // extern "C" boundary would std::terminate the host process
+        for (auto& th : ts) th.join();
+        return false;
+    }
+    for (auto& th : ts) th.join();
+    return true;
+}
+
 }  // namespace
 
 extern "C" {
 
 // Count non-empty lines.
 int64_t csv_count_rows(const char* buf, int64_t len) {
-    int64_t rows = 0;
-    const char* p = buf;
-    const char* end = buf + len;
-    while (p < end) {
-        const char* nl = static_cast<const char*>(memchr(p, '\n', end - p));
-        const char* line_end = nl ? nl : end;
-        const char* b = p;
-        const char* e = line_end;
-        trim(b, e);
-        if (e > b) ++rows;
-        p = nl ? nl + 1 : end;
-    }
-    return rows;
+    return count_range(buf, buf + len);
 }
 
 // Parse the buffer in one pass.
@@ -294,44 +317,33 @@ int64_t csv_parse_mt(const char* buf, int64_t len, char delim,
 
     ParseTables t = build_tables(max_ord, num_ords, n_num, cat_ords, n_cat,
                                  vocab_blob, vocab_counts);
-    // stripe boundaries: advance each nominal split to the next newline
-    std::vector<const char*> bounds(n_threads + 1);
-    bounds[0] = buf;
-    bounds[n_threads] = buf + len;
-    for (int32_t i = 1; i < n_threads; ++i) {
-        const char* p = buf + len * i / n_threads;
-        const char* nl = static_cast<const char*>(
-            memchr(p, '\n', buf + len - p));
-        bounds[i] = nl ? nl + 1 : buf + len;
-    }
+    std::vector<const char*> bounds = stripe_bounds(buf, len, n_threads);
     // pass A: parallel row count per stripe
     std::vector<int64_t> stripe_rows(n_threads, 0);
-    {
-        std::vector<std::thread> ts;
-        for (int32_t i = 0; i < n_threads; ++i)
-            ts.emplace_back([&, i] {
-                stripe_rows[i] = count_range(bounds[i], bounds[i + 1]);
-            });
-        for (auto& th : ts) th.join();
-    }
+    bool ok = run_threads(n_threads, [&](int32_t i) {
+        stripe_rows[i] = count_range(bounds[i], bounds[i + 1]);
+    });
     std::vector<int64_t> base(n_threads + 1, 0);
     for (int32_t i = 0; i < n_threads; ++i)
         base[i + 1] = base[i] + stripe_rows[i];
-    if (base[n_threads] > n_rows) return -3;   // caller under-allocated
+    // thread-spawn failure or under-allocated output (the sequential
+    // contract is "parse at most n_rows"): fall back to the sequential
+    // path, which implements both cases exactly
+    if (!ok || base[n_threads] > n_rows)
+        return parse_range(buf, buf + len, delim, t, num_out, cat_out,
+                           n_rows, 0, n_rows, err_row, err_ord);
 
     // pass B: parallel parse into disjoint global row ranges
     std::vector<int64_t> st(n_threads, 0), erow(n_threads, -1);
     std::vector<int32_t> eord(n_threads, -1);
-    {
-        std::vector<std::thread> ts;
-        for (int32_t i = 0; i < n_threads; ++i)
-            ts.emplace_back([&, i] {
-                st[i] = parse_range(bounds[i], bounds[i + 1], delim, t,
-                                    num_out, cat_out, n_rows, base[i],
-                                    stripe_rows[i], &erow[i], &eord[i]);
-            });
-        for (auto& th : ts) th.join();
-    }
+    ok = run_threads(n_threads, [&](int32_t i) {
+        st[i] = parse_range(bounds[i], bounds[i + 1], delim, t,
+                            num_out, cat_out, n_rows, base[i],
+                            stripe_rows[i], &erow[i], &eord[i]);
+    });
+    if (!ok)
+        return parse_range(buf, buf + len, delim, t, num_out, cat_out,
+                           n_rows, 0, n_rows, err_row, err_ord);
     for (int32_t i = 0; i < n_threads; ++i) {
         if (st[i] < 0) {                      // lowest-row failure wins
             *err_row = erow[i];
@@ -340,6 +352,27 @@ int64_t csv_parse_mt(const char* buf, int64_t len, char delim,
         }
     }
     return base[n_threads];
+}
+
+// Striped row count: the sequential pre-count is otherwise the Amdahl
+// bottleneck of the parallel ingest (two full-buffer scans, one serial).
+int64_t csv_count_rows_mt(const char* buf, int64_t len, int32_t n_threads) {
+    if (n_threads <= 0) {
+        n_threads = static_cast<int32_t>(std::thread::hardware_concurrency());
+        if (n_threads <= 0) n_threads = 1;
+    }
+    int64_t max_stripes = len / (4 << 20);
+    if (n_threads > max_stripes) n_threads = static_cast<int32_t>(max_stripes);
+    if (n_threads <= 1) return count_range(buf, buf + len);
+    std::vector<const char*> bounds = stripe_bounds(buf, len, n_threads);
+    std::vector<int64_t> rows(n_threads, 0);
+    if (!run_threads(n_threads, [&](int32_t i) {
+            rows[i] = count_range(bounds[i], bounds[i + 1]);
+        }))
+        return count_range(buf, buf + len);
+    int64_t total = 0;
+    for (int64_t r : rows) total += r;
+    return total;
 }
 
 // Total bytes needed by csv_extract_column's output (tokens + '\n' each).

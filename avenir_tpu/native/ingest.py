@@ -53,6 +53,8 @@ def _build() -> Optional[ctypes.CDLL]:
 
     lib.csv_count_rows.restype = i64
     lib.csv_count_rows.argtypes = [c_char_p, i64]
+    lib.csv_count_rows_mt.restype = i64
+    lib.csv_count_rows_mt.argtypes = [c_char_p, i64, i32]
     lib.csv_parse.restype = i64
     lib.csv_parse.argtypes = [
         c_char_p, i64, ctypes.c_char, i32,
@@ -105,7 +107,7 @@ def parse_csv_native(
     if lib is None:
         raise RuntimeError("native CSV ingest unavailable (no g++?)")
     d = delim.encode()[0:1]
-    n = int(lib.csv_count_rows(data, len(data)))
+    n = int(lib.csv_count_rows_mt(data, len(data), np.int32(threads)))
     columns: Dict[int, np.ndarray] = {}
 
     num_ords = np.asarray(numeric_ordinals, np.int32)

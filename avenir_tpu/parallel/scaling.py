@@ -31,6 +31,14 @@ from avenir_tpu.parallel.mesh import DATA_AXIS, data_mesh
 _NB_CLASSES, _NB_FEAT, _NB_BMAX = 2, 8, 10
 
 
+def nb_payload_bytes() -> int:
+    """All-reduce payload of the weak-scaling NB step: the [F, K, B] count
+    tensor + [K] class counts in f32. The single source of the number the
+    compiled-HLO check validates and the projections consume (bench.py,
+    tests)."""
+    return (_NB_FEAT * _NB_CLASSES * _NB_BMAX + _NB_CLASSES) * 4
+
+
 def _timed_scalar(many_fn, *args) -> float:
     """Best-of-2 wall clock of the jitted scalar-reducing many_fn, warmup
     excluded, result forced to host with float(). Through the axon tunnel
@@ -250,8 +258,7 @@ def measure_scaling(
     # (2(P-1)/P x tensor bytes) are the collective cost the efficiency
     # number prices in; unlike the wall clock these hold on real chips and
     # let a contended virtual run still validate the harness math
-    nb_tensor_bytes = (_NB_FEAT * _NB_CLASSES * _NB_BMAX
-                       + _NB_CLASSES) * 4        # [F,K,B] + [K] f32
+    nb_tensor_bytes = nb_payload_bytes()
     table = []
     for n in counts:
         mesh = data_mesh(devs[:n], model_parallel=1)

@@ -605,12 +605,11 @@ def main():
 
 def _scaling_projection(train_rps: float):
     """Pod-scale projection grounded in the measured single-chip rate."""
-    from avenir_tpu.parallel.scaling import (_NB_BMAX, _NB_CLASSES, _NB_FEAT,
+    from avenir_tpu.parallel.scaling import (nb_payload_bytes,
                                              project_efficiency)
 
-    # the [F,K,B] count tensor + [K] class counts, f32 — the payload the
-    # scaling harness validates against the compiled HLO
-    payload = (_NB_FEAT * _NB_CLASSES * _NB_BMAX + _NB_CLASSES) * 4
+    # the payload the scaling harness validates against the compiled HLO
+    payload = nb_payload_bytes()
     return {
         "bench_step_65k_rows": project_efficiency(65_536 / train_rps,
                                                   payload),

@@ -173,3 +173,52 @@ def test_multithreaded_parse_matches_sequential():
     with pytest.raises(ValueError, match="chartreuse"):
         parse_csv_native(("\n".join(bad_rows) + "\n").encode(), *args,
                          threads=2)
+
+
+def test_fuzz_native_matches_python_parser():
+    """Differential fuzz: random CSVs with whitespace, blank lines, short
+    rows, negatives, exponent floats, and empty numeric fields must parse
+    identically through the native and python engines."""
+    from avenir_tpu.core.dataset import Dataset
+    from avenir_tpu.core.schema import FeatureSchema
+
+    schema = FeatureSchema.from_json({"fields": [
+        {"name": "id", "ordinal": 0, "dataType": "string", "id": True},
+        {"name": "a", "ordinal": 1, "dataType": "double", "feature": True,
+         "min": -100, "max": 100},
+        {"name": "c", "ordinal": 2, "dataType": "categorical",
+         "feature": True, "cardinality": ["x", "y", "z"]},
+        {"name": "b", "ordinal": 3, "dataType": "double", "feature": True,
+         "min": -100, "max": 100},
+        {"name": "cls", "ordinal": 4, "dataType": "categorical",
+         "class": True, "cardinality": ["neg", "pos"]},
+    ]})
+    rng = np.random.default_rng(99)
+    cats, classes = ["x", "y", "z"], ["neg", "pos"]
+    for trial in range(10):
+        lines = []
+        for i in range(rng.integers(5, 60)):
+            kind = rng.random()
+            a = f"{rng.normal()*50:.4f}"
+            if kind < 0.1:
+                a = f"{rng.normal():.3e}"           # exponent float
+            elif kind < 0.2:
+                a = ""                              # empty numeric -> NaN
+            b = f"{int(rng.integers(-99, 99))}"
+            pad = " " * int(rng.integers(0, 3))
+            lines.append(f"{pad}r{i},{a},{pad}{cats[rng.integers(0,3)]}"
+                         f"{pad},{b},{classes[rng.integers(0,2)]}")
+            if rng.random() < 0.15:
+                lines.append("")                    # blank line
+        text = "\n".join(lines) + "\n"
+        nat = Dataset.from_csv(text, schema, engine="native")
+        py = Dataset.from_csv(text, schema, engine="python")
+        assert len(nat) == len(py)
+        for o in (1, 3):
+            np.testing.assert_array_equal(np.isnan(nat.column(o)),
+                                          np.isnan(py.column(o)))
+            m = ~np.isnan(py.column(o))
+            np.testing.assert_allclose(nat.column(o)[m], py.column(o)[m],
+                                       rtol=1e-6)
+        for o in (2, 4):
+            np.testing.assert_array_equal(nat.column(o), py.column(o))

@@ -51,15 +51,14 @@ def test_hlo_collective_payload_matches_analytic_model():
     all-reduce whose payload is the [F,K,B] count tensor + [K] class
     counts in f32."""
     from avenir_tpu.parallel.mesh import data_mesh
-    from avenir_tpu.parallel.scaling import (_NB_BMAX, _NB_CLASSES, _NB_FEAT,
-                                             _nb_compiled_collectives)
+    from avenir_tpu.parallel.scaling import (_nb_compiled_collectives,
+                                             nb_payload_bytes)
 
     mesh = data_mesh(jax.devices()[:4], model_parallel=1)
     ops = _nb_compiled_collectives(mesh)
     ars = [o for o in ops if o["op"] == "all-reduce"]
     assert len(ars) == 1, ops
-    expected = (_NB_FEAT * _NB_CLASSES * _NB_BMAX + _NB_CLASSES) * 4
-    assert ars[0]["payload_bytes"] == expected
+    assert ars[0]["payload_bytes"] == nb_payload_bytes() == 648
 
 
 def test_projection_math_and_report_fields():
