@@ -1159,7 +1159,8 @@ def infrequent_item_marker_job(cfg: JobConfig, inputs: List[str],
 
 # ===================================================================== markov
 @job("markovStateTransitionModel", "mst",
-     "org.avenir.markov.MarkovStateTransitionModel")
+     "org.avenir.markov.MarkovStateTransitionModel",
+     "org.avenir.spark.sequence.MarkovStateTransitionModel")
 def markov_model_job(cfg: JobConfig, inputs: List[str], output: str) -> JobResult:
     """Per-class matrices via mst.* keys (the Hadoop job). With
     `id.field.ordinals` set (the Spark surface's HOCON key,
@@ -1219,7 +1220,8 @@ def markov_model_job(cfg: JobConfig, inputs: List[str], output: str) -> JobResul
 
 
 @job("markovModelClassifier", "mmc",
-     "org.avenir.markov.MarkovModelClassifier")
+     "org.avenir.markov.MarkovModelClassifier",
+     "org.avenir.spark.sequence.MarkovModelClassifier")
 def markov_classifier_job(cfg: JobConfig, inputs: List[str], output: str) -> JobResult:
     from avenir_tpu.models.markov import (MarkovModelClassifier,
                                           MarkovStateTransitionModel)
@@ -1374,7 +1376,8 @@ def fisher_job(cfg: JobConfig, inputs: List[str], output: str) -> JobResult:
 
 
 # ======================================================================= text
-@job("wordCounter", "wco", "org.avenir.text.WordCounter")
+@job("wordCounter", "wco", "org.avenir.text.WordCounter",
+     "org.avenir.sanity.WordCount")
 def word_counter_job(cfg: JobConfig, inputs: List[str], output: str) -> JobResult:
     from avenir_tpu.models.text import WordCounter
 

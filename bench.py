@@ -189,7 +189,7 @@ def bench_nb_stream():
     bins = model.bins
     k = schema.num_classes()
 
-    # --- device-generated chunks: >=100M rows, zero host ingest ---------
+    # --- device-generated chunks: the 1B-row pass, zero host ingest -----
     # 4 pre-generated chunks cycled across the loop; the fold executable
     # re-runs every call regardless (the donated accumulator argument
     # changes each chunk, so the axon (executable, input) memoization
@@ -303,7 +303,7 @@ def bench_knn_stream():
     t_start = time.perf_counter()
     _ = float(compiled(q, t0))
     dt = time.perf_counter() - t_start
-    return KNN_STREAM_TRAIN / dt, nq * KNN_STREAM_TRAIN / dt, dt
+    return KNN_STREAM_TRAIN / dt, nq * KNN_STREAM_TRAIN / dt, dt, use_pallas
 
 
 def bench_knn(dim: int):
@@ -546,7 +546,8 @@ def main():
     peak = PEAK_FLOPS.get(dev.device_kind, DEFAULT_PEAK)
     train_rps, predict_rps, nb_rps = bench_naive_bayes()
     stream_rps, stream_csv_rps, parse_rps, rss_mb = bench_nb_stream()
-    knn_stream_rps, knn_stream_pds, knn_stream_s = bench_knn_stream()
+    (knn_stream_rps, knn_stream_pds, knn_stream_s,
+     knn_stream_pallas) = bench_knn_stream()
     rf_rls, rf_levels, rf_predict_rps = bench_random_forest()
     ap_txs, ap_rounds, ap_found = bench_apriori()
     bandit_gds = bench_bandit()
@@ -624,10 +625,12 @@ def main():
         "knn_stream_1b_pair_distances_per_sec": round(knn_stream_pds, 1),
         "knn_stream_1b_elapsed_s": round(knn_stream_s, 2),
         "knn_stream_note": (
-            f"top-k over a {KNN_STREAM_TRAIN//10**9}B-row train corpus "
-            f"streamed in {KNN_STREAM_BLOCK//10**6}M-row blocks "
+            f"top-k over a {KNN_STREAM_TRAIN/1e9:.2f}B-row train corpus "
+            f"streamed in {KNN_STREAM_BLOCK/1e3:.0f}K-row blocks "
             f"({KNN_STREAM_QUERIES} queries, d={KNN_STREAM_DIM}, "
-            "bf16 pallas kernel + running argsort merge; blocks are "
+            + ("bf16 pallas lane kernel" if knn_stream_pallas
+               else "f32 blocked jnp fallback")
+            + " + running argsort merge; blocks are "
             "feature rotations of one resident block so the metric "
             "prices distance math, not PRNG generation — a throughput "
             "proxy, the kernel cost being data-independent)"),
