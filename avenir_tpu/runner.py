@@ -588,10 +588,64 @@ def ctmc_stats_job(cfg: JobConfig, inputs: List[str], output: str) -> JobResult:
     from avenir_tpu.models.markov import ContTimeStateTransitionStats
 
     states = cfg.assert_list("state.values")
-    rates = np.loadtxt(cfg.assert_get("state.trans.file.path"),
-                       delimiter=cfg.field_delim_regex, ndmin=2)
-    stats = ContTimeStateTransitionStats(
-        rates, states, cfg.assert_float("time.horizon"))
+    horizon = cfg.assert_float("time.horizon")
+    rate_path = cfg.assert_get("state.trans.file.path")
+    # two accepted rate-file shapes (the Scala job's cts.key.field.len
+    # contract): a plain S x S numeric matrix, or stateTransitionRate's
+    # per-entity output (`key,state,r0,...,rS-1` rows) — the supplier-
+    # fulfillment flow (sup.sh transRate -> rateStat) hands the second
+    # straight through, and stats are then looked up by the input row's
+    # entity key
+    per_entity: Dict[str, np.ndarray] = {}
+    # shape sniffing by STRUCTURE, not parse failure (numeric entity ids
+    # and state labels would make a per-entity file loadtxt-able): a
+    # plain matrix row has S tokens; a per-entity row has S + 2 with the
+    # second token being a state label
+    first = next(iter(_read_lines(rate_path)), "")
+    ftoks = [t.strip() for t in first.split(cfg.field_delim_regex)]
+    if len(ftoks) == len(states) + 2 and ftoks[1] in states:
+        rows: Dict[str, Dict[str, List[float]]] = {}
+        for ln in _read_lines(rate_path):
+            toks = [t.strip() for t in ln.split(cfg.field_delim_regex)]
+            key, state, vals = toks[0], toks[1], [float(v) for v in toks[2:]]
+            if state not in states or len(vals) != len(states):
+                raise ValueError(
+                    f"rate file row for {key!r} does not match "
+                    f"state.values {states}")
+            rows.setdefault(key, {})[state] = vals
+        for key, by_state in rows.items():
+            missing = [s for s in states if s not in by_state]
+            if missing:
+                raise ValueError(
+                    f"entity {key!r} in {rate_path} has no rate row for "
+                    f"state(s) {missing}")
+            per_entity[key] = np.array([by_state[s] for s in states])
+        rates = None
+    else:
+        rates = np.loadtxt(rate_path, delimiter=cfg.field_delim_regex,
+                           ndmin=2)
+        if rates.shape != (len(states), len(states)):
+            raise ValueError(
+                f"rate matrix in {rate_path} has shape {rates.shape}; "
+                f"expected {(len(states), len(states))} for state.values "
+                f"{states} (or stateTransitionRate per-entity rows)")
+
+    stats_cache: Dict[str, ContTimeStateTransitionStats] = {}
+
+    def stats_for(rid: str) -> ContTimeStateTransitionStats:
+        if rates is not None:
+            key = ""
+        else:
+            if rid not in per_entity:
+                raise KeyError(f"no rate matrix for entity {rid!r} in "
+                               f"{rate_path}")
+            key = rid
+        if key not in stats_cache:
+            q = rates if rates is not None else per_entity[key]
+            stats_cache[key] = ContTimeStateTransitionStats(
+                q, states, horizon)
+        return stats_cache[key]
+
     stat_kind = cfg.get("state.trans.stat", "stateDwellTime")
     targets = cfg.assert_list("target.states")
     out = _out_file(output)
@@ -602,12 +656,14 @@ def ctmc_stats_job(cfg: JobConfig, inputs: List[str], output: str) -> JobResult:
                 toks = [t.strip() for t in ln.split(cfg.field_delim_regex)]
                 rid, init = toks[0], toks[1]
                 end = toks[2] if len(toks) > 2 else None
+                st = stats_for(rid)
                 if stat_kind == "stateDwellTime":
-                    v = stats.dwell_time(init, targets[0], end)
+                    v = st.dwell_time(init, targets[0], end)
                 else:
-                    v = stats.transition_count(init, targets[0], targets[1], end)
+                    v = st.transition_count(init, targets[0], targets[1], end)
                 fh.write(f"{rid}{delim}{v:.6f}\n")
-    return JobResult("contTimeStateTransitionStats", {}, [out], stats)
+    return JobResult("contTimeStateTransitionStats", {},
+                     [out], stats_cache)
 
 
 @job("stateTransitionRate", "str",

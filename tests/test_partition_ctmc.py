@@ -219,3 +219,70 @@ def test_class_attribute_counter():
     assert (c.pos_count, c.neg_count, c.total) == (4, 2, 6)
     c.update(7, 7)
     assert c.total == 14
+
+
+def test_ctmc_stats_job_per_entity_rate_file(tmp_path):
+    """The supplier-fulfillment handoff (sup.sh transRate -> rateStat):
+    the stats job accepts stateTransitionRate's per-entity output and
+    looks up each query row's matrix by entity key."""
+    from avenir_tpu.runner import run_job
+
+    rates = tmp_path / "rates.txt"
+    # e1 leaves A slowly (rate .2/wk), e2 quickly (2/wk)
+    rates.write_text(
+        "e1,A,-0.2,0.2\ne1,B,1.0,-1.0\n"
+        "e2,A,-2.0,2.0\ne2,B,1.0,-1.0\n")
+    queries = tmp_path / "q.csv"
+    queries.write_text("e1,A\ne2,A\n")
+    out = str(tmp_path / "dwell.csv")
+    res = run_job("contTimeStateTransitionStats", {
+        "cts.state.values": "A,B",
+        "cts.time.horizon": "4",
+        "cts.state.trans.file.path": str(rates),
+        "cts.state.trans.stat": "stateDwellTime",
+        "cts.target.states": "A",
+    }, [str(queries)], out)
+    dwell = {ln.split(",")[0]: float(ln.split(",")[1])
+             for ln in open(out).read().splitlines()}
+    # slower exit from A -> more time spent in A over the horizon
+    assert dwell["e1"] > dwell["e2"] > 0
+    # unknown entity fails crisply
+    queries.write_text("ghost,A\n")
+    with pytest.raises(KeyError, match="ghost"):
+        run_job("contTimeStateTransitionStats", {
+            "cts.state.values": "A,B",
+            "cts.time.horizon": "4",
+            "cts.state.trans.file.path": str(rates),
+            "cts.target.states": "A",
+        }, [str(queries)], str(tmp_path / "x.csv"))
+
+
+def test_ctmc_stats_job_numeric_keys_and_missing_state_row(tmp_path):
+    """Shape sniffing must classify by structure: numeric entity ids and
+    numeric state labels still parse as a per-entity file; an entity
+    missing a state row gets a descriptive error."""
+    from avenir_tpu.runner import run_job
+
+    rates = tmp_path / "rates.txt"
+    rates.write_text("101,0,-0.2,0.2\n101,1,1.0,-1.0\n"
+                     "102,0,-2.0,2.0\n102,1,1.0,-1.0\n")
+    q = tmp_path / "q.csv"
+    q.write_text("101,0\n102,0\n")
+    res = run_job("contTimeStateTransitionStats", {
+        "cts.state.values": "0,1",
+        "cts.time.horizon": "4",
+        "cts.state.trans.file.path": str(rates),
+        "cts.target.states": "0",
+    }, [str(q)], str(tmp_path / "d.csv"))
+    dwell = {ln.split(",")[0]: float(ln.split(",")[1])
+             for ln in open(res.outputs[0]).read().splitlines()}
+    assert dwell["101"] > dwell["102"] > 0
+
+    rates.write_text("e1,A,-0.2,0.2\n")        # e1 has no B row
+    with pytest.raises(ValueError, match="no rate row for state"):
+        run_job("contTimeStateTransitionStats", {
+            "cts.state.values": "A,B",
+            "cts.time.horizon": "4",
+            "cts.state.trans.file.path": str(rates),
+            "cts.target.states": "A",
+        }, [str(q)], str(tmp_path / "x.csv"))
