@@ -9,5 +9,11 @@ import sys
 
 from avenir_tpu.runner import run_from_cli
 
-if __name__ == "__main__":
+
+def main() -> None:
+    """Console-script entry (`avenir-tpu ...` after pip install)."""
     run_from_cli(sys.argv[1:])
+
+
+if __name__ == "__main__":
+    main()

@@ -8,7 +8,7 @@ against reference-style schemas, used by the test suite and bench.py.
 
 from __future__ import annotations
 
-from typing import List, Optional, Tuple
+from typing import List, Optional
 
 import numpy as np
 

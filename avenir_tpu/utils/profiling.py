@@ -20,7 +20,7 @@ import contextlib
 import math
 import time
 from dataclasses import dataclass
-from typing import Dict, Iterator, List, Optional, Tuple
+from typing import Dict, Iterator, List
 
 
 class PhaseTimer:
