@@ -301,3 +301,81 @@ def test_mixed_expansion_matches_jnp_mixed_distance():
             n_attrs=n_attrs, interpret=True)
         np.testing.assert_allclose(np.asarray(got_d), np.asarray(ref_d),
                                    rtol=3e-3, atol=1e-4)
+
+
+def test_randomized_shape_sweep_vs_oracle():
+    """Randomized interpret-mode sweep over (nq, nt, k, n_valid, metric):
+    the lane kernel top-k must match a NumPy oracle for every
+    drawn configuration (tie-tolerant on indices). Catches shape-dependent
+    carry/padding bugs the fixed-shape tests can't."""
+    rng = np.random.default_rng(77)
+    for trial in range(8):
+        k = int(rng.integers(1, 9))
+        d = int(rng.choice([4, 8, 16]))
+        nq = 128 * int(rng.integers(1, 3))
+        # low end of 2 lets n_real fall BELOW k: the unfillable-slot
+        # (inf / -1 sentinel) path must be drawable, not dead
+        n_real = int(rng.integers(2, 700))
+        metric = str(rng.choice(["euclidean", "manhattan"]))
+        block_t = 256
+        q = rng.normal(size=(nq, d)).astype(np.float32)
+        t = rng.normal(size=(n_real, d)).astype(np.float32)
+        t_pad, _, n_valid = pad_train(t, None, block_t)
+        got_d, got_i = knn_topk_lanes(
+            jnp.asarray(q), jnp.asarray(t_pad), k=k, block_q=128,
+            block_t=block_t, n_valid=n_valid, metric=metric,
+            interpret=True)
+        got_d, got_i = np.asarray(got_d), np.asarray(got_i)
+
+        if metric == "euclidean":
+            full = np.sqrt(((q[:, None, :] - t[None, :, :]) ** 2).mean(-1))
+        else:
+            full = np.abs(q[:, None, :] - t[None, :, :]).sum(-1) / d
+        kk = min(k, n_real)
+        ref_d = np.sort(full, axis=1)[:, :kk]
+        np.testing.assert_allclose(
+            got_d[:, :kk], ref_d, rtol=3e-3, atol=1e-4,
+            err_msg=f"trial {trial}: k={k} d={d} nq={nq} n_real={n_real}")
+        # returned indices must point at rows whose true distance matches
+        rows = np.arange(nq)[:, None]
+        np.testing.assert_allclose(
+            full[rows, got_i[:, :kk]], got_d[:, :kk], rtol=3e-3, atol=1e-4)
+        if kk < k:
+            assert np.isinf(got_d[:, kk:]).all()
+            assert (got_i[:, kk:] == -1).all()
+
+
+def test_randomized_classify_sweep_fused_vs_composed():
+    """Randomized fused-vote configurations (k, classes, kernel_fn,
+    corpus size) against the composed top-k + _vote path."""
+    from avenir_tpu.models.knn import _vote
+    from avenir_tpu.ops.pallas_knn import knn_classify_lanes
+
+    rng = np.random.default_rng(88)
+    for trial in range(4):
+        k = int(rng.integers(1, 8))
+        C = int(rng.integers(2, 5))
+        kernel_fn = str(rng.choice(["none", "gaussian", "linearAdditive"]))
+        n_real = int(rng.integers(max(k, 3), 600))
+        d = 6
+        q = rng.normal(size=(128, d)).astype(np.float32)
+        t = rng.normal(size=(n_real, d)).astype(np.float32)
+        labels = rng.integers(0, C, n_real).astype(np.int32)
+        t_pad, _, n_valid = pad_train(t, None, 256)
+        lab_pad = np.zeros(t_pad.shape[0], np.int32)
+        lab_pad[:n_real] = labels
+
+        scores = np.asarray(knn_classify_lanes(
+            jnp.asarray(q), jnp.asarray(t_pad), jnp.asarray(lab_pad), k=k,
+            n_classes=C, kernel_fn=kernel_fn, kernel_param=30.0,
+            block_q=128, block_t=256, n_valid=n_valid, interpret=True))
+        assert np.isfinite(scores).all(), (trial, kernel_fn)
+
+        dist, idx = knn_topk_lanes(
+            jnp.asarray(q), jnp.asarray(t_pad), k=k, block_q=128,
+            block_t=256, n_valid=n_valid, interpret=True)
+        ref = np.asarray(_vote(dist, jnp.asarray(lab_pad)[jnp.maximum(idx, 0)],
+                               jnp.ones_like(dist), kernel_fn, 30.0, C,
+                               False, False))
+        agree = (scores.argmax(1) == ref.argmax(1)).mean()
+        assert agree >= 0.98, (trial, kernel_fn, agree)
