@@ -178,6 +178,31 @@ def distributed_apriori_support_fn(mesh: Mesh, k: int):
     )
 
 
+def distributed_bandit_select_fn(mesh: Mesh, batch_size: int,
+                                 max_reward: float = 100.0):
+    """Build a jitted mesh-wide UCB1 bandit round: groups shard over the
+    mesh rows (the map-only per-group MR job GreedyRandomBandit.java:148 /
+    AuerDeterministic.java:130 is embarrassingly parallel — selection
+    reads only the group's own arm stats, so the only collective cost is
+    zero), each shard scores and ranks its groups, and the output stays
+    group-sharded like the job's per-mapper output files."""
+    from avenir_tpu.models.bandits import _ucb1_kernel
+
+    axes = tuple(a for a in (DATA_AXIS, MODEL_AXIS) if a in mesh.axis_names)
+
+    def kernel(counts, rewards, mask, round_num):
+        # the shared single-device kernel, per shard (nested jit inlines)
+        return _ucb1_kernel(counts, rewards, mask, round_num, max_reward,
+                            batch_size)
+
+    row = P(axes)
+    return jax.jit(
+        jax.shard_map(kernel, mesh=mesh,
+                      in_specs=(row, row, row, P()),
+                      out_specs=row, check_vma=False)
+    )
+
+
 def distributed_crosscount_fn(mesh: Mesh, bins_a: int, bins_b: int):
     """Build a jitted mesh-wide contingency counter: the primitive behind
     mutual information / correlations (SURVEY §2.4) — per-shard one-hot

@@ -187,3 +187,30 @@ def test_multihost_helpers_single_process(mesh8, rng):
     np.testing.assert_allclose(np.asarray(arr), rows)
     # the array is actually row-sharded over the mesh
     assert len(arr.sharding.device_set) == 8
+
+
+def test_distributed_bandit_select_matches_single():
+    """Group-sharded UCB1 picks equal the single-device kernel exactly
+    (selection reads only each group's own stats; no collective)."""
+    from avenir_tpu.models.bandits import _ucb1_kernel
+    from avenir_tpu.parallel.distributed import distributed_bandit_select_fn
+    from avenir_tpu.parallel.mesh import data_mesh
+
+    mesh = data_mesh(jax.devices()[:4], model_parallel=1)
+    rng = np.random.default_rng(8)
+    g, a = 64, 5
+    counts = rng.integers(0, 40, (g, a)).astype(np.int32)
+    rewards = (rng.random((g, a)) * 100).astype(np.float32)
+    mask = np.ones((g, a), bool)
+    mask[:, -1] = False                      # padded arm slots
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    shard = NamedSharding(mesh, P(mesh.axis_names))
+    sel = distributed_bandit_select_fn(mesh, batch_size=3)
+    got = np.asarray(sel(jax.device_put(counts, shard),
+                         jax.device_put(rewards, shard),
+                         jax.device_put(mask, shard), 7.0))
+    ref = np.asarray(_ucb1_kernel(jnp.asarray(counts), jnp.asarray(rewards),
+                                  jnp.asarray(mask), 7.0, 100.0, 3))
+    np.testing.assert_array_equal(got, ref)
+    assert (got < a - 1).all()               # padded arm never picked
