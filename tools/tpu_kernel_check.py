@@ -143,6 +143,28 @@ def main():
               + ("" if ok else f": agree={agree:.3f}"))
         results.append(ok)
 
+    # exhausted-rounds edge: a corpus smaller than k forces the epilogue
+    # through the int32-max fill (whose label-masked bits bitcast to NaN);
+    # with a non-'none' kernel the scores must stay finite and the vote
+    # mass must equal the real-neighbor count (regression for the
+    # duplicate-count extraction fix)
+    for dtype in ("float32", "bfloat16"):
+        q = rng.normal(size=(256, 4)).astype(np.float32)
+        t3 = rng.normal(size=(3, 4)).astype(np.float32)
+        lab3 = np.array([0, 1, 1], np.int32)
+        t_pad, _, n_valid = pad_train(t3, None, 512)
+        lab_pad = np.zeros(t_pad.shape[0], np.int32)
+        lab_pad[:3] = lab3
+        scores = np.asarray(knn_classify_lanes(
+            jnp.asarray(q), jnp.asarray(t_pad), jnp.asarray(lab_pad), k=5,
+            n_classes=2, kernel_fn="gaussian", kernel_param=30.0,
+            block_q=256, block_t=512, n_valid=n_valid,
+            compute_dtype=dtype))
+        ok = bool(np.isfinite(scores).all())
+        print(f"{'PASS' if ok else 'FAIL'} fused-vote-exhausted/{dtype}"
+              + ("" if ok else ": non-finite scores"))
+        results.append(ok)
+
     # mixed categorical data through the one-hot expansion, compiled
     from avenir_tpu.models.knn import _expand_mixed
     from avenir_tpu.ops.distance import blocked_topk_neighbors
