@@ -180,7 +180,10 @@ class NeighborIndex:
             x_num, self.n_attrs = _expand_mixed(x_num, ranges, x_cat, bins,
                                                 metric)
             x_cat = None
-            self.block = max(128, min(pad_rows(len(train), 128), 8192))
+            # 256-row granularity: the lane kernel's pair-fold front end
+            # requires block_t % 256 == 0 (the exact kernel only needs
+            # 128, but a 128-odd block would crash the packed path)
+            self.block = max(256, min(pad_rows(len(train), 256), 8192))
             t_num, x_cat, n_valid = pad_train(x_num, None, self.block)
         else:
             t_num, x_cat, n_valid = pad_train(x_num, x_cat, self.block)
@@ -277,14 +280,16 @@ class NearestNeighborClassifier:
         nb_model: Optional[NaiveBayesModel] = None,
         approx: bool = False,
         fused: bool = False,
+        packed: bool = False,
     ):
         """fused=True opts into the in-kernel vote (knn_classify_lanes) for
         the non-class-conditional modes: class scores come straight out of
         the pallas kernel (distances quantized ~2^-21, ties biased toward
-        lower class codes). The default composes the exact top-k with the
-        jitted _vote."""
+        lower class codes). packed=True opts the top-k side into the
+        lane-resident packed-key kernel (NeighborIndex). The default
+        composes the exact top-k with the jitted _vote."""
         self.index = NeighborIndex(train, k=top_match_count, metric=metric,
-                                   block=block, approx=approx)
+                                   block=block, approx=approx, packed=packed)
         self.fused = fused
         self.schema = train.schema
         self.k = self.index.k

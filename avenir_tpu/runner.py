@@ -303,6 +303,10 @@ def nearest_neighbor(cfg: JobConfig, inputs: List[str], output: str) -> JobResul
         inverse_distance_weighted=cfg.get_bool("inverse.distance.weighted", False),
         decision_threshold=cfg.get_float("decision.threshold", -1.0),
         positive_class=cfg.get("positive.class.value"),
+        # framework-specific fast-path toggles (no reference analog): the
+        # lane-resident packed top-k kernel and the in-kernel fused vote
+        packed=cfg.get_bool("device.packed.kernel", False),
+        fused=cfg.get_bool("device.fused.vote", False),
     )
     out = _out_file(output)
     out_delim = cfg.field_delim

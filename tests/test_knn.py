@@ -164,3 +164,34 @@ def test_classifier_fused_path_matches_composed(monkeypatch):
     np.testing.assert_allclose(sf.sum(axis=1), sc.sum(axis=1), atol=1e-3)
     exact = (np.abs(sf - sc).max(axis=1) <= 2.0).mean()
     assert exact >= 0.95, exact
+
+
+def test_classifier_fast_path_toggles(monkeypatch):
+    """packed=True + fused=True through the REAL (interpret-mode) pallas
+    kernels on a 300-row corpus — a size whose 128-granular padding is an
+    odd multiple, which the packed path must survive (the lane kernels
+    require block_t % 256 == 0) — must match the default exact path."""
+    import functools
+
+    import avenir_tpu.ops.pallas_knn as pk
+    from avenir_tpu.data import generate_elearn
+    from avenir_tpu.models.knn import NearestNeighborClassifier
+
+    ds = generate_elearn(300, seed=6)
+    test = generate_elearn(80, seed=7)
+    base = NearestNeighborClassifier(ds, top_match_count=3,
+                                     kernel_function="gaussian",
+                                     kernel_param=30.0, metric="euclidean")
+    bp, _ = base.predict(test)
+
+    monkeypatch.setattr(pk, "pallas_available", lambda: True)
+    for name in ("knn_classify_lanes", "knn_topk_lanes", "knn_topk_pallas"):
+        monkeypatch.setattr(pk, name,
+                            functools.partial(getattr(pk, name),
+                                              interpret=True))
+    fast = NearestNeighborClassifier(ds, top_match_count=3,
+                                     kernel_function="gaussian",
+                                     kernel_param=30.0, metric="euclidean",
+                                     packed=True, fused=True)
+    fp, _ = fast.predict(test)
+    np.testing.assert_array_equal(bp, fp)
