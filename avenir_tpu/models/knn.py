@@ -187,6 +187,14 @@ class NeighborIndex:
             t_num, x_cat, n_valid = pad_train(x_num, None, self.block)
         else:
             t_num, x_cat, n_valid = pad_train(x_num, x_cat, self.block)
+        # the cap is a static property of the corpus: decide the packed
+        # routing once here, not per query (beyond the lane kernel's
+        # packed-chunk-id cap the exact kernel serves — explicit index
+        # carries, no cap)
+        if self.packed and t_num is not None:
+            from avenir_tpu.ops.pallas_knn import LANE_CORPUS_CAP
+
+            self.packed = t_num.shape[0] <= LANE_CORPUS_CAP
         self.t_num = jnp.asarray(t_num) if t_num is not None else None
         self.t_cat = jnp.asarray(x_cat) if x_cat is not None else None
         self.cat_bins = bins

@@ -198,6 +198,9 @@ def _knn_kernel_packed(q_ref, t_ref, best_d_ref, best_i_ref, *, k: int,
 
 
 _LANES = 128
+# lane-kernel corpus cap: 12 chunk-id bits (keeps distance quantization
+# <= 2^-11); callers route bigger corpora to the exact kernel
+LANE_CORPUS_CAP = _LANES * (1 << 12)
 
 
 def _lane_pack_bits(nt: int) -> int:
@@ -369,7 +372,7 @@ def knn_topk_lanes(
     pack_bits = _lane_pack_bits(nt)
     assert pack_bits <= 12, (
         f"corpus {nt} needs {pack_bits} chunk-id bits; cap is 12 "
-        f"(<= {_LANES * (1 << 12)} rows) to keep quantization <= 2^-11")
+        f"(<= {LANE_CORPUS_CAP} rows) to keep quantization <= 2^-11")
     nv = nt if n_valid is None else n_valid
     if metric == "euclidean":
         q = q * jnp.float32(-2.0)       # see _knn_kernel_lanes epilogue

@@ -195,3 +195,38 @@ def test_classifier_fast_path_toggles(monkeypatch):
                                      packed=True, fused=True)
     fp, _ = fast.predict(test)
     np.testing.assert_array_equal(bp, fp)
+    # packed WITHOUT fused: predict() must route through the packed
+    # lane top-k (fused short-circuits neighbors(), so this is the only
+    # configuration that executes knn_topk_lanes here)
+    packed_only = NearestNeighborClassifier(ds, top_match_count=3,
+                                            kernel_function="gaussian",
+                                            kernel_param=30.0,
+                                            metric="euclidean", packed=True)
+    assert packed_only.index.packed
+    pp, _ = packed_only.predict(test)
+    np.testing.assert_array_equal(bp, pp)
+
+
+def test_packed_over_corpus_cap_falls_back(monkeypatch):
+    """packed=True over a corpus beyond the lane kernel's chunk-id cap
+    must silently use the exact kernel instead of tripping its assert."""
+    import functools
+
+    import avenir_tpu.ops.pallas_knn as pk
+    from avenir_tpu.data import generate_elearn
+    from avenir_tpu.models.knn import NeighborIndex
+
+    monkeypatch.setattr(pk, "pallas_available", lambda: True)
+    monkeypatch.setattr(pk, "LANE_CORPUS_CAP", 256)      # tiny cap for test
+    monkeypatch.setattr(pk, "knn_topk_pallas",
+                        functools.partial(pk.knn_topk_pallas,
+                                          interpret=True))
+    def _boom(*a, **k):
+        raise AssertionError("lane kernel must not be called over the cap")
+    monkeypatch.setattr(pk, "knn_topk_lanes", _boom)
+
+    idx = NeighborIndex(generate_elearn(600, seed=9), k=3,
+                        metric="euclidean", packed=True)
+    d, i = idx.neighbors(generate_elearn(64, seed=10))
+    import numpy as np
+    assert np.isfinite(np.asarray(d)).all()
