@@ -174,3 +174,45 @@ class TestByteRangeSplits:
         with pytest.raises(ValueError):
             CsvBlockReader(churn_csv["csv"], churn_schema(),
                            byte_range=(10, 5))
+
+
+def test_deferred_accumulator_flush_bound_crossing():
+    """Exactness across mid-stream flushes: shrink the per-cell f32/int32
+    flush bounds so a chunked accumulate(defer=True) run crosses them
+    repeatedly; final counts must equal the one-shot fit exactly (the
+    contract the 1B-row bench path relies on)."""
+    from avenir_tpu.data import churn_schema, generate_churn
+    from avenir_tpu.models.naive_bayes import NaiveBayesModel
+
+    schema = churn_schema()
+    ds = generate_churn(4000, seed=41)
+    codes, bins = ds.feature_codes(NaiveBayesModel.empty(schema).binned_fields)
+    labels = ds.labels()
+    x_cont = np.zeros((len(ds), 0), np.float32)
+
+    oneshot = NaiveBayesModel.empty(schema)
+    oneshot.accumulate(codes, labels, x_cont)
+
+    for weighted in (False, True):
+        m = NaiveBayesModel.empty(schema)
+        m._FLUSH_ROWS = 700          # instance override: force crossings
+        m._FLUSH_ROWS_INT = 700
+        w = np.ones(len(ds), np.float32) if weighted else None
+        for s in range(0, len(ds), 500):
+            m.accumulate(codes[s:s + 500], labels[s:s + 500],
+                         x_cont[s:s + 500],
+                         weights=None if w is None else w[s:s + 500],
+                         defer=True)
+            if s == 1500 and weighted:
+                # mode switch mid-stream (int <-> f32 accumulator) must
+                # flush the pending counts, not drop them
+                m.accumulate(codes[s + 500:s + 600], labels[s + 500:s + 600],
+                             x_cont[s + 500:s + 600], defer=True)
+        m.flush()
+        # the weighted run double-adds rows 2000:2100 via the mode switch
+        extra = 100 if weighted else 0
+        assert m.class_counts.sum() == len(ds) + extra
+        if not weighted:
+            np.testing.assert_array_equal(m.post_counts, oneshot.post_counts)
+            np.testing.assert_array_equal(m.class_counts,
+                                          oneshot.class_counts)
