@@ -181,12 +181,11 @@ def test_deferred_accumulator_flush_bound_crossing():
     flush bounds so a chunked accumulate(defer=True) run crosses them
     repeatedly; final counts must equal the one-shot fit exactly (the
     contract the 1B-row bench path relies on)."""
-    from avenir_tpu.data import churn_schema, generate_churn
     from avenir_tpu.models.naive_bayes import NaiveBayesModel
 
     schema = churn_schema()
     ds = generate_churn(4000, seed=41)
-    codes, bins = ds.feature_codes(NaiveBayesModel.empty(schema).binned_fields)
+    codes, _ = ds.feature_codes(NaiveBayesModel.empty(schema).binned_fields)
     labels = ds.labels()
     x_cont = np.zeros((len(ds), 0), np.float32)
 
@@ -203,13 +202,15 @@ def test_deferred_accumulator_flush_bound_crossing():
                          x_cont[s:s + 500],
                          weights=None if w is None else w[s:s + 500],
                          defer=True)
-            if s == 1500 and weighted:
+            if s == 1000 and weighted:
+                # pending f32 rows exist here (500 since the last flush):
+                # the f32 -> int mode switch must FLUSH them, not drop
                 # mode switch mid-stream (int <-> f32 accumulator) must
                 # flush the pending counts, not drop them
                 m.accumulate(codes[s + 500:s + 600], labels[s + 500:s + 600],
                              x_cont[s + 500:s + 600], defer=True)
         m.flush()
-        # the weighted run double-adds rows 2000:2100 via the mode switch
+        # the weighted run double-adds rows 1500:1600 via the mode switch
         extra = 100 if weighted else 0
         assert m.class_counts.sum() == len(ds) + extra
         if not weighted:
