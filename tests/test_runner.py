@@ -578,3 +578,23 @@ def test_every_reference_tool_class_is_addressable():
                     jobs.add(f"{pkg.group(1)}.{f.rsplit('.', 1)[0]}")
     missing = sorted(j for j in jobs if j not in _REGISTRY)
     assert not missing, f"unaddressable reference job classes: {missing}"
+
+
+def test_all_jobs_fail_crisply_on_empty_config():
+    """Every registered job confronted with an empty config and a missing
+    input must raise a deliberate error (missing-config naming the
+    prefixed key, missing file, or a validation ValueError) — never a raw
+    TypeError/IndexError/AttributeError from deep inside."""
+    import tempfile
+
+    from avenir_tpu.core.config import MissingConfigError
+    from avenir_tpu.runner import _REGISTRY
+
+    crisp = (MissingConfigError, FileNotFoundError, ValueError)
+    d = tempfile.mkdtemp()
+    for name in sorted({c for c, _, _ in _REGISTRY.values()}):
+        if name.startswith("_"):
+            continue                     # test-registered fixtures
+        with pytest.raises(crisp):
+            run_job(name, {}, [os.path.join(d, "nope.csv")],
+                    os.path.join(d, "out"))
