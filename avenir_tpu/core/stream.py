@@ -191,3 +191,46 @@ def stream_job_inputs(cfg, inputs: Iterable[str], schema: FeatureSchema,
     for path in inputs:
         yield from prefetched(iter_csv_chunks(
             path, schema, cfg.field_delim_regex, block, keep_raw=keep_raw))
+
+
+def iter_line_blocks(path: str,
+                     block_bytes: int = DEFAULT_BLOCK_BYTES
+                     ) -> Iterator[list]:
+    """Yield lists of non-empty text lines, ~block_bytes of file each.
+
+    The untyped-row analog of CsvBlockReader for jobs whose input is not
+    schema-typed CSV (sequence files, transaction lists, free text): the
+    reference streams those one line at a time through the same mapper
+    contract (e.g. markov/MarkovStateTransitionModel.java:116-133,
+    association/FrequentItemsApriori.java:138-150); here the unit is a
+    block of lines, so host RSS stays O(block) however large the file."""
+    if not os.path.exists(path):
+        raise FileNotFoundError(f"no such input file: {path!r}")
+    with open(path, "rb") as fh:
+        carry = b""
+        while True:
+            block = fh.read(block_bytes)
+            if not block:
+                break
+            data = carry + block
+            cut = data.rfind(b"\n")
+            if cut < 0:
+                carry = data
+                continue
+            carry = data[cut + 1:]
+            lines = data[:cut].decode("utf-8", "replace").split("\n")
+            lines = [ln.rstrip("\r") for ln in lines if ln.strip()]
+            if lines:
+                yield lines
+        if carry.strip():
+            yield [ln.rstrip("\r")
+                   for ln in carry.decode("utf-8", "replace").split("\n")
+                   if ln.strip()]
+
+
+def stream_job_lines(cfg, inputs: Iterable[str]) -> Iterator[list]:
+    """Prefetched line blocks of every input path, sized by the same
+    `stream.block.size.mb` key as stream_job_inputs."""
+    block = int(cfg.get_float("stream.block.size.mb", 64.0) * (1 << 20))
+    for path in inputs:
+        yield from prefetched(iter_line_blocks(path, block))

@@ -108,6 +108,95 @@ class TransactionSet:
         return self.multihot.shape[0]
 
 
+class StreamingTransactionSource:
+    """Re-iterable chunked transaction reader for unbounded-size mining.
+
+    Apriori is inherently multi-pass — the reference runs one MR job per
+    itemset length k over the same HDFS input
+    (FrequentItemsApriori.java:123-126) — so streaming means each k-pass
+    re-scans the file at O(block) host RSS instead of holding the [N, V]
+    multi-hot matrix. Pass 1 (scan_items) freezes the item vocabulary and
+    per-item supports; chunks() then yields fixed-row-count multi-hot
+    blocks encoded against that frozen vocabulary, zero-padded so the
+    device counting kernel compiles once."""
+
+    def __init__(self, paths: Sequence[str], delim: str = ",",
+                 trans_id_ord: int = 0, skip_field_count: int = 1,
+                 marker: Optional[str] = None,
+                 block_bytes: int = 64 << 20):
+        self.paths = list(paths)
+        self.delim = delim
+        self.trans_id_ord = trans_id_ord
+        self.skip = skip_field_count
+        self.marker = marker
+        self.block_bytes = block_bytes
+        self.vocab: List[str] = []
+        self.index: Dict[str, int] = {}
+        self.n_trans = 0
+        self._item_counts: Optional[np.ndarray] = None
+
+    def _row_blocks(self):
+        from avenir_tpu.core.stream import iter_line_blocks, prefetched
+
+        for path in self.paths:
+            for lines in prefetched(
+                    iter_line_blocks(path, self.block_bytes)):
+                yield [[t.strip() for t in ln.split(self.delim)]
+                       for ln in lines]
+
+    def scan_items(self) -> Tuple[List[str], np.ndarray, int]:
+        """Pass 1: (vocab, per-item transaction counts, n_trans). An item
+        repeated within one transaction counts once (multi-hot algebra)."""
+        if self._item_counts is not None:
+            return self.vocab, self._item_counts, self.n_trans
+        counts: List[int] = []
+        for rows in self._row_blocks():
+            for row in rows:
+                self.n_trans += 1
+                seen = set()
+                for tok in row[self.skip:]:
+                    if tok == "" or tok == self.marker:
+                        continue
+                    i = self.index.get(tok)
+                    if i is None:
+                        i = len(self.vocab)
+                        self.index[tok] = i
+                        self.vocab.append(tok)
+                        counts.append(0)
+                    seen.add(i)
+                for i in seen:
+                    counts[i] += 1
+        self._item_counts = np.asarray(counts, np.int64)
+        return self.vocab, self._item_counts, self.n_trans
+
+    def chunks(self, block_rows: int = 8192, with_ids: bool = False):
+        """Yield (multihot uint8 [block_rows, V], ids) blocks; the final
+        block zero-pads its row tail (an all-zero row contains no k>=1
+        candidate, so it never counts)."""
+        V = max(len(self.vocab), 1)
+
+        def emit(rows):
+            mh = np.zeros((block_rows, V), np.uint8)
+            ids = []
+            for r, row in enumerate(rows):
+                if with_ids:
+                    ids.append(row[self.trans_id_ord])
+                for tok in row[self.skip:]:
+                    i = self.index.get(tok)
+                    if i is not None:
+                        mh[r, i] = 1
+            return mh, ids
+
+        buf: List[List[str]] = []
+        for rows in self._row_blocks():
+            buf.extend(rows)
+            while len(buf) >= block_rows:
+                yield emit(buf[:block_rows])
+                buf = buf[block_rows:]
+        if buf:
+            yield emit(buf)
+
+
 # --------------------------------------------------------------------------
 # Itemset containers (the between-rounds file state)
 # --------------------------------------------------------------------------
@@ -297,6 +386,74 @@ class FrequentItemsApriori:
             freq_ids = [c for c, _ in kept]
             out.append(self._pack(tx, freq_ids, k, [cnt for _, cnt in kept]))
         return out
+
+    def mine_stream(self, src: StreamingTransactionSource
+                    ) -> List[ItemSetList]:
+        """mine() at unbounded input size: one streamed scan per itemset
+        length k (the reference's one-MR-job-per-k driver loop,
+        FrequentItemsApriori.java:123-126), support counts folded across
+        fixed-shape multi-hot blocks so host RSS stays O(block)."""
+        vocab, col_counts, n = src.scan_items()
+        min_count = self.support_threshold * n
+        out: List[ItemSetList] = []
+
+        freq_ids: List[Tuple[int, ...]] = [
+            (i,) for i in range(len(vocab)) if col_counts[i] > min_count
+        ]
+        out.append(self._pack_stream(
+            src, freq_ids, 1, [int(col_counts[i]) for (i,) in freq_ids]))
+
+        for k in range(2, self.max_length + 1):
+            cands = _generate_candidates(freq_ids, k)
+            if not cands:
+                break
+            c_pad = max(64, 1 << (len(cands) - 1).bit_length())
+            cand_rows = np.zeros((c_pad, max(len(vocab), 1)), np.float32)
+            for ci, items in enumerate(cands):
+                cand_rows[ci, list(items)] = 1.0
+            cand_d = jnp.asarray(cand_rows)
+            counts = np.zeros(c_pad, np.int64)
+            for mh, _ in src.chunks(self.block):
+                counts += np.asarray(_contain_counts(
+                    jnp.asarray(mh, dtype=jnp.float32), cand_d, k), np.int64)
+            kept = [(c, int(cnt)) for c, cnt in zip(cands, counts[:len(cands)])
+                    if cnt > min_count]
+            if not kept:
+                break
+            freq_ids = [c for c, _ in kept]
+            out.append(self._pack_stream(
+                src, freq_ids, k, [cnt for _, cnt in kept]))
+        return out
+
+    def _pack_stream(self, src: StreamingTransactionSource,
+                     freq_ids: List[Tuple[int, ...]], k: int,
+                     counts: List[int]) -> ItemSetList:
+        if not freq_ids:
+            return ItemSetList(k, [])
+        n = src.n_trans
+        tids: Optional[List[List[str]]] = None
+        if self.emit_trans_id:
+            # one extra streamed pass over the KEPT sets only: exact
+            # per-set transaction id lists (fia.emit.trans.id)
+            c_pad = max(64, 1 << (len(freq_ids) - 1).bit_length())
+            cand_rows = np.zeros((c_pad, max(len(src.vocab), 1)), np.float32)
+            for ci, items in enumerate(freq_ids):
+                cand_rows[ci, list(items)] = 1.0
+            cand_d = jnp.asarray(cand_rows)
+            tids = [[] for _ in freq_ids]
+            for mh, ids in src.chunks(self.block, with_ids=True):
+                m = np.asarray(_contain_mask(
+                    jnp.asarray(mh, dtype=jnp.float32), cand_d, k))
+                for ci in range(len(freq_ids)):
+                    for r in np.flatnonzero(m[:len(ids), ci]):
+                        tids[ci].append(str(ids[r]))
+        sets = []
+        for ci, ids_t in enumerate(freq_ids):
+            tokens = tuple(sorted(src.vocab[i] for i in ids_t))
+            sets.append(ItemSet(tokens, counts[ci] / n, int(counts[ci]),
+                                tids[ci] if tids is not None else None))
+        sets.sort(key=lambda s: s.items)
+        return ItemSetList(k, sets)
 
     def _pack(self, tx: TransactionSet, freq_ids: List[Tuple[int, ...]],
               k: int, counts: List[int]) -> ItemSetList:

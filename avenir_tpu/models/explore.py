@@ -17,7 +17,6 @@ from dataclasses import dataclass
 from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
-import jax
 import jax.numpy as jnp
 
 from avenir_tpu.core.dataset import Dataset
@@ -28,6 +27,20 @@ from avenir_tpu.ops.infotheory import (bits_entropy, entropy, gini,
 from avenir_tpu.ops.reduce import cross_count
 
 _EPS = 1e-12
+
+
+def _padded_add(acc: Optional[np.ndarray], new: np.ndarray) -> np.ndarray:
+    """acc + new where either may be smaller along any axis (growing
+    data-discovered vocabularies); missing cells are zero counts."""
+    if acc is None:
+        return new
+    if acc.shape == new.shape:
+        return acc + new
+    shape = tuple(max(a, b) for a, b in zip(acc.shape, new.shape))
+    out = np.zeros(shape, np.float64)
+    out[tuple(slice(0, s) for s in acc.shape)] += acc
+    out[tuple(slice(0, s) for s in new.shape)] += new
+    return out
 
 
 # ---------------------------------------------------------------------------
@@ -50,45 +63,89 @@ class MutualInformationAnalyzer:
     MI values are in nats (reference uses log base e via Math.log).
     """
 
-    def __init__(self, ds: Dataset):
+    def __init__(self, ds: Optional[Dataset] = None):
         self.ds = ds
-        codes, bins = ds.feature_codes()
-        self.fields = ds.encodable_feature_fields()
-        self.bins = bins
-        self.codes = codes
-        self.labels = ds.labels()
-        self.k = ds.schema.num_classes()
-        self.n = len(ds)
-        self._compute()
+        self.fields: Optional[List[FeatureField]] = None
+        self.bins: List[int] = []
+        self.k = 0
+        self.n = 0
+        self._fc: List[np.ndarray] = []            # per f: [Bf, K]
+        self._pair: Dict[Tuple[int, int], np.ndarray] = {}   # [Bi, Bj]
+        self._pairc: Dict[Tuple[int, int], np.ndarray] = {}  # [Bi, Bj, K]
+        if ds is not None:
+            self.add(ds)
+            self.finalize()
 
-    def _compute(self):
-        codes_d = jnp.asarray(self.codes)
-        y = jnp.asarray(self.labels)
+    @classmethod
+    def from_chunks(cls, chunks) -> "MutualInformationAnalyzer":
+        """Build from streamed Dataset chunks: every distribution the
+        reducer held (MutualInformation.java:138-216) is an additive count
+        tensor, so folding per-chunk cross_counts yields bit-identical
+        tables to the whole-file pass at O(chunk) host RSS."""
+        self = cls()
+        for ds in chunks:
+            self.add(ds)
+        if self.fields is None:
+            raise ValueError("no input chunks")
+        self.finalize()
+        return self
+
+    def add(self, ds: Dataset) -> None:
+        """Fold one chunk's contingency counts into the running tables.
+        Data-discovered categorical vocabularies may extend between chunks
+        (the shared-schema contract of CsvBlockReader); accumulated tables
+        zero-pad along the grown bin axes."""
+        if self.fields is None:
+            self.fields = ds.encodable_feature_fields()
+            self.k = ds.schema.num_classes()
+            F = len(self.fields)
+            self.bins = [0] * F
+            self._fc = [np.zeros((0, self.k)) for _ in range(F)]
+        codes, bins = ds.feature_codes(self.fields)
+        codes_d = jnp.asarray(codes)
+        y = jnp.asarray(ds.labels())
+        F = len(self.fields)
+        self.bins = [max(a, b) for a, b in zip(self.bins, bins)]
+        for f in range(F):
+            joint = np.asarray(
+                cross_count(codes_d[:, f], y, bins[f], self.k), np.float64)
+            self._fc[f] = _padded_add(self._fc[f], joint)
+        for i in range(F):
+            for j in range(i + 1, F):
+                bi, bj = bins[i], bins[j]
+                joint_ij = np.asarray(
+                    cross_count(codes_d[:, i], codes_d[:, j], bi, bj),
+                    np.float64)
+                self._pair[(i, j)] = _padded_add(
+                    self._pair.get((i, j)), joint_ij)
+                # combined code (i,j) vs class
+                comb = codes_d[:, i] * bj + codes_d[:, j]
+                joint_ijc = np.asarray(
+                    cross_count(comb, y, bi * bj, self.k),
+                    np.float64).reshape(bi, bj, self.k)
+                self._pairc[(i, j)] = _padded_add(
+                    self._pairc.get((i, j)), joint_ijc)
+        self.n += len(ds)
+
+    def finalize(self) -> None:
+        """Derive all MI statistics from the accumulated count tables."""
         F = len(self.bins)
         self.feature_class_mi = np.zeros(F)
         self.pair_mi = np.zeros((F, F))
         self.pair_class_mi = np.zeros((F, F))
         self.pair_class_entropy = np.zeros((F, F))
-
-        # feature-class MI: I(Xf; C) from [Bf, K] contingency
         for f in range(F):
-            joint = cross_count(codes_d[:, f], y, self.bins[f], self.k)
-            self.feature_class_mi[f] = float(mutual_information(joint))
-
-        # pair MI I(Xi; Xj) and pair-class I((Xi,Xj); C), H(Xi,Xj,C)
-        for i in range(F):
-            for j in range(i + 1, F):
-                bi, bj = self.bins[i], self.bins[j]
-                joint_ij = cross_count(codes_d[:, i], codes_d[:, j], bi, bj)
-                mi_ij = float(mutual_information(joint_ij))
-                self.pair_mi[i, j] = self.pair_mi[j, i] = mi_ij
-                # combined code (i,j) vs class
-                comb = codes_d[:, i] * bj + codes_d[:, j]
-                joint_ijc = cross_count(comb, y, bi * bj, self.k)
-                mic = float(mutual_information(joint_ijc))
-                self.pair_class_mi[i, j] = self.pair_class_mi[j, i] = mic
-                h = float(entropy(jnp.asarray(joint_ijc).reshape(-1), axis=-1))
-                self.pair_class_entropy[i, j] = self.pair_class_entropy[j, i] = h
+            self.feature_class_mi[f] = float(
+                mutual_information(jnp.asarray(self._fc[f])))
+        for (i, j), joint_ij in self._pair.items():
+            mi_ij = float(mutual_information(jnp.asarray(joint_ij)))
+            self.pair_mi[i, j] = self.pair_mi[j, i] = mi_ij
+        for (i, j), joint_ijc in self._pairc.items():
+            flat = jnp.asarray(joint_ijc.reshape(-1, self.k))
+            mic = float(mutual_information(flat))
+            self.pair_class_mi[i, j] = self.pair_class_mi[j, i] = mic
+            h = float(entropy(flat.reshape(-1), axis=-1))
+            self.pair_class_entropy[i, j] = self.pair_class_entropy[j, i] = h
 
     # ------------------------------------------------------------- scores
     def _ordinals(self) -> List[int]:
@@ -285,43 +342,109 @@ def cramer_index(table: np.ndarray) -> float:
     return chi2 / denom
 
 
+class ContingencyAccumulator:
+    """Streaming per-field feature-value x class contingency tables.
+
+    The whole correlation family (Cramér, categorical, heterogeneity
+    reduction) is a function of these [B, K] tables, and the tables are
+    additive over records — the reference's mapper/combiner/reducer count
+    algebra (CramerCorrelation.java:54) at chunk granularity. The bin axis
+    grows in place as data-discovered vocabularies extend between chunks."""
+
+    def __init__(self):
+        self.fields: Optional[List[FeatureField]] = None
+        self.tables: Dict[int, np.ndarray] = {}      # ordinal -> [B, K]
+        self.class_counts: Optional[np.ndarray] = None
+        self.k = 0
+        self.n = 0
+
+    def add(self, ds: Dataset) -> None:
+        if self.fields is None:
+            self.fields = [f for f in ds.schema.feature_fields
+                           if f.num_bins() > 0]
+            self.k = ds.schema.num_classes()
+            self.class_counts = np.zeros(self.k, np.float64)
+        y = ds.labels()
+        self.class_counts += np.bincount(y, minlength=self.k)
+        if self.fields:
+            codes, bins = ds.feature_codes(self.fields)
+            codes_d = jnp.asarray(codes)
+            yd = jnp.asarray(y)
+            for i, f in enumerate(self.fields):
+                tab = np.asarray(
+                    cross_count(codes_d[:, i], yd, bins[i], self.k),
+                    np.float64)
+                self.tables[f.ordinal] = _padded_add(
+                    self.tables.get(f.ordinal), tab)
+        self.n += len(ds)
+
+    def cramer(self) -> Dict[int, float]:
+        return {o: cramer_index(t) for o, t in sorted(self.tables.items())}
+
+    def heterogeneity(self, algo: str = "entropy") -> Dict[int, float]:
+        imp_fn = bits_entropy if algo == "entropy" else gini
+        base = float(np.asarray(imp_fn(jnp.asarray(self.class_counts))))
+        out = {}
+        for o, tab in sorted(self.tables.items()):
+            seg_tot = tab.sum(axis=1)
+            seg_imp = np.asarray(imp_fn(jnp.asarray(tab), axis=-1))
+            cond = float((seg_tot / max(seg_tot.sum(), _EPS) * seg_imp).sum())
+            out[o] = (base - cond) / max(base, _EPS)
+        return out
+
+
+class NumericMomentAccumulator:
+    """Streaming Pearson moments (n, sum, cross-products) over the numeric
+    features + numeric-coded class (NumericalCorrelation.java:48). The
+    correlation matrix from raw moments equals np.corrcoef's (the
+    normalization factor cancels in the ratio)."""
+
+    def __init__(self):
+        self.n = 0
+        self.s: Optional[np.ndarray] = None
+        self.ss: Optional[np.ndarray] = None
+
+    def add(self, ds: Dataset) -> None:
+        x = ds.feature_matrix()
+        y = ds.labels().astype(np.float32)[:, None]
+        m = np.concatenate([x, y], axis=1).astype(np.float64)
+        if self.s is None:
+            d = m.shape[1]
+            self.s = np.zeros(d, np.float64)
+            self.ss = np.zeros((d, d), np.float64)
+        self.n += m.shape[0]
+        self.s += m.sum(axis=0)
+        self.ss += m.T @ m
+
+    def correlation(self) -> np.ndarray:
+        mean = self.s / max(self.n, 1)
+        cov = self.ss / max(self.n, 1) - np.outer(mean, mean)
+        sd = np.sqrt(np.clip(np.diag(cov), _EPS, None))
+        return cov / np.outer(sd, sd)
+
+
 def cramer_correlation(ds: Dataset) -> Dict[int, float]:
     """Per-categorical-feature Cramér index against the class attribute."""
-    return {
-        f.ordinal: cramer_index(contingency(ds, f))
-        for f in ds.schema.feature_fields if f.num_bins() > 0
-    }
+    acc = ContingencyAccumulator()
+    acc.add(ds)
+    return acc.cramer()
 
 
 def heterogeneity_reduction(ds: Dataset, algo: str = "entropy") -> Dict[int, float]:
     """Proportional impurity reduction of the class by each feature
     (HeterogeneityReductionCorrelation.java:38):
     (imp(C) - sum_b p(b) imp(C|b)) / imp(C)."""
-    imp_fn = bits_entropy if algo == "entropy" else gini
-    y = jnp.asarray(ds.labels())
-    k = ds.schema.num_classes()
-    class_counts = np.asarray(jax.ops.segment_sum(
-        jnp.ones_like(y, dtype=jnp.float32), y, num_segments=k))
-    base = float(np.asarray(imp_fn(jnp.asarray(class_counts))))
-    out = {}
-    for f in ds.schema.feature_fields:
-        if f.num_bins() <= 0:
-            continue
-        tab = contingency(ds, f)                      # [B, K]
-        seg_tot = tab.sum(axis=1)
-        seg_imp = np.asarray(imp_fn(jnp.asarray(tab), axis=-1))
-        cond = float((seg_tot / max(seg_tot.sum(), _EPS) * seg_imp).sum())
-        out[f.ordinal] = (base - cond) / max(base, _EPS)
-    return out
+    acc = ContingencyAccumulator()
+    acc.add(ds)
+    return acc.heterogeneity(algo)
 
 
 def numerical_correlation(ds: Dataset) -> np.ndarray:
     """Pearson correlation matrix over numeric features + numeric-coded
     class, via a single moment pass (NumericalCorrelation.java:48)."""
-    x = ds.feature_matrix()
-    y = ds.labels().astype(np.float32)[:, None]
-    m = np.concatenate([x, y], axis=1)
-    return np.corrcoef(m, rowvar=False)
+    acc = NumericMomentAccumulator()
+    acc.add(ds)
+    return acc.correlation()
 
 
 # ---------------------------------------------------------------------------

@@ -93,11 +93,42 @@ class MarkovStateTransitionModel:
         else:
             y = np.zeros(len(seqs), np.int32)
             k = 1
+        # round the class/entity axis up to a power-of-2 bucket so the
+        # jitted kernel's executable is reused while streaming ingest
+        # grows the entity set chunk by chunk (fit_entities)
+        k_pad = max(1, 1 << (k - 1).bit_length())
         self.counts += np.asarray(
             _bigram_counts(jnp.asarray(padded), jnp.asarray(y),
-                           len(self.states), k)
-        )
+                           len(self.states), k_pad)
+        )[:k]
         return self
+
+    def fit_entities(self, seqs: Sequence[Sequence[str]],
+                     entity_keys: Sequence[str]) -> "MarkovStateTransitionModel":
+        """Per-entity accumulate that grows the label axis in place — the
+        streaming mode of the Spark multi-tenant job
+        (MarkovStateTransitionModel.scala:51-52): unseen entity keys extend
+        class_labels and zero-pad counts, so chunked ingest needs no
+        up-front entity scan and preserves first-seen entity order."""
+        if self.class_labels is None:
+            if self.counts.any():
+                raise ValueError(
+                    "fit_entities cannot follow unlabeled fit() counts")
+            self.class_labels = []
+            self.counts = np.zeros((0,) + self.counts.shape[1:], np.float64)
+        if not len(seqs):
+            return self
+        seen = set(self.class_labels)
+        new = []
+        for key in entity_keys:
+            if key not in seen:
+                seen.add(key)
+                new.append(key)
+        if new:
+            self.class_labels.extend(new)
+            self.counts = np.pad(self.counts,
+                                 ((0, len(new)), (0, 0), (0, 0)))
+        return self.fit(seqs, entity_keys)
 
     def matrix(self, class_label: Optional[str] = None,
                scaled: bool = True) -> np.ndarray:

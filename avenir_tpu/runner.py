@@ -25,6 +25,7 @@ from __future__ import annotations
 import json
 import math
 import os
+import sys
 from dataclasses import dataclass, field
 from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
@@ -134,17 +135,22 @@ def _read_lines(path: str) -> List[str]:
         return [ln.rstrip("\n") for ln in fh if ln.strip()]
 
 
-def _read_sequences(path: str, delim: str, skip: int,
-                    class_ord: Optional[int] = None):
+def _parse_sequences(lines: Sequence[str], delim: str, skip: int,
+                     class_ord: Optional[int] = None):
     """Rows -> (ids, sequences, labels). First `skip` fields are meta
     (id/class); `class_ord` points into the full row."""
     ids, seqs, labels = [], [], []
-    for ln in _read_lines(path):
+    for ln in lines:
         toks = [t.strip() for t in ln.split(delim)]
         ids.append(toks[0] if skip > 0 else "")
         labels.append(toks[class_ord] if class_ord is not None else None)
         seqs.append(toks[skip:])
     return ids, seqs, labels
+
+
+def _read_sequences(path: str, delim: str, skip: int,
+                    class_ord: Optional[int] = None):
+    return _parse_sequences(_read_lines(path), delim, skip, class_ord)
 
 
 def _validate(class_values: Sequence[str], actual: np.ndarray,
@@ -726,10 +732,18 @@ def state_transition_rate_job(cfg: JobConfig, inputs: List[str],
 # ==================================================================== explore
 @job("mutualInformation", "mut", "org.avenir.explore.MutualInformation")
 def mutual_information_job(cfg: JobConfig, inputs: List[str], output: str) -> JobResult:
+    from avenir_tpu.core.stream import stream_job_inputs
     from avenir_tpu.models.explore import MutualInformationAnalyzer
 
-    ds = _dataset(inputs[0], cfg)
-    mi = MutualInformationAnalyzer(ds)
+    # block streaming: MI's count tables fold additively per chunk, so
+    # host RSS stays O(block) at any input size (the mapper contract of
+    # MutualInformation.java:138-216)
+    try:
+        mi = MutualInformationAnalyzer.from_chunks(
+            stream_job_inputs(cfg, inputs, _schema(cfg)))
+    except ValueError as e:
+        raise ValueError(f"mutualInformation: empty input "
+                         f"(no records in {inputs})") from e
     algos = cfg.get_list("mutual.info.score.algorithms", [])
     out = _out_file(output)
     delim = cfg.field_delim
@@ -744,7 +758,7 @@ def mutual_information_job(cfg: JobConfig, inputs: List[str], output: str) -> Jo
             for ordinal, s in scores:
                 fh.write(f"{algo}{delim}{ordinal}{delim}{s:.6f}\n")
     return JobResult("mutualInformation",
-                     {"Basic:Records": len(ds)}, [out], mi)
+                     {"Basic:Records": mi.n}, [out], mi)
 
 
 @job("ruleEvaluator", "rue", "org.avenir.explore.RuleEvaluator")
@@ -780,11 +794,16 @@ def rule_evaluator(cfg: JobConfig, inputs: List[str], output: str) -> JobResult:
 def cramer_job(cfg: JobConfig, inputs: List[str], output: str) -> JobResult:
     """Cramér-index categorical<->class correlation (crc.*); the cac.* job
     computes the same contingency-table stat (CramerCorrelation.java:54)."""
-    from avenir_tpu.models.explore import cramer_correlation
+    from avenir_tpu.core.stream import stream_job_inputs
+    from avenir_tpu.models.explore import ContingencyAccumulator
 
     name = cfg.props.get("__job_name__", "cramerCorrelation")
-    ds = _dataset(inputs[0], cfg)
-    corr = cramer_correlation(ds)
+    acc = ContingencyAccumulator()
+    for chunk in stream_job_inputs(cfg, inputs, _schema(cfg)):
+        acc.add(chunk)
+    if acc.n == 0:
+        raise ValueError(f"{name}: empty input (no records in {inputs})")
+    corr = acc.cramer()
     out = _out_file(output)
     delim = cfg.field_delim
     with open(out, "w") as fh:
@@ -796,11 +815,16 @@ def cramer_job(cfg: JobConfig, inputs: List[str], output: str) -> JobResult:
 @job("heterogeneityReduction", "hrc",
      "org.avenir.explore.HeterogeneityReductionCorrelation")
 def heterogeneity_job(cfg: JobConfig, inputs: List[str], output: str) -> JobResult:
-    from avenir_tpu.models.explore import heterogeneity_reduction
+    from avenir_tpu.core.stream import stream_job_inputs
+    from avenir_tpu.models.explore import ContingencyAccumulator
 
-    ds = _dataset(inputs[0], cfg)
-    corr = heterogeneity_reduction(
-        ds, algo=cfg.get("heterogeneity.algorithm", "entropy"))
+    acc = ContingencyAccumulator()
+    for chunk in stream_job_inputs(cfg, inputs, _schema(cfg)):
+        acc.add(chunk)
+    if acc.n == 0:
+        raise ValueError(f"heterogeneityReduction: empty input "
+                         f"(no records in {inputs})")
+    corr = acc.heterogeneity(cfg.get("heterogeneity.algorithm", "entropy"))
     out = _out_file(output)
     delim = cfg.field_delim
     with open(out, "w") as fh:
@@ -812,11 +836,18 @@ def heterogeneity_job(cfg: JobConfig, inputs: List[str], output: str) -> JobResu
 @job("numericalCorrelation", "nuc",
      "org.avenir.explore.NumericalCorrelation")
 def numerical_corr_job(cfg: JobConfig, inputs: List[str], output: str) -> JobResult:
-    from avenir_tpu.models.explore import numerical_correlation
+    from avenir_tpu.core.stream import stream_job_inputs
+    from avenir_tpu.models.explore import NumericMomentAccumulator
 
-    ds = _dataset(inputs[0], cfg)
-    corr = numerical_correlation(ds)   # [D+1, D+1]: class is the last column
-    fields = [f.ordinal for f in ds.schema.feature_fields if f.is_numeric]
+    schema = _schema(cfg)
+    acc = NumericMomentAccumulator()
+    for chunk in stream_job_inputs(cfg, inputs, schema):
+        acc.add(chunk)
+    if acc.n == 0:
+        raise ValueError(f"numericalCorrelation: empty input "
+                         f"(no records in {inputs})")
+    corr = acc.correlation()           # [D+1, D+1]: class is the last column
+    fields = [f.ordinal for f in schema.feature_fields if f.is_numeric]
     out = _out_file(output)
     delim = cfg.field_delim
     with open(out, "w") as fh:
@@ -1132,21 +1163,45 @@ def sequence_generator_job(cfg: JobConfig, inputs: List[str],
 def apriori_job(cfg: JobConfig, inputs: List[str], output: str) -> JobResult:
     """All k-rounds internal; per-k itemset files written like the
     reference's per-round outputs (FrequentItemsApriori.java:123-126)."""
-    from avenir_tpu.models.association import FrequentItemsApriori, TransactionSet
+    from avenir_tpu.models.association import (FrequentItemsApriori,
+                                               StreamingTransactionSource,
+                                               TransactionSet)
 
-    delim = cfg.field_delim_regex
-    skip = cfg.get_int("skip.field.count", 1)
-    rows = [[t.strip() for t in ln.split(delim)]
-            for path in inputs for ln in _read_lines(path)]
-    tset = TransactionSet.from_rows(
-        rows, trans_id_ord=cfg.get_int("tans.id.ord", 0),
-        skip_field_count=skip,
-        marker=cfg.get("infreq.item.marker"))
     miner = FrequentItemsApriori(
         support_threshold=cfg.assert_float("support.threshold"),
         max_length=cfg.get_int("item.set.length", 3),
+        emit_trans_id=cfg.get_bool("emit.trans.id", False),
     )
-    levels = miner.mine(tset)
+    trans_id_ord = cfg.get_int("tans.id.ord", 0)
+    skip = cfg.get_int("skip.field.count", 1)
+    marker = cfg.get("infreq.item.marker")
+    total_bytes = sum(os.path.getsize(p) for p in inputs
+                      if os.path.exists(p))
+    in_ram = (cfg.get("stream.block.size.mb") is None
+              and total_bytes < (256 << 20))
+    if in_ram:
+        rows = [[t.strip() for t in ln.split(cfg.field_delim_regex)]
+                for path in inputs for ln in _read_lines(path)]
+        # the in-RAM cost is the [N, V] multi-hot matrix, which can dwarf
+        # the file bytes for a wide item catalog — gate on its footprint
+        vocab = {tok for row in rows for tok in row[skip:]
+                 if tok and tok != marker}
+        in_ram = len(rows) * max(len(vocab), 1) < (2 << 30)
+    if in_ram:
+        # in-RAM input: one upload, device-resident across all k rounds
+        # (_contain_counts_resident — one dispatch per k, not per block)
+        levels = miner.mine(TransactionSet.from_rows(
+            rows, trans_id_ord=trans_id_ord, skip_field_count=skip,
+            marker=marker))
+    else:
+        # beyond-RAM (or explicitly chunked): one streamed scan per
+        # itemset length — the reference's per-k MR jobs over the same
+        # HDFS input; host RSS stays O(block) at any size
+        levels = miner.mine_stream(StreamingTransactionSource(
+            inputs, delim=cfg.field_delim_regex,
+            trans_id_ord=trans_id_ord, skip_field_count=skip, marker=marker,
+            block_bytes=int(cfg.get_float("stream.block.size.mb", 64.0)
+                            * (1 << 20))))
     outs = []
     os.makedirs(output or ".", exist_ok=True)
     for k, isl in enumerate(levels, start=1):
@@ -1228,12 +1283,16 @@ def markov_model_job(cfg: JobConfig, inputs: List[str], output: str) -> JobResul
     key — the multi-tenant mode — with `seq.start.ordinal` marking where
     the state sequence begins and optional `class.attr.ordinal` splitting
     each entity's matrix by class; sections are emitted as `entity:<key>`."""
+    from avenir_tpu.core.stream import stream_job_lines
     from avenir_tpu.models.markov import MarkovStateTransitionModel
 
     states = cfg.get_list("model.states") or cfg.assert_list("state.list")
     scale = cfg.get_int("trans.prob.scale", 1000)
     id_ords = cfg.get_int_list("id.field.ordinals")
     out = _out_file(output)
+    # bigram counts are additive, so both modes fold streamed line blocks
+    # (the mapper's one-line-at-a-time contract,
+    # MarkovStateTransitionModel.java:116-133) at O(block) host RSS
     if id_ords is not None:
         class_ord = cfg.get_int("class.attr.ordinal")
         # mandatory in the Spark reference (getMandatoryIntParam, :54);
@@ -1242,24 +1301,19 @@ def markov_model_job(cfg: JobConfig, inputs: List[str], output: str) -> JobResul
             "seq.start.ordinal",
             max(id_ords + ([class_ord] if class_ord is not None else [])) + 1)
         delim = cfg.field_delim_regex
-        seqs: List[List[str]] = []
-        entity_of_row: List[str] = []
-        entities: List[str] = []
-        seen = set()
-        for path in inputs:
-            for ln in _read_lines(path):
+        model = MarkovStateTransitionModel(states, scale=scale)
+        for lines in stream_job_lines(cfg, inputs):
+            seqs: List[List[str]] = []
+            entity_of_row: List[str] = []
+            for ln in lines:
                 toks = [t.strip() for t in ln.split(delim)]
                 key = ",".join(toks[o] for o in id_ords)
                 if class_ord is not None:
                     key += f",{toks[class_ord]}"
-                if key not in seen:
-                    seen.add(key)
-                    entities.append(key)
                 entity_of_row.append(key)
                 seqs.append(toks[seq_start:])
-        model = MarkovStateTransitionModel(states, scale=scale,
-                                           class_labels=entities)
-        model.fit(seqs, entity_of_row)
+            model.fit_entities(seqs, entity_of_row)
+        entities = model.class_labels or []
         model.save(out, delim=cfg.field_delim, marker="entity")
         return JobResult("markovStateTransitionModel",
                          {"Entities:Count": len(entities)}, [out], model)
@@ -1271,9 +1325,9 @@ def markov_model_job(cfg: JobConfig, inputs: List[str], output: str) -> JobResul
         states, scale=scale,
         class_labels=class_labels,
     )
-    for path in inputs:
-        _, seqs, labels = _read_sequences(path, cfg.field_delim_regex,
-                                          skip, class_ord)
+    for lines in stream_job_lines(cfg, inputs):
+        _, seqs, labels = _parse_sequences(lines, cfg.field_delim_regex,
+                                           skip, class_ord)
         model.fit(seqs, labels if class_labels else None)
     model.save(out, delim=cfg.field_delim)
     return JobResult("markovStateTransitionModel", {}, [out], model)
@@ -1325,6 +1379,7 @@ def hmm_builder_job(cfg: JobConfig, inputs: List[str], output: str) -> JobResult
     `hmmb.partially.tagged=true`, tokens are bare observations except the
     ones matching hmmb.model.states, and `hmmb.window.function` spreads the
     state->obs counts around each tagged position (:174-259)."""
+    from avenir_tpu.core.stream import stream_job_lines
     from avenir_tpu.models.markov import HiddenMarkovModelBuilder
 
     states = cfg.assert_list("model.states")
@@ -1332,22 +1387,21 @@ def hmm_builder_job(cfg: JobConfig, inputs: List[str], output: str) -> JobResult
     sub = cfg.get("sub.field.delim", ":")
     skip = cfg.get_int("skip.field.count", 1)
     builder = HiddenMarkovModelBuilder(states, obs)
+    # per-sequence count accumulation over streamed line blocks (the
+    # mapper contract, HiddenMarkovModelBuilder.java:136-153)
     if cfg.get_bool("partially.tagged", False):
         wf = [int(v) for v in cfg.assert_list("window.function")]
-        all_seqs = []
-        for path in inputs:
-            _, seqs, _ = _read_sequences(path, cfg.field_delim_regex, skip)
-            all_seqs.extend(seqs)
-        hmm = builder.fit_partially_tagged(all_seqs, wf)
+        for lines in stream_job_lines(cfg, inputs):
+            _, seqs, _ = _parse_sequences(lines, cfg.field_delim_regex, skip)
+            for seq in seqs:
+                builder.add_partially_tagged(seq, wf)
     else:
-        state_seqs, obs_seqs = [], []
-        for path in inputs:
-            _, seqs, _ = _read_sequences(path, cfg.field_delim_regex, skip)
+        for lines in stream_job_lines(cfg, inputs):
+            _, seqs, _ = _parse_sequences(lines, cfg.field_delim_regex, skip)
             for seq in seqs:
                 pairs = [tok.split(sub) for tok in seq]
-                obs_seqs.append([p[0] for p in pairs])
-                state_seqs.append([p[1] for p in pairs])
-        hmm = builder.fit(state_seqs, obs_seqs)
+                builder.add([p[1] for p in pairs], [p[0] for p in pairs])
+    hmm = builder.finish()
     out = _out_file(output)
     hmm.save(out, delim=cfg.field_delim)
     return JobResult("hiddenMarkovModelBuilder", {}, [out], hmm)
@@ -1441,13 +1495,17 @@ def fisher_job(cfg: JobConfig, inputs: List[str], output: str) -> JobResult:
 def word_counter_job(cfg: JobConfig, inputs: List[str], output: str) -> JobResult:
     from avenir_tpu.models.text import WordCounter
 
+    from avenir_tpu.core.stream import stream_job_lines
+
     wc = WordCounter(
         text_field_ordinal=cfg.get_int("text.field.ordinal", -1),
         delim=cfg.field_delim_regex,
     )
+    # token counts fold per streamed line block: host RSS is O(block +
+    # vocabulary), never O(file) (WordCounter's mapper contract)
     counts: Dict[str, int] = {}
-    for path in inputs:
-        for word, c in wc.count(_read_lines(path)):
+    for lines in stream_job_lines(cfg, inputs):
+        for word, c in wc.count(lines):
             counts[word] = counts.get(word, 0) + c
     out = _out_file(output)
     delim = cfg.field_delim
@@ -1573,6 +1631,14 @@ def run_from_cli(argv: Sequence[str]) -> JobResult:
     args = ap.parse_args(argv)
     if not args.paths:
         ap.error("expected IN... OUT paths (at least an output path)")
+    # a down accelerator tunnel hangs backend init in-process with no
+    # exception; probe + degrade to CPU so CLI jobs survive an outage
+    from avenir_tpu.utils.devices import ensure_usable_backend
+
+    degraded = ensure_usable_backend()
+    if degraded:
+        print(f"WARNING: accelerator unavailable ({degraded}); "
+              "running on CPU", file=sys.stderr)
     # a .conf path routes through the HOCON block loader in run_job
     props = args.conf if args.conf else {}
     short = args.jobname.rsplit(".", 1)[-1]

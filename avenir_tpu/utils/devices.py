@@ -1,0 +1,66 @@
+"""Accelerator availability probing: degrade to CPU during outages.
+
+When the accelerator tunnel is down, any backend init (jax.devices(), the
+first jit dispatch) hangs in-process indefinitely — there is no exception
+to catch. The only reliable detection is a subprocess probe with a hard
+timeout; the only reliable degrade is pinning the CPU platform BEFORE any
+backend init in this process. The CLI runner uses this so every job keeps
+working (slower, correct) through an outage instead of hanging silently —
+the same degrade contract as bench.py and __graft_entry__.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+from typing import Tuple
+
+_PROBE_RESULT = None        # process-lifetime cache
+
+
+def probe_accelerator(timeout_s: float = 60.0) -> Tuple[bool, str]:
+    """(reachable, reason), probed in a subprocess with a hard timeout.
+    The reason string separates a HANG (tunnel outage) from a CRASH
+    (broken install) so operators debug the right thing."""
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", "import jax; jax.devices(); print('ok')"],
+            capture_output=True, timeout=timeout_s, text=True)
+    except subprocess.TimeoutExpired:
+        return False, (f"device probe hung >{timeout_s:.0f}s "
+                       "(transient tunnel outage)")
+    if proc.returncode == 0 and "ok" in proc.stdout:
+        return True, "ok"
+    tail = (proc.stderr or proc.stdout).strip().splitlines()
+    return False, ("backend probe crashed (broken jax/plugin install?): "
+                   + (tail[-1] if tail else f"exit {proc.returncode}"))
+
+
+def ensure_usable_backend(timeout_s: float = None) -> str:
+    """Probe once per process; on an unreachable accelerator, pin the CPU
+    platform so subsequent compute degrades instead of hanging. Returns
+    the degrade reason, or "" when the accelerator is fine.
+
+    Opt-outs: AVENIR_SKIP_DEVICE_PROBE=1 skips the probe entirely (e.g.
+    when the caller already pinned a platform). A JAX_PLATFORMS env var
+    leading with "cpu" is already hang-proof — no probe needed; any other
+    value (the infra sets JAX_PLATFORMS=<accelerator> by default) still
+    gets probed, because that is exactly the process that hangs."""
+    global _PROBE_RESULT
+    if os.environ.get("AVENIR_SKIP_DEVICE_PROBE"):
+        return ""
+    env_platforms = os.environ.get("JAX_PLATFORMS", "")
+    if env_platforms.split(",")[0].strip() == "cpu":
+        return ""
+    if timeout_s is None:
+        timeout_s = float(os.environ.get("AVENIR_DEVICE_PROBE_TIMEOUT", 60))
+    if _PROBE_RESULT is None:
+        _PROBE_RESULT = probe_accelerator(timeout_s)
+    ok, reason = _PROBE_RESULT
+    if ok:
+        return ""
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    return reason

@@ -1,0 +1,21 @@
+#!/bin/bash
+# Probe the TPU tunnel every 5 min; the moment it is up, run the full
+# validation queue (fused kernel, kernel sweep, reworked bench sections,
+# whole bench.py) and bank the evidence in tpu_queue_r05.log.
+set -o pipefail
+cd /root/repo
+while true; do
+  if python -c "
+from __graft_entry__ import _accelerator_reachable
+import sys
+sys.exit(0 if _accelerator_reachable(90) else 1)
+" 2>/dev/null; then
+    echo "=== TUNNEL UP at $(date -u +%H:%M:%S) — running validation queue ===" | tee -a tpu_queue_r05.log
+    python tools/tpu_validation_queue.py --full 2>&1 | tee -a tpu_queue_r05.log
+    rc=${PIPESTATUS[0]}
+    echo "=== QUEUE EXIT ${rc} at $(date -u +%H:%M:%S) ===" | tee -a tpu_queue_r05.log
+    break
+  fi
+  echo "probe: tunnel down at $(date -u +%H:%M:%S)" >> tpu_watch_r05.log
+  sleep 300
+done
