@@ -257,6 +257,35 @@ class NaiveBayesModel:
 
     @classmethod
     def load(cls, path: str, schema: FeatureSchema, delim: str = ",") -> "NaiveBayesModel":
+        # the model file is self-describing (the reference's BayesianModel
+        # is built from the file alone, BayesianPredictor.java:332-340):
+        # class values and categorical feature bins it mentions extend any
+        # data-discovered vocabularies a freshly-loaded schema lacks,
+        # in file order so codes match the training-side discovery
+        cat_need = {f.ordinal: f for f in schema.fields
+                    if f.is_categorical and not f.cardinality
+                    and not f.id_field}
+        if cat_need:
+            cls_fld = schema.class_field
+            cls_ord = cls_fld.ordinal if cls_fld is not None else None
+            seen: Dict[int, List[str]] = {o: [] for o in cat_need}
+            with open(path) as fh:
+                for line in fh:
+                    items = line.rstrip("\n").split(delim)
+                    if len(items) < 4:
+                        continue
+                    cv, o, b = items[0], items[1], items[2]
+                    if cv and cls_ord in seen and cv not in seen[cls_ord]:
+                        seen[cls_ord].append(cv)
+                    if o and b:
+                        ordn = int(o)
+                        if ordn in seen and ordn != cls_ord \
+                                and b not in seen[ordn]:
+                            seen[ordn].append(b)
+            for o, fld in cat_need.items():
+                if seen[o]:
+                    fld.cardinality = seen[o]
+                    fld.discovered_cardinality = True
         model = cls.empty(schema)
         bin_index = {f.ordinal: i for i, f in enumerate(model.binned_fields)}
         cont_index = {f.ordinal: i for i, f in enumerate(model.cont_fields)}

@@ -508,9 +508,11 @@ def decision_tree(cfg: JobConfig, inputs: List[str], output: str) -> JobResult:
     decPathIn/decPathOut file rotation (resource/detr.sh:34-54) runs as an
     internal device loop, but the DecisionPathList JSON still lands at
     `dtb.decision.file.path.out` for checkpoint parity."""
-    schema = _schema(cfg)
     ds = _dataset(inputs[0], cfg)
-    builder = _tree_builder(cfg, schema)
+    # build against the dataset's OWN schema object: parsing may have
+    # discovered vocabularies (e.g. an undeclared class cardinality in
+    # the reference's call_hangup.json) that a fresh load lacks
+    builder = _tree_builder(cfg, ds.schema)
     paths = builder.fit(ds)
     out = cfg.get("decision.file.path.out") or _out_file(output, "decPathOut.txt")
     paths.save(out)
@@ -521,10 +523,9 @@ def decision_tree(cfg: JobConfig, inputs: List[str], output: str) -> JobResult:
 def random_forest(cfg: JobConfig, inputs: List[str], output: str) -> JobResult:
     from avenir_tpu.models.tree import RandomForestBuilder
 
-    schema = _schema(cfg)
     ds = _dataset(inputs[0], cfg)
     forest = RandomForestBuilder(
-        schema,
+        ds.schema,
         num_trees=cfg.get_int("num.trees", 10),
         sampling=cfg.get("sub.sampling.strategy", "withReplace"),
         sample_rate=cfg.get_float("sub.sampling.rate", 0.7),
@@ -573,7 +574,7 @@ def data_partitioner_job(cfg: JobConfig, inputs: List[str], output: str) -> JobR
     # (reconstruction would reformat numerics and break on missing values)
     ds = _dataset(inputs[0], cfg, keep_raw=True)
     dp = DataPartitioner(
-        _schema(cfg),
+        ds.schema,
         algorithm=cfg.get("split.algorithm", "giniIndex"),
         split_attribute=cfg.get_int("split.attribute"),
     )
@@ -1553,13 +1554,12 @@ def bandit_job(cfg: JobConfig, inputs: List[str], output: str) -> JobResult:
     )
     bj = make_bandit_job(name, batch, **kw)
     sel = bj.select(data, round_num)
-    rows = data.selections_to_rows(
-        sel, output_decision_count=cfg.get_bool("output.decision.count", False))
     out = _out_file(output)
-    delim = cfg.field_delim
     with open(out, "w") as fh:
-        for row in rows:
-            fh.write(delim.join(row) + "\n")
+        data.write_selections(
+            sel, fh, cfg.field_delim,
+            output_decision_count=cfg.get_bool("output.decision.count",
+                                               False))
     return JobResult(name, {"Bandit:Groups": len(data.group_ids)}, [out], sel)
 
 

@@ -22,6 +22,41 @@ def test_nb_model_roundtrip(tmp_path):
     np.testing.assert_array_equal(p1, p2)
 
 
+def test_nb_load_discovers_undeclared_vocabularies(tmp_path):
+    """The model file is self-describing (BayesianPredictor.java:332-340):
+    a schema whose class AND categorical feature fields declare no
+    cardinality (the reference's elearnActivity.json style) must load a
+    trained model with the vocabularies recovered from the file itself."""
+    import json
+
+    from avenir_tpu.core.dataset import Dataset
+    from avenir_tpu.core.schema import FeatureSchema
+    from avenir_tpu.models.naive_bayes import (NaiveBayesModel,
+                                               NaiveBayesPredictor)
+
+    sp = str(tmp_path / "s.json")
+    json.dump({"fields": [
+        {"name": "id", "ordinal": 0, "id": True, "dataType": "string"},
+        {"name": "color", "ordinal": 1, "dataType": "categorical",
+         "feature": True},
+        {"name": "cls", "ordinal": 2, "dataType": "categorical",
+         "classAttribute": True},
+    ]}, open(sp, "w"))
+    csv = "a,red,T\nb,blue,F\nc,red,T\nd,green,F\n"
+    s1 = FeatureSchema.from_file(sp)
+    m = NaiveBayesModel.fit(Dataset.from_csv(csv, s1))
+    mp = str(tmp_path / "m.csv")
+    m.save(mp)
+
+    s2 = FeatureSchema.from_file(sp)        # fresh: vocabularies empty
+    m2 = NaiveBayesModel.load(mp, s2)
+    assert m2.class_values == s1.class_field.cardinality
+    assert s2.fields[1].cardinality == sorted(["red", "blue", "green"])
+    p1, _ = NaiveBayesPredictor(m).predict(Dataset.from_csv(csv, s1))
+    p2, _ = NaiveBayesPredictor(m2).predict(Dataset.from_csv(csv, s2))
+    np.testing.assert_array_equal(p1, p2)
+
+
 def test_tree_roundtrip(tmp_path):
     from avenir_tpu.models.tree import DecisionPathList, DecisionTreeBuilder
 
