@@ -93,6 +93,29 @@ class GroupBanditData:
             self.group_ids, self.item_ids, jnp.asarray(self.counts),
             jnp.asarray(self.rewards), jnp.asarray(self.mask))
 
+    def write_selections(self, sel: np.ndarray, fh, delim: str = ",",
+                         output_decision_count: bool = False) -> int:
+        """Decode [G, B] selected indices to the reference's per-round
+        output rows (GreedyRandomBandit.java:148-203) and write them to
+        fh; returns rows written. Vectorized numpy decode when every
+        group has the same item count (the map-only job's hot shape);
+        falls back to selections_to_rows otherwise."""
+        rect = (isinstance(self.item_ids, np.ndarray)
+                and self.item_ids.ndim == 2) or \
+            len({len(it) for it in self.item_ids}) == 1
+        if output_decision_count or not rect:
+            rows = self.selections_to_rows(sel, output_decision_count)
+            for row in rows:
+                fh.write(delim.join(row) + "\n")
+            return len(rows)
+        ids_arr = np.asarray(self.item_ids)                    # [G, A]
+        g_arr = np.char.add(np.asarray(self.group_ids, dtype=str), delim)
+        sel = np.asarray(sel)
+        picks = ids_arr[np.arange(g_arr.shape[0])[:, None], sel]  # [G, B]
+        lines = np.char.add(g_arr[:, None], picks).ravel()
+        fh.write("\n".join(lines.tolist()) + "\n")
+        return int(lines.shape[0])
+
     def selections_to_rows(self, sel: np.ndarray,
                            output_decision_count: bool = False
                            ) -> List[List[str]]:

@@ -306,6 +306,12 @@ def measure_scaling(
         "nb_analytic_payload_bytes": nb_tensor_bytes,
         "payload_model_validated": hlo_payload == nb_tensor_bytes,
         "projection_8_to_256": project_efficiency(step_s, hlo_payload),
+        "projection_note": (
+            "projection_8_to_256 is a MODEL, not a measurement: payload "
+            "bytes are HLO-validated and the single-chip step time is "
+            "measured, but ICI bandwidth/latency are datasheet "
+            "assumptions (project_efficiency) — no multi-chip hardware "
+            "exists in this environment to measure against"),
         "virtual_devices": virtual,
     }
     if virtual:

@@ -48,6 +48,15 @@ import numpy as np
 HADOOP_NB_ROWS_PER_SEC = 1.0e6
 HADOOP_PAIR_DIST_PER_SEC = 3.2e7
 HADOOP_SCAN_ROWS_PER_SEC = 1.0e6
+# Documented MR-vs-native efficiency: published head-to-head comparisons
+# (Pavlo et al., "A Comparison of Approaches to Large-Scale Data
+# Analysis", SIGMOD 2009; Anderson & Tucek, "Efficiency Matters!", HotOS
+# 2009 line of work) place Hadoop per-node scan/grep throughput at or
+# below ~10% of a hand-coded native scan on the same hardware (JVM Text
+# decode, Writable churn, spill/merge, HDFS replication, task startup).
+# measure_baseline_anchor() measures the native rate HERE and scales by
+# this factor to obtain a defensible per-node Hadoop rate.
+MR_EFFICIENCY = 0.10
 
 NB_ROWS = 1_000_000
 NB_STEPS = 8
@@ -450,10 +459,18 @@ def bench_bandit():
     (each round fetches its selections, as the job writes them per round)."""
     from avenir_tpu.models.bandits import GreedyRandomBandit, GroupBanditData
 
+    import tempfile
+
     rng = np.random.default_rng(6)
     g, a = BANDIT_GROUPS, BANDIT_ARMS
+    # real group/item ids: the job's cost includes decoding selections and
+    # writing per-round rows (GreedyRandomBandit.java:148-203), so the
+    # emit path is timed alongside the device select
+    group_ids = np.char.add("g", np.arange(g).astype("U8"))
+    item_ids = np.broadcast_to(
+        np.char.add("p", np.arange(a).astype("U4")), (g, a))
     data = GroupBanditData(
-        group_ids=[], item_ids=[],  # id decode not exercised: device path only
+        group_ids=group_ids, item_ids=item_ids,
         counts=rng.integers(0, 50, (g, a)).astype(np.int32),
         rewards=rng.random((g, a)).astype(np.float32) * 100.0,
         mask=np.ones((g, a), bool),
@@ -462,11 +479,68 @@ def bench_bandit():
                                 prob_reduction_constant=2.0, seed=3)
     _ = bandit.select(data, 1)  # warmup compile
     t0 = time.perf_counter()
-    for r in range(2, BANDIT_ROUNDS + 2):
-        sel = bandit.select(data, r)
+    with tempfile.TemporaryFile("w") as fh:
+        for r in range(2, BANDIT_ROUNDS + 2):
+            sel = bandit.select(data, r)
+            fh.seek(0)
+            data.write_selections(np.asarray(sel), fh)
     dt = time.perf_counter() - t0
     assert sel.shape == (g, 3)
     return g * BANDIT_ROUNDS / dt
+
+
+def measure_baseline_anchor():
+    """One MEASURED anchor for the Hadoop-32-node baseline constants.
+
+    The reference publishes no numbers, so vs_baseline has always divided
+    by documented estimates (HADOOP_* above). This measures, on this very
+    host, a GENEROUS per-node upper bound for each estimate and scales by
+    32 nodes, so the companion vs_baseline_measured_anchor figure divides
+    by something defensible rather than assumed:
+
+    - nb rows/sec/node: the native C++ single-pass CSV parse+encode rate
+      on one core (engine used by Dataset.from_csv). A Hadoop mapper does
+      strictly more per row (JVM Text decode, per-field Writable churn,
+      spill/merge, HDFS round trip), so one node's whole map pipeline is
+      bounded above by one modern core's C parse rate.
+    - pair-distances/sec/node: single-process numpy d=8 blocked distance
+      rate (C/BLAS). The reference computes each distance from freshly
+      split text records in sifarish's JVM inner loop; C-speed floats
+      with no parse is again a strict upper bound per node.
+
+    The per-node Hadoop rate is the measured native rate x MR_EFFICIENCY
+    (documented <=10% MR-vs-native efficiency — see the constant's
+    citation note); the raw measured rates are reported alongside so the
+    JSON distinguishes measured from assumed.
+    Returns (nb_node_native_rps, pair_node_native_pps)."""
+    from avenir_tpu.core.dataset import Dataset
+    from avenir_tpu.data import churn_schema, generate_churn
+
+    rows = 200_000
+    csv_bytes = generate_churn(rows, seed=23, as_csv=True).encode()
+    schema = churn_schema()
+    _ = Dataset.from_csv(csv_bytes, schema)         # warm (vocab discovery)
+    best = np.inf
+    for _ in range(3):
+        t0 = time.perf_counter()
+        Dataset.from_csv(csv_bytes, schema)
+        best = min(best, time.perf_counter() - t0)
+    nb_node_rps = rows / best
+
+    rng = np.random.default_rng(24)
+    q = rng.normal(size=(256, 8)).astype(np.float32)
+    t = rng.normal(size=(65_536, 8)).astype(np.float32)
+    _ = ((q[:, None, :] - t[None, :256, :]) ** 2).sum(-1)   # warm
+    best = np.inf
+    for _ in range(3):
+        t0 = time.perf_counter()
+        acc = 0.0
+        for s in range(0, t.shape[0], 8_192):
+            d2 = ((q[:, None, :] - t[None, s:s + 8_192, :]) ** 2).sum(-1)
+            acc += float(d2[0, 0])
+        best = min(best, time.perf_counter() - t0)
+    pair_node_pps = q.shape[0] * t.shape[0] / best
+    return nb_node_rps, pair_node_pps
 
 
 def bench_knn_matmul_ceiling(dim: int):
@@ -559,6 +633,15 @@ def main():
     nb_speedup = nb_rps / HADOOP_NB_ROWS_PER_SEC
     knn_speedup = knn_qps / (HADOOP_PAIR_DIST_PER_SEC / KNN_TRAIN)
     vs_baseline = float(np.sqrt(nb_speedup * knn_speedup))
+    # measured anchor: native per-node rate measured on this host, scaled
+    # by the documented MR efficiency factor, x 32 nodes
+    anchor_nb_rps, anchor_pair_pps = measure_baseline_anchor()
+    anchored_nb_cluster = 32 * MR_EFFICIENCY * anchor_nb_rps
+    anchored_pair_cluster = 32 * MR_EFFICIENCY * anchor_pair_pps
+    nb_speedup_anchor = nb_rps / anchored_nb_cluster
+    knn_speedup_anchor = knn_qps / (anchored_pair_cluster / KNN_TRAIN)
+    vs_baseline_anchor = float(np.sqrt(
+        nb_speedup_anchor * knn_speedup_anchor))
     # the other three north-star configs, against the same per-scan
     # estimate: the reference pays >= one full MR scan per tree level /
     # per itemset length / per decision round
@@ -652,6 +735,23 @@ def main():
                           "pair-distances/sec — see module docstring), not "
                           "measured reference numbers; the reference "
                           "publishes none (BASELINE.md)"),
+        "vs_baseline_measured_anchor": round(vs_baseline_anchor, 2),
+        "baseline_anchor": {
+            "nb_node_native_rows_per_sec_measured": round(anchor_nb_rps, 1),
+            "pair_node_native_distances_per_sec_measured":
+                round(anchor_pair_pps, 1),
+            "mr_efficiency_factor_assumed": MR_EFFICIENCY,
+            "anchored_cluster_nb_rows_per_sec": round(anchored_nb_cluster, 1),
+            "anchored_cluster_pair_distances_per_sec":
+                round(anchored_pair_cluster, 1),
+            "note": ("per-node native scan rates MEASURED on this host "
+                     "(single-core C parse+encode; single-process numpy "
+                     "d=8 distances), scaled by the documented <=10% "
+                     "MR-vs-native efficiency (Pavlo et al. SIGMOD'09 "
+                     "line of work — see MR_EFFICIENCY) and 32 nodes; "
+                     "only the efficiency factor is assumed, and it is "
+                     "generous to Hadoop"),
+        },
         "knn_d8_qps": round(knn_qps, 1),
         "knn_d8_fused_classify_qps": round(knn_fused_qps, 1),
         "knn_d128_qps": round(knn_qps_hi, 1),
