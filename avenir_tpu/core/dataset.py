@@ -178,6 +178,9 @@ class Dataset:
                             + f" not in declared cardinality of field "
                             f"{fld.name!r}") from None
             raise
+        for fld in schema.fields:
+            if fld.is_numeric and fld.ordinal in columns:
+                _discover_numeric_range(fld, columns[fld.ordinal])
         return cls(schema, columns, n, lazy=lazy)
 
     @classmethod
@@ -207,6 +210,7 @@ class Dataset:
                 columns[o] = np.array(
                     [float(t) if t != "" else np.nan for t in toks], dtype=dt
                 )
+                _discover_numeric_range(fld, columns[o])
             else:  # string / text / id: host-side object column
                 columns[o] = np.array(toks, dtype=object)
         return cls(schema, columns, n, raw_rows=rows if keep_raw else None)
@@ -351,6 +355,24 @@ def _discover_cardinality(fld, tokens) -> None:
         return
     fld.cardinality = sorted({t for t in tokens})
     fld.discovered_cardinality = True
+
+
+def _discover_numeric_range(fld, col: np.ndarray) -> None:
+    """Numeric fields with bucketWidth but no declared max (the
+    reference's hosp_readmit.json style — the Java jobs bin by
+    floor(value/width) with data-determined extent): record the observed
+    max on the (shared) schema field so num_bins() covers every seen
+    code. The max only grows across chunks/splits, so earlier codes stay
+    valid and streaming count accumulators just pad the bin axis."""
+    if not fld.bucket_width or (fld.max is not None
+                                and not fld.discovered_range):
+        return
+    finite = col[np.isfinite(col)]
+    if finite.size == 0:
+        return
+    hi = float(finite.max())
+    fld.max = hi if fld.max is None else max(fld.max, hi)
+    fld.discovered_range = True
 
 
 def pad_rows(n: int, multiple: int) -> int:

@@ -49,9 +49,12 @@ class FeatureField:
     # was inferred from data (undeclared in the schema file) and may grow
     cardinality: List[str] = field(default_factory=list)
     discovered_cardinality: bool = False
-    # numeric metadata (binning / split hints)
+    # numeric metadata (binning / split hints); discovered_range marks a
+    # max that was inferred from data (undeclared bucketWidth extent, the
+    # reference's hosp_readmit.json style) and may grow
     min: Optional[float] = None
     max: Optional[float] = None
+    discovered_range: bool = False
     bucket_width: Optional[float] = None
     max_split: Optional[int] = None
     split_scan_interval: Optional[float] = None
@@ -127,6 +130,7 @@ class FeatureField:
             "maxSplit",
             "splitScanInterval",
             "discoveredCardinality",
+            "discoveredRange",
         }
         return cls(
             name=obj.get("name", f"field{obj.get('ordinal')}"),
@@ -140,6 +144,7 @@ class FeatureField:
                                                 False)),
             min=obj.get("min"),
             max=obj.get("max"),
+            discovered_range=bool(obj.get("discoveredRange", False)),
             bucket_width=obj.get("bucketWidth"),
             max_split=obj.get("maxSplit"),
             split_scan_interval=obj.get("splitScanInterval"),
@@ -160,6 +165,9 @@ class FeatureField:
         if self.discovered_cardinality:
             # keeps a data-discovered vocabulary growable after reload
             obj["discoveredCardinality"] = True
+        if self.discovered_range:
+            # keeps a data-discovered numeric extent growable after reload
+            obj["discoveredRange"] = True
         for key, val in (
             ("min", self.min),
             ("max", self.max),

@@ -3,12 +3,20 @@
 seedable numpy generators that return Datasets directly."""
 
 from avenir_tpu.data.generators import (
+    BUY_STATES,
     call_hangup_schema,
     churn_schema,
+    disease_schema,
     elearn_schema,
+    generate_buy_xactions,
     generate_call_hangup,
     generate_churn,
+    generate_disease,
     generate_elearn,
     generate_event_sequences,
+    generate_hosp_readmit,
     generate_price_opt,
+    generate_visit_history,
+    hosp_readmit_schema,
+    xactions_to_state_sequences,
 )

@@ -42,3 +42,17 @@ def test_tutorial_runs(name, tmp_path):
             raise AssertionError(
                 f"{name} block {i} failed: {e}\n--- block ---\n{block}"
             ) from e
+
+
+def test_every_tutorial_asserts_results():
+    """Run-books are generate -> run -> INSPECT cycles: every tutorial
+    must assert on computed results (so a corrupted model/output file
+    fails the suite), not merely execute."""
+    import ast
+
+    for name in TUTORIALS:
+        blocks = _blocks(os.path.join(DOCS, name))
+        asserts = sum(
+            isinstance(node, ast.Assert)
+            for b in blocks for node in ast.walk(ast.parse(b)))
+        assert asserts >= 2, f"{name} has {asserts} assert statements"
