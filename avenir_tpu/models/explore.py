@@ -14,9 +14,11 @@ from __future__ import annotations
 
 import math
 from dataclasses import dataclass
+from functools import partial
 from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
+import jax
 import jax.numpy as jnp
 
 from avenir_tpu.core.dataset import Dataset
@@ -24,9 +26,12 @@ from avenir_tpu.core.schema import FeatureField
 from avenir_tpu.ops.infotheory import (bits_entropy, entropy, gini,
                                        mutual_information,
                                        weighted_split_score)
-from avenir_tpu.ops.reduce import cross_count
+from avenir_tpu.ops.reduce import cross_count, keyed_reduce
 
 _EPS = 1e-12
+# fused MI chunk keys are int32: past this keyspace they would wrap, so
+# add() drops to per-pair cross_counts (each in its own small keyspace)
+_FUSED_KEYSPACE_LIMIT = 2**31
 
 
 def _padded_add(acc: Optional[np.ndarray], new: np.ndarray) -> np.ndarray:
@@ -46,6 +51,39 @@ def _padded_add(acc: Optional[np.ndarray], new: np.ndarray) -> np.ndarray:
 # ---------------------------------------------------------------------------
 # mutual information + feature selection scores
 # ---------------------------------------------------------------------------
+
+
+@partial(jax.jit, static_argnames=("bmax", "k", "nf"))
+def _mi_chunk_counts(codes, y, bmax: int, k: int, nf: int):
+    """One chunk's complete MI count-table set in three keyed reductions:
+    fc [F, bmax, k], pair [P, bmax, bmax] and pairc [P, bmax, bmax, k],
+    P = F(F-1)/2 in upper-triangle order. int32 counts (exact to 2^31);
+    peak memory is the [n, P] key tensor — pair analysis is inherently
+    O(F^2) work either way, this shape just buys it with 3 dispatches
+    instead of F^2. Caller guarantees the fused keyspace
+    P*bmax^2*k < 2^31 (add() falls back to per-pair cross_count past
+    that; int keys would wrap)."""
+    n = codes.shape[0]
+
+    def count(keys, num):
+        return keyed_reduce(keys.reshape(-1),
+                            jnp.ones((keys.size,), jnp.int32), num)
+
+    f_idx = jnp.arange(nf, dtype=jnp.int32)[None, :]
+    fc = count((f_idx * bmax + codes) * k + y[:, None],
+               nf * bmax * k).reshape(nf, bmax, k)
+    ii, jj = np.triu_indices(nf, 1)            # static under jit
+    npair = len(ii)
+    if npair == 0:
+        return (fc, jnp.zeros((0, bmax, bmax), jnp.int32),
+                jnp.zeros((0, bmax, bmax, k), jnp.int32))
+    ci, cj = codes[:, ii], codes[:, jj]        # [n, P]
+    p_idx = jnp.arange(npair, dtype=jnp.int32)[None, :]
+    key_p = (p_idx * bmax + ci) * bmax + cj
+    pair = count(key_p, npair * bmax * bmax).reshape(npair, bmax, bmax)
+    pairc = count(key_p * k + y[:, None],
+                  npair * bmax * bmax * k).reshape(npair, bmax, bmax, k)
+    return fc, pair, pairc
 
 
 class MutualInformationAnalyzer:
@@ -94,7 +132,12 @@ class MutualInformationAnalyzer:
         """Fold one chunk's contingency counts into the running tables.
         Data-discovered categorical vocabularies may extend between chunks
         (the shared-schema contract of CsvBlockReader); accumulated tables
-        zero-pad along the grown bin axes."""
+        zero-pad along the grown bin axes.
+
+        All F feature-class tables and both F(F-1)/2 pair-table families
+        come out of THREE keyed segment_sums per chunk (bin axes padded to
+        the chunk's max bin count) — not one dispatch per table, which is
+        what makes the streaming path tunnel-latency-proof on device."""
         if self.fields is None:
             self.fields = ds.encodable_feature_fields()
             self.k = ds.schema.num_classes()
@@ -102,29 +145,46 @@ class MutualInformationAnalyzer:
             self.bins = [0] * F
             self._fc = [np.zeros((0, self.k)) for _ in range(F)]
         codes, bins = ds.feature_codes(self.fields)
-        codes_d = jnp.asarray(codes)
-        y = jnp.asarray(ds.labels())
         F = len(self.fields)
         self.bins = [max(a, b) for a, b in zip(self.bins, bins)]
-        for f in range(F):
-            joint = np.asarray(
-                cross_count(codes_d[:, f], y, bins[f], self.k), np.float64)
-            self._fc[f] = _padded_add(self._fc[f], joint)
-        for i in range(F):
-            for j in range(i + 1, F):
-                bi, bj = bins[i], bins[j]
-                joint_ij = np.asarray(
-                    cross_count(codes_d[:, i], codes_d[:, j], bi, bj),
-                    np.float64)
-                self._pair[(i, j)] = _padded_add(
-                    self._pair.get((i, j)), joint_ij)
-                # combined code (i,j) vs class
-                comb = codes_d[:, i] * bj + codes_d[:, j]
-                joint_ijc = np.asarray(
-                    cross_count(comb, y, bi * bj, self.k),
-                    np.float64).reshape(bi, bj, self.k)
-                self._pairc[(i, j)] = _padded_add(
-                    self._pairc.get((i, j)), joint_ijc)
+        bmax = max(bins) if bins else 1
+        fused_keys = (F * (F - 1) // 2) * bmax * bmax * self.k
+        if fused_keys < _FUSED_KEYSPACE_LIMIT:
+            fc, pair, pairc = (np.asarray(a, np.float64) for a in
+                               _mi_chunk_counts(jnp.asarray(codes),
+                                                jnp.asarray(ds.labels()),
+                                                bmax, self.k, F))
+            p = 0
+            for i in range(F):
+                self._fc[i] = _padded_add(self._fc[i], fc[i, :bins[i]])
+                for j in range(i + 1, F):
+                    bi, bj = bins[i], bins[j]
+                    self._pair[(i, j)] = _padded_add(
+                        self._pair.get((i, j)), pair[p, :bi, :bj])
+                    self._pairc[(i, j)] = _padded_add(
+                        self._pairc.get((i, j)), pairc[p, :bi, :bj])
+                    p += 1
+        else:
+            # fused int32 keys would wrap (many features x huge bin
+            # counts): per-pair cross_counts, each in its own keyspace
+            codes_d = jnp.asarray(codes)
+            y = jnp.asarray(ds.labels())
+            for f in range(F):
+                self._fc[f] = _padded_add(self._fc[f], np.asarray(
+                    cross_count(codes_d[:, f], y, bins[f], self.k),
+                    np.float64))
+            for i in range(F):
+                for j in range(i + 1, F):
+                    bi, bj = bins[i], bins[j]
+                    self._pair[(i, j)] = _padded_add(
+                        self._pair.get((i, j)), np.asarray(
+                            cross_count(codes_d[:, i], codes_d[:, j],
+                                        bi, bj), np.float64))
+                    comb = codes_d[:, i] * bj + codes_d[:, j]
+                    self._pairc[(i, j)] = _padded_add(
+                        self._pairc.get((i, j)), np.asarray(
+                            cross_count(comb, y, bi * bj, self.k),
+                            np.float64).reshape(bi, bj, self.k))
         self.n += len(ds)
 
     def finalize(self) -> None:
@@ -569,17 +629,51 @@ def relief_relevance(
 # ---------------------------------------------------------------------------
 
 
+def class_affinity_from_table(tab: np.ndarray, fld: FeatureField,
+                              class_values: Sequence[str], top_n: int = 3
+                              ) -> Dict[str, List[Tuple[str, float]]]:
+    """class_affinity from an accumulated [B, K] contingency table —
+    the streaming form (tables fold additively per chunk)."""
+    cls_tot = tab.sum(axis=0)
+    out = {}
+    for ki, cv in enumerate(class_values):
+        p = tab[:, ki] / max(cls_tot[ki], _EPS)
+        order = np.argsort(-p)[:top_n]
+        out[cv] = [(fld.cardinality[b], float(p[b])) for b in order
+                   if b < len(fld.cardinality)]
+    return out
+
+
 def class_affinity(ds: Dataset, fld: FeatureField, top_n: int = 3
                    ) -> Dict[str, List[Tuple[str, float]]]:
     """Per class: top-n categorical values by P(value | class)
     (CategoricalClassAffinity.java:51)."""
-    tab = contingency(ds, fld)                        # [B, K]
-    cls_tot = tab.sum(axis=0)
+    return class_affinity_from_table(contingency(ds, fld), fld,
+                                     ds.schema.class_values(), top_n)
+
+
+def supervised_encoding_from_table(
+    tab: np.ndarray,
+    fld: FeatureField,
+    classes: Sequence[str],
+    strategy: str = "supervisedRatio",
+    pos_class: Optional[str] = None,
+) -> Dict[str, float]:
+    """supervised_encoding from an accumulated [B, K] contingency table —
+    the streaming form."""
+    pi = classes.index(pos_class) if pos_class else 1
+    pos = tab[:, pi]
+    neg = tab.sum(axis=1) - pos
+    total_pos = max(pos.sum(), _EPS)
+    total_neg = max(neg.sum(), _EPS)
     out = {}
-    for ki, cv in enumerate(ds.schema.class_values()):
-        p = tab[:, ki] / max(cls_tot[ki], _EPS)
-        order = np.argsort(-p)[:top_n]
-        out[cv] = [(fld.cardinality[b], float(p[b])) for b in order]
+    for b, value in enumerate(fld.cardinality[:tab.shape[0]]):
+        if strategy == "weightOfEvidence":
+            num = max(pos[b], 0.5) / total_pos        # 0.5 = continuity corr.
+            den = max(neg[b], 0.5) / total_neg
+            out[value] = math.log(num / den)
+        else:
+            out[value] = float(pos[b] / max(pos[b] + neg[b], _EPS))
     return out
 
 
@@ -595,22 +689,9 @@ def supervised_encoding(
       weightOfEvidence: ln( (count(value,pos)/total_pos) /
                             (count(value,neg)/total_neg) )
     """
-    tab = contingency(ds, fld)                        # [B, K]
-    classes = ds.schema.class_values()
-    pi = classes.index(pos_class) if pos_class else 1
-    pos = tab[:, pi]
-    neg = tab.sum(axis=1) - pos
-    total_pos = max(pos.sum(), _EPS)
-    total_neg = max(neg.sum(), _EPS)
-    out = {}
-    for b, value in enumerate(fld.cardinality):
-        if strategy == "weightOfEvidence":
-            num = max(pos[b], 0.5) / total_pos        # 0.5 = continuity corr.
-            den = max(neg[b], 0.5) / total_neg
-            out[value] = math.log(num / den)
-        else:
-            out[value] = float(pos[b] / max(pos[b] + neg[b], _EPS))
-    return out
+    return supervised_encoding_from_table(
+        contingency(ds, fld), fld, ds.schema.class_values(),
+        strategy, pos_class)
 
 
 # ---------------------------------------------------------------------------
@@ -711,16 +792,22 @@ class Rule:
             "lt": x < v, "le": x <= v,
         }[op]
 
-    def evaluate(self, ds: Dataset) -> Dict[str, float]:
+    def counts(self, ds: Dataset) -> Tuple[int, int, int]:
+        """(rows, conditionCount, bothCount) for one chunk — additive, so
+        rule evaluation streams like every other counting job."""
         cond = np.ones(len(ds), bool)
         for e in self.condition:
             cond &= self._eval_one(ds, e)
         cons = np.ones(len(ds), bool)
         for e in self.consequence:
             cons &= self._eval_one(ds, e)
-        both = cond & cons
-        n = len(ds)
-        support = both.sum() / n if n else 0.0
-        confidence = both.sum() / max(cond.sum(), 1)
-        return {"support": float(support), "confidence": float(confidence),
-                "conditionCount": int(cond.sum()), "bothCount": int(both.sum())}
+        return len(ds), int(cond.sum()), int((cond & cons).sum())
+
+    @staticmethod
+    def finalize(n: int, cond: int, both: int) -> Dict[str, float]:
+        return {"support": float(both / n if n else 0.0),
+                "confidence": float(both / max(cond, 1)),
+                "conditionCount": cond, "bothCount": both}
+
+    def evaluate(self, ds: Dataset) -> Dict[str, float]:
+        return self.finalize(*self.counts(ds))

@@ -766,23 +766,40 @@ def mutual_information_job(cfg: JobConfig, inputs: List[str], output: str) -> Jo
 def rule_evaluator(cfg: JobConfig, inputs: List[str], output: str) -> JobResult:
     """rue.rule.<name> definitions `cond1 & cond2 => cons` evaluated for
     support/confidence (RuleEvaluator.java:48)."""
+    from avenir_tpu.core.stream import stream_job_inputs
     from avenir_tpu.models.explore import Rule
 
-    ds = _dataset(inputs[0], cfg)
     names = cfg.assert_list("rule.names")
+    cond_delim = cfg.get("cond.delim", "&")
+    rules = {}
+    for name in names:
+        expr = cfg.assert_get(f"rule.{name}")
+        if expr.count("=>") != 1:
+            raise ValueError(
+                f"{cfg.prefix}.rule.{name} must contain exactly one '=>' "
+                f"(cond => cons), got: {expr!r}")
+        cond_part, cons_part = expr.split("=>")
+        rules[name] = Rule(
+            [c.strip() for c in cond_part.split(cond_delim) if c.strip()],
+            [c.strip() for c in cons_part.split(cond_delim) if c.strip()],
+        )
+    # all rules fold their (rows, cond, both) counts per streamed chunk
+    totals = {name: [0, 0, 0] for name in names}
+    rows_seen = 0
+    for chunk in stream_job_inputs(cfg, inputs, _schema(cfg)):
+        rows_seen += len(chunk)
+        for name, rule in rules.items():
+            for i, v in enumerate(rule.counts(chunk)):
+                totals[name][i] += v
+    if rows_seen == 0:
+        raise ValueError(f"ruleEvaluator: empty input "
+                         f"(no records in {inputs})")
     out = _out_file(output)
     delim = cfg.field_delim
     results = {}
     with open(out, "w") as fh:
         for name in names:
-            expr = cfg.assert_get(f"rule.{name}")
-            cond_part, cons_part = expr.split("=>")
-            cond_delim = cfg.get("cond.delim", "&")
-            rule = Rule(
-                [c.strip() for c in cond_part.split(cond_delim) if c.strip()],
-                [c.strip() for c in cons_part.split(cond_delim) if c.strip()],
-            )
-            res = rule.evaluate(ds)
+            res = Rule.finalize(*totals[name])
             results[name] = res
             fh.write(f"{name}{delim}{res['support']:.6f}{delim}"
                      f"{res['confidence']:.6f}\n")
@@ -880,18 +897,27 @@ def relief_job(cfg: JobConfig, inputs: List[str], output: str) -> JobResult:
 @job("categoricalClassAffinity", "cca",
      "org.avenir.explore.CategoricalClassAffinity")
 def class_affinity_job(cfg: JobConfig, inputs: List[str], output: str) -> JobResult:
-    from avenir_tpu.models.explore import class_affinity
+    from avenir_tpu.core.stream import stream_job_inputs
+    from avenir_tpu.models.explore import (ContingencyAccumulator,
+                                           class_affinity_from_table)
 
-    ds = _dataset(inputs[0], cfg)
+    schema = _schema(cfg)
+    acc = ContingencyAccumulator()
+    for chunk in stream_job_inputs(cfg, inputs, schema):
+        acc.add(chunk)
+    if acc.n == 0:
+        raise ValueError(f"categoricalClassAffinity: empty input "
+                         f"(no records in {inputs})")
     top_n = cfg.get_int("top.count", 3)
     out = _out_file(output)
     delim = cfg.field_delim
     payload = {}
     with open(out, "w") as fh:
-        for fld in ds.schema.feature_fields:
-            if not fld.is_categorical:
+        for fld in schema.feature_fields:
+            if not fld.is_categorical or fld.ordinal not in acc.tables:
                 continue
-            aff = class_affinity(ds, fld, top_n=top_n)
+            aff = class_affinity_from_table(
+                acc.tables[fld.ordinal], fld, schema.class_values(), top_n)
             payload[fld.ordinal] = aff
             for cv, pairs in aff.items():
                 for val, score in pairs:
@@ -903,20 +929,29 @@ def class_affinity_job(cfg: JobConfig, inputs: List[str], output: str) -> JobRes
 @job("categoricalContinuousEncoding", "coe",
      "org.avenir.explore.CategoricalContinuousEncoding")
 def supervised_encoding_job(cfg: JobConfig, inputs: List[str], output: str) -> JobResult:
-    from avenir_tpu.models.explore import supervised_encoding
+    from avenir_tpu.core.stream import stream_job_inputs
+    from avenir_tpu.models.explore import (ContingencyAccumulator,
+                                           supervised_encoding_from_table)
 
-    ds = _dataset(inputs[0], cfg)
+    schema = _schema(cfg)
+    acc = ContingencyAccumulator()
+    for chunk in stream_job_inputs(cfg, inputs, schema):
+        acc.add(chunk)
+    if acc.n == 0:
+        raise ValueError(f"categoricalContinuousEncoding: empty input "
+                         f"(no records in {inputs})")
     strategy = cfg.get("encoding.strategy", "supervisedRatio")
     pos = cfg.get("pos.class.attr.value")
     out = _out_file(output)
     delim = cfg.field_delim
     payload = {}
     with open(out, "w") as fh:
-        for fld in ds.schema.feature_fields:
-            if not fld.is_categorical:
+        for fld in schema.feature_fields:
+            if not fld.is_categorical or fld.ordinal not in acc.tables:
                 continue
-            enc = supervised_encoding(ds, fld, strategy=strategy,
-                                      pos_class=pos)
+            enc = supervised_encoding_from_table(
+                acc.tables[fld.ordinal], fld, schema.class_values(),
+                strategy=strategy, pos_class=pos)
             payload[fld.ordinal] = enc
             for val, code in enc.items():
                 fh.write(f"{fld.ordinal}{delim}{val}{delim}{code:.6f}\n")
@@ -1648,3 +1683,7 @@ def run_from_cli(argv: Sequence[str]) -> JobResult:
     print(json.dumps({"job": res.name, "counters": res.counters,
                       "outputs": res.outputs}))
     return res
+
+
+if __name__ == "__main__":           # `python -m avenir_tpu.runner ...`
+    run_from_cli(sys.argv[1:])       # same surface as `python -m avenir_tpu`

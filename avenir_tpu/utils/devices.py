@@ -52,6 +52,13 @@ def ensure_usable_backend(timeout_s: float = None) -> str:
         return ""
     env_platforms = os.environ.get("JAX_PLATFORMS", "")
     if env_platforms.split(",")[0].strip() == "cpu":
+        # the accelerator plugin's sitecustomize overrides the env var at
+        # backend init (observed: JAX_PLATFORMS=cpu still hangs on a dead
+        # tunnel); enforce the operator's choice via the config knob,
+        # which the plugin cannot override
+        import jax
+
+        jax.config.update("jax_platforms", env_platforms)
         return ""
     if timeout_s is None:
         timeout_s = float(os.environ.get("AVENIR_DEVICE_PROBE_TIMEOUT", 60))

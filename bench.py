@@ -62,7 +62,8 @@ NB_ROWS = 1_000_000
 NB_STEPS = 8
 STREAM_ROWS = 1_000_000_000
 STREAM_CHUNK = 8_000_000
-STREAM_CSV_ROWS = 8_000_000
+STREAM_CSV_ROWS = 100_000_000
+STREAM_CSV_CACHE = "/tmp/avenir_bench_stream_100m.csv"
 # block must respect the lane kernel's corpus cap (pack_bits <= 12 ->
 # <= 524,288 rows per kernel call) and block_t alignment
 KNN_STREAM_BLOCK = 1 << 19
@@ -177,15 +178,18 @@ def bench_nb_stream():
     - 1B-row accumulate rate: chunks generated on device (PRNG) so the
       number isolates the streaming-fold path at the north star's own
       definition (1e9 rows, flat host RSS) from host CSV parse speed.
-    - on-disk CSV end-to-end: a generated churn CSV streamed through
-      CsvBlockReader + prefetched() into the same accumulate loop —
-      the rate real files achieve, bounded by this host's single core
-      (nproc=1 here; a v5e host shards parse across ~100 cores).
+    - on-disk CSV end-to-end, MEASURED at STREAM_CSV_ROWS=100M real rows
+      (a ~3.8GB file generated once, cached at STREAM_CSV_CACHE): the
+      file streams through CsvBlockReader + prefetched() into the same
+      accumulate loop. The parse uses the native csv_parse_mt path with
+      the host's actual core count (this host: 1 core — stripes scale it
+      on multi-core hosts, unmeasurable here). Overlap efficiency =
+      end-to-end rate / min(parse-only rate, fold-only rate): 1.0 means
+      the prefetch thread fully hides the cheaper stage.
 
     Returns (gen_rows_per_sec, csv_rows_per_sec, csv_parse_rows_per_sec,
-    peak_rss_mb)."""
+    overlap_efficiency, peak_rss_mb)."""
     import resource
-    import tempfile
 
     import jax
     import jax.numpy as jnp
@@ -227,32 +231,60 @@ def bench_nb_stream():
     assert model.class_counts.sum() == STREAM_ROWS
 
     # --- on-disk CSV end-to-end (parse + prefetch + accumulate) ---------
-    blob = generate_churn(100_000, seed=9, as_csv=True)
-    reps = STREAM_CSV_ROWS // 100_000
-    with tempfile.NamedTemporaryFile("w", suffix=".csv", delete=False) as fh:
-        for _ in range(reps):
-            fh.write(blob)
-        path = fh.name
-    try:
-        csv_schema = churn_schema()
-        # parse-only rate (native C++ block parse, no device work)
-        t0 = time.perf_counter()
-        parsed = sum(len(c) for c in iter_csv_chunks(path, csv_schema))
-        parse_rps = parsed / (time.perf_counter() - t0)
-        assert parsed == STREAM_CSV_ROWS
-        model2 = NaiveBayesModel.empty(csv_schema)
-        t0 = time.perf_counter()
-        for ds in prefetched(iter_csv_chunks(path, csv_schema)):
-            codes, _ = ds.feature_codes(model2.binned_fields)
-            model2.accumulate(codes, ds.labels(),
-                              np.zeros((len(ds), 0), np.float32), defer=True)
-        model2.flush()
-        csv_rps = STREAM_CSV_ROWS / (time.perf_counter() - t0)
-        assert model2.class_counts.sum() == STREAM_CSV_ROWS
-    finally:
-        os.unlink(path)
+    # 100M real rows on disk, generated once and cached across runs; the
+    # sidecar marker lets a warm run skip blob generation entirely
+    path = STREAM_CSV_CACHE
+    marker = path + ".rows"
+    valid = (os.path.exists(path) and os.path.exists(marker)
+             and open(marker).read().strip()
+             == f"{STREAM_CSV_ROWS},{os.path.getsize(path)}")
+    if not valid:
+        blob = generate_churn(100_000, seed=9, as_csv=True)
+        with open(path + ".tmp", "w") as fh:
+            for _ in range(STREAM_CSV_ROWS // 100_000):
+                fh.write(blob)
+        os.replace(path + ".tmp", path)
+        with open(marker, "w") as fh:
+            fh.write(f"{STREAM_CSV_ROWS},{os.path.getsize(path)}")
+    csv_schema = churn_schema()
+    # parse-only rate (native csv_parse_mt block parse, no device work)
+    t0 = time.perf_counter()
+    parsed = sum(len(c) for c in iter_csv_chunks(path, csv_schema))
+    parse_rps = parsed / (time.perf_counter() - t0)
+    assert parsed == STREAM_CSV_ROWS
+    # fold-only rate on the SAME chunk shape the CSV path feeds (cached
+    # parsed blocks cycled; includes the per-chunk feature_codes host
+    # encode) — the honest denominator for overlap efficiency
+    model2 = NaiveBayesModel.empty(csv_schema)
+    cached = []
+    for ds in iter_csv_chunks(path, csv_schema):
+        cached.append(ds)
+        if len(cached) >= 4:
+            break
+    fold_rows = 0
+    t0 = time.perf_counter()
+    for i in range(20):
+        ds = cached[i % len(cached)]
+        codes, _ = ds.feature_codes(model2.binned_fields)
+        model2.accumulate(codes, ds.labels(),
+                          np.zeros((len(ds), 0), np.float32), defer=True)
+        fold_rows += len(ds)
+    model2.flush()
+    fold_rps = fold_rows / (time.perf_counter() - t0)
+    cached = None
+    model2 = NaiveBayesModel.empty(csv_schema)
+    t0 = time.perf_counter()
+    for ds in prefetched(iter_csv_chunks(path, csv_schema)):
+        codes, _ = ds.feature_codes(model2.binned_fields)
+        model2.accumulate(codes, ds.labels(),
+                          np.zeros((len(ds), 0), np.float32), defer=True)
+    model2.flush()
+    csv_rps = STREAM_CSV_ROWS / (time.perf_counter() - t0)
+    assert model2.class_counts.sum() == STREAM_CSV_ROWS
+    # perfect parse/fold overlap would run at the slower stage's rate
+    overlap_eff = csv_rps / min(parse_rps, fold_rps)
     peak_rss_mb = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / 1024.0
-    return gen_rps, csv_rps, parse_rps, peak_rss_mb
+    return gen_rps, csv_rps, parse_rps, overlap_eff, peak_rss_mb
 
 
 def bench_knn_stream():
@@ -619,7 +651,8 @@ def main():
     dev = jax.devices()[0]
     peak = PEAK_FLOPS.get(dev.device_kind, DEFAULT_PEAK)
     train_rps, predict_rps, nb_rps = bench_naive_bayes()
-    stream_rps, stream_csv_rps, parse_rps, rss_mb = bench_nb_stream()
+    (stream_rps, stream_csv_rps, parse_rps, overlap_eff,
+     rss_mb) = bench_nb_stream()
     (knn_stream_rps, knn_stream_pds, knn_stream_s,
      knn_stream_pallas) = bench_knn_stream()
     rf_rls, rf_levels, rf_predict_rps = bench_random_forest()
@@ -719,17 +752,18 @@ def main():
             "proxy, the kernel cost being data-independent)"),
         "nb_stream_csv_rows_per_sec": round(stream_csv_rps, 1),
         "csv_parse_rows_per_sec": round(parse_rps, 1),
+        "csv_overlap_efficiency": round(overlap_eff, 3),
         "peak_rss_mb": round(rss_mb, 1),
         "stream_note": (f"streaming path: {STREAM_ROWS//10**6}M rows folded "
                         "through accumulate(defer=True) in "
                         f"{STREAM_CHUNK//10**6}M-row chunks that never "
                         "coexist in memory (device-generated, isolates the "
-                        "fold from host parse); csv figures stream "
-                        f"{STREAM_CSV_ROWS//10**6}M on-disk rows through "
-                        "CsvBlockReader+prefetched() and are bounded by "
-                        "this host's single core (nproc=1; the native "
-                        "csv_parse_mt stripes the parse across all cores "
-                        "on real multi-core hosts)"),
+                        "fold from host parse); csv figures are MEASURED "
+                        f"over {STREAM_CSV_ROWS//10**6}M real on-disk rows "
+                        "(~3.8GB) through CsvBlockReader+prefetched() with "
+                        "the native csv_parse_mt at the host's core count "
+                        "(this host: 1); overlap_efficiency = end-to-end / "
+                        "min(parse-only, fold-only) rate"),
         "baseline_note": ("vs_baseline divides by DOCUMENTED ESTIMATES of a "
                           "32-node Hadoop cluster (1.0e6 NB rows/sec, 3.2e7 "
                           "pair-distances/sec — see module docstring), not "

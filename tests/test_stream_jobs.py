@@ -235,3 +235,47 @@ def test_apriori_emit_trans_id_streams(tmp_path):
     first = open(res.outputs[0]).read().splitlines()[0]
     # per-set exact transaction id lists ride along (fia.emit.trans.id)
     assert any(tok.startswith("T") for tok in first.split(","))
+
+
+def test_rule_evaluator_chunked_equals_whole(churn, tmp_path):
+    props = {"rue.feature.schema.file.path": churn["schema"],
+             "rue.rule.names": "r1",
+             "rue.rule.r1": "3 eq high => 6 eq closed"}
+    whole, chunked = _run_both("ruleEvaluator", props,
+                               [churn["train"]], tmp_path, "rue")
+    assert whole == chunked and whole.strip()
+
+
+def test_class_affinity_chunked_equals_whole(churn, tmp_path):
+    props = {"cca.feature.schema.file.path": churn["schema"]}
+    whole, chunked = _run_both("categoricalClassAffinity", props,
+                               [churn["train"]], tmp_path, "cca")
+    assert whole == chunked and whole.strip()
+
+
+def test_supervised_encoding_chunked_equals_whole(churn, tmp_path):
+    props = {"coe.feature.schema.file.path": churn["schema"],
+             "coe.encoding.strategy": "weightOfEvidence"}
+    whole, chunked = _run_both("categoricalContinuousEncoding", props,
+                               [churn["train"]], tmp_path, "coe")
+    assert whole == chunked and whole.strip()
+
+
+def test_mi_fused_and_fallback_paths_agree(churn, monkeypatch):
+    """The fused 3-dispatch MI chunk kernel and the per-pair cross_count
+    fallback (taken when int32 keys would wrap) must produce identical
+    tables."""
+    from avenir_tpu.core.dataset import Dataset
+    from avenir_tpu.core.schema import FeatureSchema
+    from avenir_tpu.models import explore
+
+    ds = Dataset.from_csv(open(churn["train"]).read(),
+                          FeatureSchema.from_file(churn["schema"]))
+    fused = explore.MutualInformationAnalyzer(ds)
+    monkeypatch.setattr(explore, "_FUSED_KEYSPACE_LIMIT", 1)
+    fallback = explore.MutualInformationAnalyzer(ds)
+    np.testing.assert_array_equal(fused.feature_class_mi,
+                                  fallback.feature_class_mi)
+    np.testing.assert_array_equal(fused.pair_class_mi,
+                                  fallback.pair_class_mi)
+    np.testing.assert_array_equal(fused.pair_mi, fallback.pair_mi)

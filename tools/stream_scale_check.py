@@ -1,0 +1,133 @@
+"""100M-row streaming-scale demonstration (VERDICT r4 #3/#6 'done when').
+
+Runs, each in its own subprocess (so peak-RSS is per-job):
+  1. mutualInformation over 100M real on-disk churn rows (~3.8GB CSV);
+  2. markovStateTransitionModel (per-class) over 100M sequence rows (~2GB);
+asserting host RSS stays O(block) — a whole-file ingest of either input
+would need >2x the file size resident; the streamed jobs are asserted
+under 3GB regardless of input size.
+
+Writes one JSON line per job and a summary to STREAM_SCALE_r05.json.
+Works on CPU (pins the platform; the point is ingest scale, not device
+speed — bench.py measures the TPU fold rates).
+
+Usage: python tools/stream_scale_check.py [--rows N_MILLION]
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+sys.path.insert(0, ".")
+
+ROWS_M = int(sys.argv[sys.argv.index("--rows") + 1]) \
+    if "--rows" in sys.argv else 100
+CHURN_CSV = f"/tmp/avenir_scale_churn_{ROWS_M}m.csv"
+SEQ_CSV = f"/tmp/avenir_scale_seq_{ROWS_M}m.csv"
+RSS_LIMIT_MB = 3072
+
+_CHILD = r'''
+import json, os, resource, sys, time
+sys.path.insert(0, ".")
+import jax
+jax.config.update("jax_platforms", "cpu")
+from avenir_tpu.runner import run_job
+
+job, conf_json, inp, out = sys.argv[1:5]
+t0 = time.perf_counter()
+res = run_job(job, json.loads(conf_json), [inp], out)
+dt = time.perf_counter() - t0
+rss = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / 1024.0
+rows = next((v for k, v in res.counters.items() if "Records" in k), None)
+print(json.dumps({"job": job, "seconds": round(dt, 1),
+                  "rows": rows, "peak_rss_mb": round(rss, 1),
+                  "counters": res.counters}))
+'''
+
+
+def ensure_file(path, blob, reps):
+    want = len(blob.encode()) * reps
+    if os.path.exists(path) and os.path.getsize(path) == want:
+        return
+    with open(path + ".tmp", "w") as fh:
+        for _ in range(reps):
+            fh.write(blob)
+    os.replace(path + ".tmp", path)
+
+
+def run_child(job, conf, inp, out):
+    env = dict(os.environ, AVENIR_SKIP_DEVICE_PROBE="1")
+    proc = subprocess.run([sys.executable, "-c", _CHILD, job,
+                           json.dumps(conf), inp, out],
+                          capture_output=True, text=True, timeout=7200,
+                          env=env)
+    if proc.returncode != 0:
+        raise RuntimeError(f"{job} failed: {proc.stderr[-500:]}")
+    line = json.loads(proc.stdout.strip().splitlines()[-1])
+    print(json.dumps(line), flush=True)
+    assert line["peak_rss_mb"] < RSS_LIMIT_MB, \
+        f"{job} RSS {line['peak_rss_mb']}MB not O(block)"
+    return line
+
+
+def main():
+    import numpy as np
+
+    jax_free_env = dict(os.environ)  # generation needs no jax at all
+    del jax_free_env
+
+    from avenir_tpu.data import churn_schema, generate_churn
+
+    t0 = time.perf_counter()
+    schema_path = "/tmp/avenir_scale_churn.json"
+    churn_schema().save(schema_path)
+    churn_blob = generate_churn(100_000, seed=9, as_csv=True)
+    ensure_file(CHURN_CSV, churn_blob, ROWS_M * 10)
+
+    rng = np.random.default_rng(12)
+    states = ["L", "M", "H"]
+    lines = []
+    for i in range(100_000):
+        up = i % 2 == 0
+        s, toks = 1, []
+        for _ in range(6):
+            p = [0.1, 0.3, 0.6] if up else [0.6, 0.3, 0.1]
+            s = int(np.clip(s + rng.choice([-1, 0, 1], p=p), 0, 2))
+            toks.append(states[s])
+        lines.append(f"c{i},{'T' if up else 'F'}," + ",".join(toks))
+    ensure_file(SEQ_CSV, "\n".join(lines) + "\n", ROWS_M * 10)
+    print(f"# inputs ready in {time.perf_counter()-t0:.0f}s: "
+          f"{os.path.getsize(CHURN_CSV)>>20}MB churn, "
+          f"{os.path.getsize(SEQ_CSV)>>20}MB sequences", flush=True)
+
+    results = {"rows": ROWS_M * 1_000_000,
+               "churn_csv_mb": os.path.getsize(CHURN_CSV) >> 20,
+               "seq_csv_mb": os.path.getsize(SEQ_CSV) >> 20,
+               "rss_limit_mb": RSS_LIMIT_MB}
+    results["mutualInformation"] = run_child(
+        "mutualInformation",
+        {"mut.feature.schema.file.path": schema_path,
+         "mut.mutual.info.score.algorithms": "mutual.info.maximization"},
+        CHURN_CSV, "/tmp/avenir_scale_mi.txt")
+    results["markovStateTransitionModel"] = run_child(
+        "markovStateTransitionModel",
+        {"mst.model.states": "L,M,H", "mst.class.label.field.ord": "1",
+         "mst.skip.field.count": "2", "mst.class.labels": "T,F"},
+        SEQ_CSV, "/tmp/avenir_scale_mst.txt")
+    with open("STREAM_SCALE_r05.json", "w") as fh:
+        json.dump(results, fh, indent=1)
+    print(json.dumps({"stream_scale": "done",
+                      "mi_rows_per_sec": round(
+                          results["rows"]
+                          / results["mutualInformation"]["seconds"], 1),
+                      "mst_rows_per_sec": round(
+                          results["rows"]
+                          / results["markovStateTransitionModel"]["seconds"],
+                          1)}))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
