@@ -193,17 +193,12 @@ def stream_job_inputs(cfg, inputs: Iterable[str], schema: FeatureSchema,
             path, schema, cfg.field_delim_regex, block, keep_raw=keep_raw))
 
 
-def iter_line_blocks(path: str,
+def iter_byte_blocks(path: str,
                      block_bytes: int = DEFAULT_BLOCK_BYTES
-                     ) -> Iterator[list]:
-    """Yield lists of non-empty text lines, ~block_bytes of file each.
-
-    The untyped-row analog of CsvBlockReader for jobs whose input is not
-    schema-typed CSV (sequence files, transaction lists, free text): the
-    reference streams those one line at a time through the same mapper
-    contract (e.g. markov/MarkovStateTransitionModel.java:116-133,
-    association/FrequentItemsApriori.java:138-150); here the unit is a
-    block of lines, so host RSS stays O(block) however large the file."""
+                     ) -> Iterator[bytes]:
+    """Yield ~block_bytes raw byte blocks cut at line boundaries — the
+    zero-copy feed for native block consumers (seq_encode): no decode,
+    no per-line Python strings."""
     if not os.path.exists(path):
         raise FileNotFoundError(f"no such input file: {path!r}")
     with open(path, "rb") as fh:
@@ -218,14 +213,29 @@ def iter_line_blocks(path: str,
                 carry = data
                 continue
             carry = data[cut + 1:]
-            lines = data[:cut].decode("utf-8", "replace").split("\n")
-            lines = [ln.rstrip("\r") for ln in lines if ln.strip()]
-            if lines:
-                yield lines
+            if data[:cut].strip():
+                yield data[:cut + 1]
         if carry.strip():
-            yield [ln.rstrip("\r")
-                   for ln in carry.decode("utf-8", "replace").split("\n")
-                   if ln.strip()]
+            yield carry
+
+
+def iter_line_blocks(path: str,
+                     block_bytes: int = DEFAULT_BLOCK_BYTES
+                     ) -> Iterator[list]:
+    """Yield lists of non-empty text lines, ~block_bytes of file each.
+
+    The untyped-row analog of CsvBlockReader for jobs whose input is not
+    schema-typed CSV (sequence files, transaction lists, free text): the
+    reference streams those one line at a time through the same mapper
+    contract (e.g. markov/MarkovStateTransitionModel.java:116-133,
+    association/FrequentItemsApriori.java:138-150); here the unit is a
+    block of lines, so host RSS stays O(block) however large the file."""
+    for blk in iter_byte_blocks(path, block_bytes):
+        lines = [ln.rstrip("\r")
+                 for ln in blk.decode("utf-8", "replace").split("\n")
+                 if ln.strip()]
+        if lines:
+            yield lines
 
 
 def stream_job_lines(cfg, inputs: Iterable[str]) -> Iterator[list]:

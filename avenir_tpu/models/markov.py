@@ -103,6 +103,61 @@ class MarkovStateTransitionModel:
         )[:k]
         return self
 
+    def fit_csr(self, codes: np.ndarray, offsets: np.ndarray,
+                skip: int, class_ord: Optional[int] = None,
+                label_codes: Optional[np.ndarray] = None
+                ) -> "MarkovStateTransitionModel":
+        """Fold one CSR-encoded line block (native seq_encode output:
+        tokens dictionary-encoded against a vocabulary whose first
+        len(states) entries are the states; `label_codes[k]` gives the
+        vocab code of class_labels[k] — a label that IS a state shares
+        the state's code. Meta tokens are -1). Same semantics as fit() —
+        unknown state tokens in the sequence region raise, transitions
+        never cross rows — but the whole count is numpy/C speed: the
+        sequence jobs' answer to the CSV jobs' native columnar parse."""
+        s = len(self.states)
+        n = offsets.shape[0] - 1
+        if n <= 0:
+            return self
+        lens = np.diff(offsets)
+        row_of = np.repeat(np.arange(n), lens)
+        starts = offsets[:-1]
+        idx = np.arange(codes.shape[0])
+        in_seq = idx >= (starts[row_of] + skip)
+        bad = in_seq & ((codes < 0) | (codes >= s))
+        if bad.any():
+            b = int(np.flatnonzero(bad)[0])
+            raise ValueError(
+                f"unknown state token at row {int(row_of[b])}, "
+                f"position {int(b - starts[row_of[b]])}")
+        if self.class_labels:
+            k = len(self.class_labels)
+            if class_ord is None:
+                raise ValueError("class_ord required with class_labels")
+            if label_codes is None:
+                label_codes = s + np.arange(k)
+            if (lens <= class_ord).any():
+                r = int(np.argmax(lens <= class_ord))
+                raise ValueError(f"row {r} has no class field "
+                                 f"(ordinal {class_ord})")
+            inv = np.full(int(label_codes.max()) + 2, -1, np.int64)
+            inv[label_codes] = np.arange(k)
+            raw = codes[starts + class_ord].astype(np.int64)
+            ok = (raw >= 0) & (raw < inv.shape[0] - 1)
+            y = np.where(ok, inv[np.clip(raw, 0, inv.shape[0] - 1)], -1)
+            if (y < 0).any():
+                r = int(np.argmax(y < 0))
+                raise ValueError(f"unknown class label in row {r}")
+        else:
+            k = 1
+            y = np.zeros(n, np.int64)
+        prev, nxt = codes[:-1], codes[1:]
+        valid = in_seq[:-1] & (row_of[:-1] == row_of[1:])
+        key = (y[row_of[:-1]] * s + prev) * s + nxt
+        self.counts += np.bincount(
+            key[valid], minlength=k * s * s).reshape(k, s, s)
+        return self
+
     def fit_entities(self, seqs: Sequence[Sequence[str]],
                      entity_keys: Sequence[str]) -> "MarkovStateTransitionModel":
         """Per-entity accumulate that grows the label axis in place — the

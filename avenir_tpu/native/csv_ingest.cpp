@@ -457,4 +457,89 @@ int64_t csv_extract_column(const char* buf, int64_t len, char delim,
     return w;
 }
 
+// Ragged tokenize + dictionary-encode (the sequence-job ingest: markov /
+// HMM lines are "id,class,s1,s2,..." with per-row token counts). One scan
+// splits every non-empty line by `delim`, ASCII-trims each token, and
+// encodes it against ONE vocabulary (n_vocab zero-terminated strings back
+// to back in vocab_blob); unknown tokens (ids, free meta fields) encode
+// as -1 and the CALLER decides which positions must be known. Outputs
+// CSR: codes[total_tokens] + offsets[n_rows+1] (offsets[0] = 0).
+// seq_token_count sizes the arrays; seq_encode returns rows written or
+// -3 when the buffers are too small.
+int64_t seq_token_count(const char* buf, int64_t len, char delim,
+                        int64_t* out_tokens) {
+    int64_t rows = 0, tokens = 0;
+    const char* p = buf;
+    const char* end = buf + len;
+    while (p < end) {
+        const char* nl = static_cast<const char*>(
+            memchr(p, '\n', static_cast<size_t>(end - p)));
+        const char* e = nl ? nl : end;
+        // row-ness must match seq_encode EXACTLY: whitespace-only lines
+        // are skipped even when the delimiter itself is a whitespace char
+        bool all_ws = true;
+        int64_t t = 1;
+        for (const char* q = p; q < e; ++q) {
+            if (*q == delim) ++t;
+            if (*q != ' ' && *q != '\t' && *q != '\r') all_ws = false;
+        }
+        if (!all_ws) { ++rows; tokens += t; }
+        p = nl ? nl + 1 : end;
+    }
+    *out_tokens = tokens;
+    return rows;
+}
+
+int64_t seq_encode(const char* buf, int64_t len, char delim,
+                   const char* vocab_blob, int32_t n_vocab,
+                   int32_t* codes, int64_t max_tokens,
+                   int64_t* offsets, int64_t max_rows) {
+    Vocab vocab;
+    const char* v = vocab_blob;
+    for (int32_t i = 0; i < n_vocab; ++i) {
+        size_t n = strlen(v);
+        vocab.values.emplace_back(v, n);
+        v += n + 1;
+    }
+    vocab.build();
+
+    int64_t rows = 0, tok = 0;
+    offsets[0] = 0;
+    const char* p = buf;
+    const char* end = buf + len;
+    while (p < end) {
+        const char* nl = static_cast<const char*>(
+            memchr(p, '\n', static_cast<size_t>(end - p)));
+        const char* e = nl ? nl : end;
+        // whitespace-only lines don't produce rows (the Python line
+        // reader's `if ln.strip()` filter); a delim-only line DOES (it
+        // parses into empty tokens, exactly like the Python split path)
+        bool all_ws = true;
+        for (const char* s = p; s < e; ++s)
+            if (*s != ' ' && *s != '\t' && *s != '\r') { all_ws = false; break; }
+        if (all_ws) {
+            p = nl ? nl + 1 : end;
+            continue;
+        }
+        if (rows + 1 >= max_rows) return -3;   // offsets[++rows] must fit
+        const char* ts = p;
+        for (const char* s = p;; ++s) {
+            if (s == e || *s == delim) {
+                const char* a = ts;
+                const char* b = s;
+                while (a < b && (*a == ' ' || *a == '\t' || *a == '\r')) ++a;
+                while (b > a && (b[-1] == ' ' || b[-1] == '\t'
+                                 || b[-1] == '\r')) --b;
+                if (tok >= max_tokens) return -3;
+                codes[tok++] = vocab.find(a, static_cast<size_t>(b - a));
+                ts = s + 1;
+                if (s == e) break;
+            }
+        }
+        offsets[++rows] = tok;
+        p = nl ? nl + 1 : end;
+    }
+    return rows;
+}
+
 }  // extern "C"

@@ -69,6 +69,12 @@ def _build() -> Optional[ctypes.CDLL]:
     lib.csv_extract_column.restype = i64
     lib.csv_extract_column.argtypes = [c_char_p, i64, ctypes.c_char, i32,
                                        ctypes.c_char_p, i64]
+    p_i64_arr = np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS")
+    lib.seq_token_count.restype = i64
+    lib.seq_token_count.argtypes = [c_char_p, i64, ctypes.c_char, p_i64]
+    lib.seq_encode.restype = i64
+    lib.seq_encode.argtypes = [c_char_p, i64, ctypes.c_char,
+                               c_char_p, i32, p_i32, i64, p_i64_arr, i64]
     return lib
 
 
@@ -186,3 +192,29 @@ def _extract_column(lib, data: bytes, d: bytes, ordinal: int) -> List[str]:
     if not raw:
         return []
     return raw.decode().split("\n")[:-1]
+
+
+def seq_encode_native(data: bytes, delim: str, vocab: List[str]
+                      ) -> Optional[Tuple[np.ndarray, np.ndarray]]:
+    """Ragged tokenize + dictionary-encode a text block against one
+    vocabulary (the sequence-job ingest). Returns (codes int32
+    [total_tokens], offsets int64 [rows+1]) in CSR form — token t of row
+    r is codes[offsets[r] + t]; unknown tokens are -1. None when the
+    native library is unavailable (callers fall back to Python split)."""
+    lib = _get_lib()
+    if lib is None:
+        return None
+    d = delim.encode()
+    if len(d) != 1:
+        return None
+    n_tokens = ctypes.c_int64(0)
+    n_rows = int(lib.seq_token_count(data, len(data), d,
+                                     ctypes.byref(n_tokens)))
+    codes = np.empty(max(n_tokens.value, 1), np.int32)
+    offsets = np.empty(n_rows + 1, np.int64)
+    blob = b"".join(v.encode() + b"\0" for v in vocab)
+    got = int(lib.seq_encode(data, len(data), d, blob, len(vocab),
+                             codes, codes.shape[0], offsets, n_rows + 1))
+    if got != n_rows:
+        raise RuntimeError(f"seq_encode row mismatch: {got} != {n_rows}")
+    return codes[: int(offsets[n_rows])], offsets

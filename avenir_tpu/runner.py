@@ -1361,12 +1361,50 @@ def markov_model_job(cfg: JobConfig, inputs: List[str], output: str) -> JobResul
         states, scale=scale,
         class_labels=class_labels,
     )
-    for lines in stream_job_lines(cfg, inputs):
-        _, seqs, labels = _parse_sequences(lines, cfg.field_delim_regex,
-                                           skip, class_ord)
-        model.fit(seqs, labels if class_labels else None)
+    delim = cfg.field_delim_regex
+    # one shared vocabulary: states first (codes 0..S-1), then any class
+    # labels that are not themselves state names; label_codes maps class
+    # index -> vocab code either way
+    vocab = list(states)
+    for lab in class_labels or []:
+        if lab not in vocab:
+            vocab.append(lab)
+    label_codes = np.asarray([vocab.index(lab)
+                              for lab in class_labels or []])
+    rows = 0
+    from avenir_tpu.native.ingest import native_available, seq_encode_native
+
+    if len(delim.encode()) == 1 and native_available():
+        # native ragged tokenize+encode straight from raw byte blocks
+        # (CSR codes; no per-line Python strings exist at any point)
+        from avenir_tpu.core.stream import iter_byte_blocks, prefetched
+
+        block = int(cfg.get_float("stream.block.size.mb", 64.0) * (1 << 20))
+        for path in inputs:
+            for data in prefetched(iter_byte_blocks(path, block)):
+                enc = seq_encode_native(data, delim, vocab)
+                if enc is None:           # lib lost mid-run: degrade
+                    _, seqs, labels = _parse_sequences(
+                        [ln for ln in
+                         data.decode("utf-8", "replace").splitlines()
+                         if ln.strip()],
+                        delim, skip, class_ord)
+                    model.fit(seqs, labels if class_labels else None)
+                    rows += len(seqs)
+                    continue
+                model.fit_csr(*enc, skip=skip,
+                              class_ord=class_ord if class_labels else None,
+                              label_codes=label_codes)
+                rows += enc[1].shape[0] - 1
+    else:
+        for lines in stream_job_lines(cfg, inputs):
+            _, seqs, labels = _parse_sequences(lines, delim, skip,
+                                               class_ord)
+            model.fit(seqs, labels if class_labels else None)
+            rows += len(seqs)
     model.save(out, delim=cfg.field_delim)
-    return JobResult("markovStateTransitionModel", {}, [out], model)
+    return JobResult("markovStateTransitionModel",
+                     {"Basic:Records": rows}, [out], model)
 
 
 @job("markovModelClassifier", "mmc",
@@ -1385,14 +1423,17 @@ def markov_classifier_job(cfg: JobConfig, inputs: List[str], output: str) -> Job
     skip = cfg.get_int("skip.field.count", 1)
     class_ord = cfg.get_int("class.label.field.ord") \
         if cfg.get_bool("validation.mode", False) else None
+    from avenir_tpu.core.stream import stream_job_lines
+
     out = _out_file(output)
     delim = cfg.field_delim
     counters: Dict[str, float] = {}
     actual, predicted = [], []
     with open(out, "w") as fh:
-        for path in inputs:
-            ids, seqs, labels = _read_sequences(path, cfg.field_delim_regex,
-                                                skip, class_ord)
+        # map-only row transform at O(block): classify per line block
+        for lines in stream_job_lines(cfg, inputs):
+            ids, seqs, labels = _parse_sequences(
+                lines, cfg.field_delim_regex, skip, class_ord)
             cls, scores = clf.predict(seqs)
             for rid, c, s in zip(ids, cls, scores):
                 fh.write(f"{rid}{delim}{c}{delim}{s:.6f}\n")

@@ -760,7 +760,8 @@ def main():
                         "coexist in memory (device-generated, isolates the "
                         "fold from host parse); csv figures are MEASURED "
                         f"over {STREAM_CSV_ROWS//10**6}M real on-disk rows "
-                        "(~3.8GB) through CsvBlockReader+prefetched() with "
+                        f"(~{STREAM_CSV_ROWS*38/10**9:.1f}GB) through "
+                        "CsvBlockReader+prefetched() with "
                         "the native csv_parse_mt at the host's core count "
                         "(this host: 1); overlap_efficiency = end-to-end / "
                         "min(parse-only, fold-only) rate"),

@@ -279,3 +279,46 @@ def test_mi_fused_and_fallback_paths_agree(churn, monkeypatch):
     np.testing.assert_array_equal(fused.pair_class_mi,
                                   fallback.pair_class_mi)
     np.testing.assert_array_equal(fused.pair_mi, fallback.pair_mi)
+
+
+def test_markov_native_and_python_paths_agree(tmp_path, monkeypatch):
+    """The native CSR encode path and the python split path must produce
+    identical models (the native lib may be unavailable on some hosts)."""
+    import avenir_tpu.native.ingest as ingest
+
+    path = _markov_file(tmp_path)
+    props = {
+        "mst.model.states": "L,M,H",
+        "mst.class.label.field.ord": "1",
+        "mst.skip.field.count": "2",
+        "mst.class.labels": "T,F",
+    }
+    native_out = str(tmp_path / "mn.txt")
+    run_job("markovStateTransitionModel", props, [path], native_out)
+    monkeypatch.setattr(ingest, "native_available", lambda: False)
+    py_out = str(tmp_path / "mp.txt")
+    run_job("markovStateTransitionModel", props, [path], py_out)
+    assert open(native_out).read() == open(py_out).read()
+
+
+def test_markov_class_label_collides_with_state(tmp_path):
+    """A class label that IS a state name must work identically on the
+    native and python paths (shared-vocabulary disambiguation)."""
+    import avenir_tpu.native.ingest as ingest
+
+    path = str(tmp_path / "seq.csv")
+    with open(path, "w") as fh:
+        fh.write("a,H,L,M,H\nb,F,H,M,L\nc,H,M,M,H\n")
+    props = {
+        "mst.model.states": "L,M,H",
+        "mst.class.label.field.ord": "1",
+        "mst.skip.field.count": "2",
+        "mst.class.labels": "H,F",       # 'H' is also a state
+    }
+    out_n = str(tmp_path / "n.txt")
+    run_job("markovStateTransitionModel", props, [path], out_n)
+    assert "classLabel:H" in open(out_n).read()
+    out_p = str(tmp_path / "p.txt")
+    run_job("markovStateTransitionModel",
+            {**props, "mst.stream.block.size.mb": TINY_BLOCK}, [path], out_p)
+    assert open(out_n).read() == open(out_p).read()
