@@ -141,7 +141,10 @@ class StreamingTransactionSource:
         for path in self.paths:
             for lines in prefetched(
                     iter_line_blocks(path, self.block_bytes)):
-                yield [[t.strip() for t in ln.split(self.delim)]
+                # trim set matches the native seq_encode trim exactly
+                # (space/tab/CR): the vocab pass and the native counting
+                # pass must agree on token identity
+                yield [[t.strip(" \t\r") for t in ln.split(self.delim)]
                        for ln in lines]
 
     def scan_items(self) -> Tuple[List[str], np.ndarray, int]:
@@ -170,10 +173,46 @@ class StreamingTransactionSource:
         return self.vocab, self._item_counts, self.n_trans
 
     def chunks(self, block_rows: int = 8192, with_ids: bool = False):
-        """Yield (multihot uint8 [block_rows, V], ids) blocks; the final
-        block zero-pads its row tail (an all-zero row contains no k>=1
-        candidate, so it never counts)."""
+        """Yield (multihot uint8 [block_rows, V], ids) blocks; zero-pad
+        row tails (an all-zero row contains no k>=1 candidate, so it
+        never counts). The counting passes (no ids needed) ride the
+        native ragged encoder when built — no per-row Python exists on
+        the N-proportional path."""
+        from avenir_tpu.native.ingest import (native_available,
+                                              seq_encode_native)
+
         V = max(len(self.vocab), 1)
+        if (not with_ids and len(self.delim.encode()) == 1
+                and native_available()):
+            from avenir_tpu.core.stream import iter_byte_blocks, prefetched
+
+            for path in self.paths:
+                for data in prefetched(
+                        iter_byte_blocks(path, self.block_bytes)):
+                    # cannot be None: availability + 1-byte delim checked
+                    codes, offsets = seq_encode_native(
+                        data, self.delim, self.vocab)
+                    n = offsets.shape[0] - 1
+                    if n <= 0:
+                        continue
+                    lens = np.diff(offsets)
+                    row_of = np.repeat(np.arange(n), lens)
+                    starts = offsets[:-1]
+                    idx = np.arange(codes.shape[0])
+                    # item region only; unknown tokens (-1: ids, marker,
+                    # empties) drop exactly like the python path
+                    valid = (idx >= starts[row_of] + self.skip) & (codes >= 0)
+                    r, c = row_of[valid], codes[valid]
+                    # r is sorted (row_of nondecreasing): each page is a
+                    # searchsorted slice, not a full-array rescan
+                    bounds = np.searchsorted(
+                        r, np.arange(0, n + block_rows, block_rows))
+                    for page, (lo, hi) in enumerate(
+                            zip(bounds[:-1], bounds[1:])):
+                        mh = np.zeros((block_rows, V), np.uint8)
+                        mh[r[lo:hi] - page * block_rows, c[lo:hi]] = 1
+                        yield mh, []
+            return
 
         def emit(rows):
             mh = np.zeros((block_rows, V), np.uint8)

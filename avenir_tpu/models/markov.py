@@ -135,7 +135,11 @@ class MarkovStateTransitionModel:
             if class_ord is None:
                 raise ValueError("class_ord required with class_labels")
             if label_codes is None:
-                label_codes = s + np.arange(k)
+                # no safe default exists: a label that IS a state shares
+                # the state's code, which only the vocab builder knows
+                raise ValueError(
+                    "label_codes required with class_labels (vocab code "
+                    "of each class label, see the mst runner)")
             if (lens <= class_ord).any():
                 r = int(np.argmax(lens <= class_ord))
                 raise ValueError(f"row {r} has no class field "
@@ -332,6 +336,38 @@ class HiddenMarkovModelBuilder:
             self.trans_counts[a, b] += 1
         for s, o in zip(ss, oo):
             self.emis_counts[s, o] += 1
+
+    def add_csr(self, codes: np.ndarray, offsets: np.ndarray,
+                skip: int) -> None:
+        """Fold a CSR block of `obs<sub>state` pair tokens encoded with
+        pair_code = state_index * n_obs + obs_index (native seq_encode
+        against the state-major pair vocabulary — see the hmmb runner).
+        Count-identical to calling add() per row; pure numpy bincount."""
+        s, o = len(self.states), len(self.observations)
+        n = offsets.shape[0] - 1
+        if n <= 0:
+            return
+        lens = np.diff(offsets)
+        row_of = np.repeat(np.arange(n), lens)
+        starts = offsets[:-1]
+        idx = np.arange(codes.shape[0])
+        in_seq = idx >= (starts[row_of] + skip)
+        bad = in_seq & ((codes < 0) | (codes >= s * o))
+        if bad.any():
+            b = int(np.flatnonzero(bad)[0])
+            raise ValueError(
+                f"unknown obs:state token at row {int(row_of[b])}, "
+                f"position {int(b - starts[row_of[b]])}")
+        st = np.where(in_seq, codes // o, 0)
+        ob = np.where(in_seq, codes % o, 0)
+        firsts = starts + skip
+        firsts = firsts[firsts < offsets[1:]]
+        self.init_counts += np.bincount(st[firsts], minlength=s)
+        valid = in_seq[:-1] & (row_of[:-1] == row_of[1:])
+        self.trans_counts += np.bincount(
+            (st[:-1] * s + st[1:])[valid], minlength=s * s).reshape(s, s)
+        self.emis_counts += np.bincount(
+            (st * o + ob)[in_seq], minlength=s * o).reshape(s, o)
 
     def add_partially_tagged(self, tokens: Sequence[str],
                              window_function: Sequence[int]) -> None:

@@ -1382,16 +1382,8 @@ def markov_model_job(cfg: JobConfig, inputs: List[str], output: str) -> JobResul
         block = int(cfg.get_float("stream.block.size.mb", 64.0) * (1 << 20))
         for path in inputs:
             for data in prefetched(iter_byte_blocks(path, block)):
+                # cannot be None: availability + 1-byte delim pre-checked
                 enc = seq_encode_native(data, delim, vocab)
-                if enc is None:           # lib lost mid-run: degrade
-                    _, seqs, labels = _parse_sequences(
-                        [ln for ln in
-                         data.decode("utf-8", "replace").splitlines()
-                         if ln.strip()],
-                        delim, skip, class_ord)
-                    model.fit(seqs, labels if class_labels else None)
-                    rows += len(seqs)
-                    continue
                 model.fit_csr(*enc, skip=skip,
                               class_ord=class_ord if class_labels else None,
                               label_codes=label_codes)
@@ -1473,11 +1465,29 @@ def hmm_builder_job(cfg: JobConfig, inputs: List[str], output: str) -> JobResult
             for seq in seqs:
                 builder.add_partially_tagged(seq, wf)
     else:
-        for lines in stream_job_lines(cfg, inputs):
-            _, seqs, _ = _parse_sequences(lines, cfg.field_delim_regex, skip)
-            for seq in seqs:
-                pairs = [tok.split(sub) for tok in seq]
-                builder.add([p[1] for p in pairs], [p[0] for p in pairs])
+        delim = cfg.field_delim_regex
+        from avenir_tpu.native.ingest import (native_available,
+                                              seq_encode_native)
+
+        if len(delim.encode()) == 1 and native_available():
+            # native path: encode whole `obs:state` pair tokens against
+            # the state-major pair vocabulary straight from byte blocks
+            from avenir_tpu.core.stream import iter_byte_blocks, prefetched
+
+            vocab = [f"{ov}{sub}{sv}" for sv in states for ov in obs]
+            block = int(cfg.get_float("stream.block.size.mb", 64.0)
+                        * (1 << 20))
+            for path in inputs:
+                for data in prefetched(iter_byte_blocks(path, block)):
+                    # cannot be None: availability + delim pre-checked
+                    enc = seq_encode_native(data, delim, vocab)
+                    builder.add_csr(*enc, skip=skip)
+        else:
+            for lines in stream_job_lines(cfg, inputs):
+                _, seqs, _ = _parse_sequences(lines, delim, skip)
+                for seq in seqs:
+                    pairs = [tok.split(sub) for tok in seq]
+                    builder.add([p[1] for p in pairs], [p[0] for p in pairs])
     hmm = builder.finish()
     out = _out_file(output)
     hmm.save(out, delim=cfg.field_delim)

@@ -301,7 +301,7 @@ def test_markov_native_and_python_paths_agree(tmp_path, monkeypatch):
     assert open(native_out).read() == open(py_out).read()
 
 
-def test_markov_class_label_collides_with_state(tmp_path):
+def test_markov_class_label_collides_with_state(tmp_path, monkeypatch):
     """A class label that IS a state name must work identically on the
     native and python paths (shared-vocabulary disambiguation)."""
     import avenir_tpu.native.ingest as ingest
@@ -318,7 +318,49 @@ def test_markov_class_label_collides_with_state(tmp_path):
     out_n = str(tmp_path / "n.txt")
     run_job("markovStateTransitionModel", props, [path], out_n)
     assert "classLabel:H" in open(out_n).read()
+    monkeypatch.setattr(ingest, "native_available", lambda: False)
     out_p = str(tmp_path / "p.txt")
     run_job("markovStateTransitionModel",
             {**props, "mst.stream.block.size.mb": TINY_BLOCK}, [path], out_p)
     assert open(out_n).read() == open(out_p).read()
+
+
+def test_hmm_native_and_python_paths_agree(tmp_path, monkeypatch):
+    import avenir_tpu.native.ingest as ingest
+
+    rng = np.random.default_rng(9)
+    path = str(tmp_path / "tagged2.csv")
+    with open(path, "w") as fh:
+        for i in range(100):
+            s = rng.integers(0, 2)
+            toks = []
+            for _ in range(7):
+                s = s if rng.random() < 0.8 else 1 - s
+                o = s if rng.random() < 0.9 else 1 - s
+                toks.append(f"{['x','y'][o]}:{['A','B'][s]}")
+            fh.write(f"e{i}," + ",".join(toks) + "\n")
+    props = {"hmmb.model.states": "A,B", "hmmb.model.observations": "x,y",
+             "hmmb.skip.field.count": "1"}
+    out_n = str(tmp_path / "hn.txt")
+    run_job("hiddenMarkovModelBuilder", props, [path], out_n)
+    monkeypatch.setattr(ingest, "native_available", lambda: False)
+    out_p = str(tmp_path / "hp.txt")
+    run_job("hiddenMarkovModelBuilder", props, [path], out_p)
+    assert open(out_n).read() == open(out_p).read()
+
+
+def test_apriori_native_and_python_chunks_agree(tmp_path, monkeypatch):
+    import avenir_tpu.native.ingest as ingest
+
+    path = _trans_file(tmp_path)
+    props = {"fia.support.threshold": "0.2", "fia.item.set.length": "2",
+             "fia.skip.field.count": "1",
+             "fia.stream.block.size.mb": TINY_BLOCK}
+    res_n = run_job("frequentItemsApriori", props, [path],
+                    str(tmp_path / "an"))
+    monkeypatch.setattr(ingest, "native_available", lambda: False)
+    res_p = run_job("frequentItemsApriori", props, [path],
+                    str(tmp_path / "ap"))
+    assert len(res_n.outputs) == len(res_p.outputs) >= 2
+    for a, b in zip(res_n.outputs, res_p.outputs):
+        assert open(a).read() == open(b).read()
