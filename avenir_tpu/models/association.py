@@ -97,7 +97,8 @@ class TransactionSet:
         else:
             lines = source
         rows = [
-            [t.strip() for t in ln.rstrip("\n").split(delim)]
+            # trim set matches the native seq_encode / streaming source
+            [t.strip(" \t\r") for t in ln.rstrip("\n").split(delim)]
             for ln in lines if ln.strip()
         ]
         if hasattr(lines, "close") and lines is not source:
@@ -178,12 +179,11 @@ class StreamingTransactionSource:
         never counts). The counting passes (no ids needed) ride the
         native ragged encoder when built — no per-row Python exists on
         the N-proportional path."""
-        from avenir_tpu.native.ingest import (native_available,
+        from avenir_tpu.native.ingest import (csr_rows, native_seq_ready,
                                               seq_encode_native)
 
         V = max(len(self.vocab), 1)
-        if (not with_ids and len(self.delim.encode()) == 1
-                and native_available()):
+        if not with_ids and native_seq_ready(self.delim):
             from avenir_tpu.core.stream import iter_byte_blocks, prefetched
 
             for path in self.paths:
@@ -195,9 +195,7 @@ class StreamingTransactionSource:
                     n = offsets.shape[0] - 1
                     if n <= 0:
                         continue
-                    lens = np.diff(offsets)
-                    row_of = np.repeat(np.arange(n), lens)
-                    starts = offsets[:-1]
+                    row_of, starts = csr_rows(offsets)
                     idx = np.arange(codes.shape[0])
                     # item region only; unknown tokens (-1: ids, marker,
                     # empties) drop exactly like the python path

@@ -30,18 +30,29 @@ class FisherDiscriminant:
         self.boundaries: Dict[int, float] = {}
         self.means: Dict[int, Tuple[float, float]] = {}
         self.fields: List = []
+        self._cnt = None
 
-    def fit(self, ds: Dataset) -> "FisherDiscriminant":
-        self.fields = [f for f in ds.schema.feature_fields if f.is_numeric]
+    def accumulate(self, ds: Dataset) -> "FisherDiscriminant":
+        """Fold one chunk's per-class moments (count, sum, sum-sq) —
+        additive, so the discriminant streams like every count job."""
+        if self._cnt is None:
+            self.fields = [f for f in ds.schema.feature_fields
+                           if f.is_numeric]
+            assert ds.schema.num_classes() == 2, \
+                "Fisher discriminant is two-class"
+            self._cnt = np.zeros(2, np.float64)
+            self._s1 = np.zeros((2, len(self.fields)), np.float64)
+            self._s2 = np.zeros((2, len(self.fields)), np.float64)
         x = jnp.asarray(ds.feature_matrix(self.fields))        # [n, F]
-        y = jnp.asarray(ds.labels())
-        k = ds.schema.num_classes()
-        assert k == 2, "Fisher discriminant is two-class"
-        oh = jax.nn.one_hot(y, k, dtype=jnp.float32)           # [n, 2]
-        cnt = oh.sum(axis=0)                                   # [2]
-        s1 = jnp.einsum("nk,nf->kf", oh, x)                    # [2, F]
-        s2 = jnp.einsum("nk,nf->kf", oh, x * x)
-        cnt_np, s1_np, s2_np = map(np.asarray, (cnt, s1, s2))
+        oh = jax.nn.one_hot(jnp.asarray(ds.labels()), 2,
+                            dtype=jnp.float32)                 # [n, 2]
+        self._cnt += np.asarray(oh.sum(axis=0))
+        self._s1 += np.asarray(jnp.einsum("nk,nf->kf", oh, x))
+        self._s2 += np.asarray(jnp.einsum("nk,nf->kf", oh, x * x))
+        return self
+
+    def finalize(self) -> "FisherDiscriminant":
+        cnt_np, s1_np, s2_np = self._cnt, self._s1, self._s2
         mean = s1_np / np.maximum(cnt_np[:, None], _EPS)
         var = s2_np / np.maximum(cnt_np[:, None], _EPS) - mean ** 2
         pooled = (
@@ -59,6 +70,13 @@ class FisherDiscriminant:
             self.boundaries[fld.ordinal] = float(b)
             self.means[fld.ordinal] = (float(m0), float(m1))
         return self
+
+    def fit(self, ds: Dataset) -> "FisherDiscriminant":
+        # refit from scratch (fit has always been idempotent); streaming
+        # callers use accumulate()/finalize() directly
+        self._cnt = None
+        self.boundaries, self.means = {}, {}
+        return self.accumulate(ds).finalize()
 
     def predict(self, ds: Dataset, ordinal: int) -> np.ndarray:
         """Classify by the single-feature boundary: class 1 iff the value is

@@ -119,9 +119,10 @@ class MarkovStateTransitionModel:
         n = offsets.shape[0] - 1
         if n <= 0:
             return self
+        from avenir_tpu.native.ingest import csr_rows
+
         lens = np.diff(offsets)
-        row_of = np.repeat(np.arange(n), lens)
-        starts = offsets[:-1]
+        row_of, starts = csr_rows(offsets)
         idx = np.arange(codes.shape[0])
         in_seq = idx >= (starts[row_of] + skip)
         bad = in_seq & ((codes < 0) | (codes >= s))
@@ -347,9 +348,9 @@ class HiddenMarkovModelBuilder:
         n = offsets.shape[0] - 1
         if n <= 0:
             return
-        lens = np.diff(offsets)
-        row_of = np.repeat(np.arange(n), lens)
-        starts = offsets[:-1]
+        from avenir_tpu.native.ingest import csr_rows
+
+        row_of, starts = csr_rows(offsets)
         idx = np.arange(codes.shape[0])
         in_seq = idx >= (starts[row_of] + skip)
         bad = in_seq & ((codes < 0) | (codes >= s * o))

@@ -218,3 +218,18 @@ def seq_encode_native(data: bytes, delim: str, vocab: List[str]
     if got != n_rows:
         raise RuntimeError(f"seq_encode row mismatch: {got} != {n_rows}")
     return codes[: int(offsets[n_rows])], offsets
+
+
+def native_seq_ready(delim: str) -> bool:
+    """True when the native sequence encoder handles this delimiter
+    (single byte) and the library is built — the gate every CSR
+    consumer checks before taking the byte-block path."""
+    return len(delim.encode()) == 1 and native_available()
+
+
+def csr_rows(offsets: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
+    """(row_of [total_tokens], starts [n_rows]) for a CSR offsets array —
+    the shared row-decode of every seq_encode consumer (markov fit_csr,
+    HMM add_csr, apriori counting chunks)."""
+    return (np.repeat(np.arange(offsets.shape[0] - 1), np.diff(offsets)),
+            offsets[:-1])

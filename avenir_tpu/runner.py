@@ -138,10 +138,12 @@ def _read_lines(path: str) -> List[str]:
 def _parse_sequences(lines: Sequence[str], delim: str, skip: int,
                      class_ord: Optional[int] = None):
     """Rows -> (ids, sequences, labels). First `skip` fields are meta
-    (id/class); `class_ord` points into the full row."""
+    (id/class); `class_ord` points into the full row. Token trim set is
+    space/tab/CR — exactly the native seq_encode trim, so the python and
+    native sequence paths tokenize identically."""
     ids, seqs, labels = [], [], []
     for ln in lines:
-        toks = [t.strip() for t in ln.split(delim)]
+        toks = [t.strip(" \t\r") for t in ln.split(delim)]
         ids.append(toks[0] if skip > 0 else "")
         labels.append(toks[class_ord] if class_ord is not None else None)
         seqs.append(toks[skip:])
@@ -1216,7 +1218,9 @@ def apriori_job(cfg: JobConfig, inputs: List[str], output: str) -> JobResult:
     in_ram = (cfg.get("stream.block.size.mb") is None
               and total_bytes < (256 << 20))
     if in_ram:
-        rows = [[t.strip() for t in ln.split(cfg.field_delim_regex)]
+        # space/tab/CR trim: both apriori entry points and the native
+        # counting pass must agree on token identity
+        rows = [[t.strip(" \t\r") for t in ln.split(cfg.field_delim_regex)]
                 for path in inputs for ln in _read_lines(path)]
         # the in-RAM cost is the [N, V] multi-hot matrix, which can dwarf
         # the file bytes for a wide item catalog — gate on its footprint
@@ -1372,9 +1376,9 @@ def markov_model_job(cfg: JobConfig, inputs: List[str], output: str) -> JobResul
     label_codes = np.asarray([vocab.index(lab)
                               for lab in class_labels or []])
     rows = 0
-    from avenir_tpu.native.ingest import native_available, seq_encode_native
+    from avenir_tpu.native.ingest import native_seq_ready, seq_encode_native
 
-    if len(delim.encode()) == 1 and native_available():
+    if native_seq_ready(delim):
         # native ragged tokenize+encode straight from raw byte blocks
         # (CSR codes; no per-line Python strings exist at any point)
         from avenir_tpu.core.stream import iter_byte_blocks, prefetched
@@ -1466,10 +1470,10 @@ def hmm_builder_job(cfg: JobConfig, inputs: List[str], output: str) -> JobResult
                 builder.add_partially_tagged(seq, wf)
     else:
         delim = cfg.field_delim_regex
-        from avenir_tpu.native.ingest import (native_available,
+        from avenir_tpu.native.ingest import (native_seq_ready,
                                               seq_encode_native)
 
-        if len(delim.encode()) == 1 and native_available():
+        if native_seq_ready(delim):
             # native path: encode whole `obs:state` pair tokens against
             # the state-major pair vocabulary straight from byte blocks
             from avenir_tpu.core.stream import iter_byte_blocks, prefetched
@@ -1567,10 +1571,18 @@ def logistic_regression_job(cfg: JobConfig, inputs: List[str], output: str) -> J
 @job("fisherDiscriminant", "fid",
      "org.avenir.discriminant.FisherDiscriminant")
 def fisher_job(cfg: JobConfig, inputs: List[str], output: str) -> JobResult:
+    from avenir_tpu.core.stream import stream_job_inputs
     from avenir_tpu.models.discriminant import FisherDiscriminant
 
-    ds = _dataset(inputs[0], cfg)
-    fd = FisherDiscriminant().fit(ds)
+    fd = FisherDiscriminant()
+    n = 0
+    for chunk in stream_job_inputs(cfg, inputs, _schema(cfg)):
+        fd.accumulate(chunk)
+        n += len(chunk)
+    if n == 0:
+        raise ValueError(f"fisherDiscriminant: empty input "
+                         f"(no records in {inputs})")
+    fd.finalize()
     out = _out_file(output)
     fd.save(out, delim=cfg.field_delim)
     return JobResult("fisherDiscriminant", {}, [out], fd)

@@ -364,3 +364,16 @@ def test_apriori_native_and_python_chunks_agree(tmp_path, monkeypatch):
     assert len(res_n.outputs) == len(res_p.outputs) >= 2
     for a, b in zip(res_n.outputs, res_p.outputs):
         assert open(a).read() == open(b).read()
+
+
+def test_fisher_chunked_close_to_whole(churn, tmp_path):
+    # per-class moment sums reassociate across chunks: allclose
+    props = {"fid.feature.schema.file.path": churn["schema"]}
+    whole, chunked = _run_both("fisherDiscriminant", props,
+                               [churn["train"]], tmp_path, "fid")
+
+    def parse(text):
+        return np.array([[float(v) for v in ln.split(",")[1:]]
+                         for ln in text.splitlines()])
+
+    np.testing.assert_allclose(parse(whole), parse(chunked), atol=1e-4)
