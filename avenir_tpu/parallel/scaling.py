@@ -20,11 +20,11 @@ from __future__ import annotations
 
 import re
 import time
-from typing import Dict, List, Optional, Sequence
+from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
-from avenir_tpu.parallel.mesh import DATA_AXIS, data_mesh
+from avenir_tpu.parallel.mesh import DATA_AXIS, MODEL_AXIS, data_mesh
 
 # NB weak-scaling workload dims, shared by _nb_rate and the analytic
 # per-device traffic fields in measure_scaling
@@ -198,6 +198,34 @@ def _nb_compiled_collectives(mesh) -> List[Dict]:
     return hlo_collective_payloads(compiled.as_text())
 
 
+def _knn_compiled_collectives(mesh, k: int = 5) -> Tuple[List[Dict], int]:
+    """Compile the MODEL-parallel KNN candidate-merge step on `mesh` and
+    return (collective instructions, analytic all-gather bytes): each
+    device gathers [nq_local, P_model*k] distances (f32) + labels (i32) —
+    the k*P candidate merge, NOT the n_train rows."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from avenir_tpu.parallel.distributed import distributed_topk_fn
+
+    data_n = mesh.shape[DATA_AXIS]
+    model_n = mesh.shape.get(MODEL_AXIS, 1)
+    nq, train, d = 8 * data_n, 16 * model_n, 8
+    step = distributed_topk_fn(mesh, k=k, metric="euclidean")
+    args = [
+        jax.device_put(np.zeros((nq, d), np.float32),
+                       NamedSharding(mesh, P(DATA_AXIS, None))),
+        jax.device_put(np.zeros((train, d), np.float32),
+                       NamedSharding(mesh, P(MODEL_AXIS, None))),
+        jax.device_put(np.zeros((train,), np.int32),
+                       NamedSharding(mesh, P(MODEL_AXIS))),
+    ]
+    compiled = step.lower(*args).compile()
+    analytic = (nq // data_n) * model_n * k * (4 + 4)
+    return hlo_collective_payloads(compiled.as_text()), analytic
+
+
 def _knn_rate(mesh, queries: int, train: int, iters: int, k: int = 5) -> float:
     """Weak-scaling data-parallel KNN top-k rate (queries/sec)."""
     import jax
@@ -290,6 +318,14 @@ def measure_scaling(
                                             model_parallel=1))
     hlo_payload = sum(o["payload_bytes"] for o in hlo
                       if o["op"] == "all-reduce")
+    # second family: the model-parallel KNN candidate merge (all-gather)
+    knn_hlo: List[Dict] = []
+    knn_analytic = 0
+    if last["devices"] >= 2 and last["devices"] % 2 == 0:
+        knn_hlo, knn_analytic = _knn_compiled_collectives(
+            data_mesh(devs[: last["devices"]], model_parallel=2))
+    knn_gather = sum(o["payload_bytes"] for o in knn_hlo
+                     if o["op"] == "all-gather")
     # projection to pod scale from the measured per-device step time; on
     # virtual devices the compute side is contention-distorted, flagged
     step_s = nb_rows_per_device / (base["nb_rows_per_sec"]
@@ -305,6 +341,11 @@ def measure_scaling(
         "nb_hlo_allreduce_payload_bytes": hlo_payload,
         "nb_analytic_payload_bytes": nb_tensor_bytes,
         "payload_model_validated": hlo_payload == nb_tensor_bytes,
+        "knn_hlo_collectives": knn_hlo,
+        "knn_hlo_allgather_payload_bytes": knn_gather,
+        "knn_analytic_allgather_payload_bytes": knn_analytic,
+        "knn_payload_model_validated": bool(knn_hlo)
+        and knn_gather == knn_analytic,
         "projection_8_to_256": project_efficiency(step_s, hlo_payload),
         "projection_note": (
             "projection_8_to_256 is a MODEL, not a measurement: payload "

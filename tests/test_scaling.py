@@ -106,3 +106,19 @@ def test_hlo_payload_parses_async_collectives():
     ops = hlo_collective_payloads(txt)
     assert [(o["op"], o["payload_bytes"]) for o in ops] == [
         ("all-reduce", (8 * 2 * 10 + 2) * 4), ("all-reduce", 64)]
+
+
+def test_knn_allgather_payload_matches_analytic_model():
+    """The model-parallel KNN candidate merge's all-gather payload parsed
+    from compiled HLO must equal the analytic k*P-per-query model
+    (compile-only: no timing runs needed)."""
+    import jax
+
+    from avenir_tpu.parallel.mesh import data_mesh
+    from avenir_tpu.parallel.scaling import _knn_compiled_collectives
+
+    ops, analytic = _knn_compiled_collectives(
+        data_mesh(jax.devices()[:2], model_parallel=2))
+    gathered = sum(o["payload_bytes"] for o in ops
+                   if o["op"] == "all-gather")
+    assert ops and gathered == analytic > 0

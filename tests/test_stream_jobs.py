@@ -377,3 +377,13 @@ def test_fisher_chunked_close_to_whole(churn, tmp_path):
                          for ln in text.splitlines()])
 
     np.testing.assert_allclose(parse(whole), parse(chunked), atol=1e-4)
+
+
+def test_baseline_anchor_measures_positive_rates():
+    """bench.measure_baseline_anchor returns finite, positive per-node
+    native rates (the measured half of vs_baseline_measured_anchor)."""
+    import bench
+
+    nb, pp = bench.measure_baseline_anchor()
+    assert np.isfinite(nb) and nb > 1e4
+    assert np.isfinite(pp) and pp > 1e5
