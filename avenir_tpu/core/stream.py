@@ -244,3 +244,11 @@ def stream_job_lines(cfg, inputs: Iterable[str]) -> Iterator[list]:
     block = int(cfg.get_float("stream.block.size.mb", 64.0) * (1 << 20))
     for path in inputs:
         yield from prefetched(iter_line_blocks(path, block))
+
+
+def stream_job_byte_blocks(cfg, inputs: Iterable[str]) -> Iterator[bytes]:
+    """Prefetched raw byte blocks of every input path (the native
+    seq_encode feed), sized by the same `stream.block.size.mb` key."""
+    block = int(cfg.get_float("stream.block.size.mb", 64.0) * (1 << 20))
+    for path in inputs:
+        yield from prefetched(iter_byte_blocks(path, block))

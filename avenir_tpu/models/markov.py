@@ -105,7 +105,8 @@ class MarkovStateTransitionModel:
 
     def fit_csr(self, codes: np.ndarray, offsets: np.ndarray,
                 skip: int, class_ord: Optional[int] = None,
-                label_codes: Optional[np.ndarray] = None
+                label_codes: Optional[np.ndarray] = None,
+                y: Optional[np.ndarray] = None
                 ) -> "MarkovStateTransitionModel":
         """Fold one CSR-encoded line block (native seq_encode output:
         tokens dictionary-encoded against a vocabulary whose first
@@ -131,7 +132,17 @@ class MarkovStateTransitionModel:
             raise ValueError(
                 f"unknown state token at row {int(row_of[b])}, "
                 f"position {int(b - starts[row_of[b]])}")
-        if self.class_labels:
+        if y is not None:
+            # caller-resolved per-row class/entity indices (the per-entity
+            # streaming mode: keys are open-vocabulary strings resolved
+            # outside, counts axis already grown to cover max(y))
+            k = self.counts.shape[0]
+            if (y.shape[0] != n or (y < 0).any()
+                    or int(y.max(initial=-1)) >= k):
+                raise ValueError("y must give one index in "
+                                 "[0, counts.shape[0]) per CSR row")
+            y = y.astype(np.int64)
+        elif self.class_labels:
             k = len(self.class_labels)
             if class_ord is None:
                 raise ValueError("class_ord required with class_labels")

@@ -233,3 +233,19 @@ def csr_rows(offsets: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
     HMM add_csr, apriori counting chunks)."""
     return (np.repeat(np.arange(offsets.shape[0] - 1), np.diff(offsets)),
             offsets[:-1])
+
+
+def extract_column_native(data: bytes, delim: str, ordinal: int
+                          ) -> Optional[np.ndarray]:
+    """One column's trimmed tokens for every non-blank line of a raw text
+    block (short rows yield ''), as a numpy unicode array — the open-
+    vocabulary companion to seq_encode_native (entity ids cannot
+    dictionary-encode). None when the native library is unavailable."""
+    lib = _get_lib()
+    if lib is None:
+        return None
+    d = delim.encode()
+    if len(d) != 1:
+        return None
+    raw = _extract_column_bytes(lib, data, d, ordinal)
+    return np.array(raw.decode("utf-8", "replace").split("\n")[:-1])

@@ -1342,17 +1342,71 @@ def markov_model_job(cfg: JobConfig, inputs: List[str], output: str) -> JobResul
             max(id_ords + ([class_ord] if class_ord is not None else [])) + 1)
         delim = cfg.field_delim_regex
         model = MarkovStateTransitionModel(states, scale=scale)
-        for lines in stream_job_lines(cfg, inputs):
-            seqs: List[List[str]] = []
-            entity_of_row: List[str] = []
-            for ln in lines:
-                toks = [t.strip() for t in ln.split(delim)]
-                key = ",".join(toks[o] for o in id_ords)
-                if class_ord is not None:
-                    key += f",{toks[class_ord]}"
-                entity_of_row.append(key)
-                seqs.append(toks[seq_start:])
-            model.fit_entities(seqs, entity_of_row)
+        from avenir_tpu.native.ingest import (extract_column_native,
+                                              native_seq_ready,
+                                              seq_encode_native)
+
+        key_ords = list(id_ords) + ([class_ord]
+                                    if class_ord is not None else [])
+        if native_seq_ready(delim):
+            # native path: states CSR-encode natively; only the (open-
+            # vocabulary) entity key columns materialize as strings
+            from avenir_tpu.core.stream import stream_job_byte_blocks
+
+            model.class_labels = []
+            model.counts = np.zeros((0,) + model.counts.shape[1:],
+                                    np.float64)
+            index: Dict[str, int] = {}
+            for data in stream_job_byte_blocks(cfg, inputs):
+                enc = seq_encode_native(data, delim, states)
+                # rows too short to carry every key column are a crisp
+                # error on BOTH engines (the python path raises the same)
+                lens = np.diff(enc[1])
+                short = lens <= max(key_ords)
+                if short.any():
+                    raise ValueError(
+                        f"row {int(np.argmax(short))} has no "
+                        f"id/class field (ordinal {max(key_ords)})")
+                cols = [extract_column_native(data, delim, o)
+                        for o in key_ords]
+                keys = cols[0]
+                for col in cols[1:]:
+                    keys = np.char.add(np.char.add(keys, ","), col)
+                # first-seen entity order, vectorized: unique keys
+                # ordered by first occurrence, then row indices
+                uniq, first, inv = np.unique(
+                    keys, return_index=True, return_inverse=True)
+                gidx = np.empty(uniq.shape[0], np.int64)
+                for u in np.argsort(first):
+                    key = str(uniq[u])
+                    gi = index.get(key)
+                    if gi is None:
+                        gi = len(index)
+                        index[key] = gi
+                        model.class_labels.append(key)
+                    gidx[u] = gi
+                if len(index) > model.counts.shape[0]:
+                    model.counts = np.pad(
+                        model.counts,
+                        ((0, len(index) - model.counts.shape[0]),
+                         (0, 0), (0, 0)))
+                model.fit_csr(enc[0], enc[1], skip=seq_start, y=gidx[inv])
+        else:
+            for lines in stream_job_lines(cfg, inputs):
+                seqs: List[List[str]] = []
+                entity_of_row: List[str] = []
+                for ln in lines:
+                    toks = [t.strip(" \t\r") for t in ln.split(delim)]
+                    if len(toks) <= max(key_ords):
+                        raise ValueError(
+                            f"row {len(entity_of_row)} has no id/class "
+                            f"field (ordinal {max(key_ords)})")
+                    key = ",".join(toks[o] for o in id_ords)
+                    if class_ord is not None:
+                        key += f",{toks[class_ord]}"
+                    entity_of_row.append(key)
+                    seqs.append(toks[seq_start:])
+                model.fit_entities(seqs, entity_of_row)
         entities = model.class_labels or []
         model.save(out, delim=cfg.field_delim, marker="entity")
         return JobResult("markovStateTransitionModel",
@@ -1381,17 +1435,15 @@ def markov_model_job(cfg: JobConfig, inputs: List[str], output: str) -> JobResul
     if native_seq_ready(delim):
         # native ragged tokenize+encode straight from raw byte blocks
         # (CSR codes; no per-line Python strings exist at any point)
-        from avenir_tpu.core.stream import iter_byte_blocks, prefetched
+        from avenir_tpu.core.stream import stream_job_byte_blocks
 
-        block = int(cfg.get_float("stream.block.size.mb", 64.0) * (1 << 20))
-        for path in inputs:
-            for data in prefetched(iter_byte_blocks(path, block)):
-                # cannot be None: availability + 1-byte delim pre-checked
-                enc = seq_encode_native(data, delim, vocab)
-                model.fit_csr(*enc, skip=skip,
-                              class_ord=class_ord if class_labels else None,
-                              label_codes=label_codes)
-                rows += enc[1].shape[0] - 1
+        for data in stream_job_byte_blocks(cfg, inputs):
+            # cannot be None: availability + 1-byte delim pre-checked
+            enc = seq_encode_native(data, delim, vocab)
+            model.fit_csr(*enc, skip=skip,
+                          class_ord=class_ord if class_labels else None,
+                          label_codes=label_codes)
+            rows += enc[1].shape[0] - 1
     else:
         for lines in stream_job_lines(cfg, inputs):
             _, seqs, labels = _parse_sequences(lines, delim, skip,
@@ -1476,16 +1528,13 @@ def hmm_builder_job(cfg: JobConfig, inputs: List[str], output: str) -> JobResult
         if native_seq_ready(delim):
             # native path: encode whole `obs:state` pair tokens against
             # the state-major pair vocabulary straight from byte blocks
-            from avenir_tpu.core.stream import iter_byte_blocks, prefetched
+            from avenir_tpu.core.stream import stream_job_byte_blocks
 
             vocab = [f"{ov}{sub}{sv}" for sv in states for ov in obs]
-            block = int(cfg.get_float("stream.block.size.mb", 64.0)
-                        * (1 << 20))
-            for path in inputs:
-                for data in prefetched(iter_byte_blocks(path, block)):
-                    # cannot be None: availability + delim pre-checked
-                    enc = seq_encode_native(data, delim, vocab)
-                    builder.add_csr(*enc, skip=skip)
+            for data in stream_job_byte_blocks(cfg, inputs):
+                # cannot be None: availability + delim pre-checked
+                enc = seq_encode_native(data, delim, vocab)
+                builder.add_csr(*enc, skip=skip)
         else:
             for lines in stream_job_lines(cfg, inputs):
                 _, seqs, _ = _parse_sequences(lines, delim, skip)

@@ -387,3 +387,22 @@ def test_baseline_anchor_measures_positive_rates():
     nb, pp = bench.measure_baseline_anchor()
     assert np.isfinite(nb) and nb > 1e4
     assert np.isfinite(pp) and pp > 1e5
+
+
+def test_markov_per_entity_native_and_python_agree(tmp_path, monkeypatch):
+    import avenir_tpu.native.ingest as ingest
+
+    path = _markov_file(tmp_path, per_entity=True)
+    props = {
+        "mst.model.states": "L,M,H",
+        "mst.id.field.ordinals": "0",
+        "mst.class.attr.ordinal": "1",
+        "mst.seq.start.ordinal": "2",
+    }
+    out_n = str(tmp_path / "en.txt")
+    run_job("markovStateTransitionModel", props, [path], out_n)
+    monkeypatch.setattr(ingest, "native_available", lambda: False)
+    out_p = str(tmp_path / "ep.txt")
+    run_job("markovStateTransitionModel", props, [path], out_p)
+    assert open(out_n).read() == open(out_p).read()
+    assert "entity:" in open(out_n).read()
