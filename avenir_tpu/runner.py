@@ -805,7 +805,8 @@ def rule_evaluator(cfg: JobConfig, inputs: List[str], output: str) -> JobResult:
             results[name] = res
             fh.write(f"{name}{delim}{res['support']:.6f}{delim}"
                      f"{res['confidence']:.6f}\n")
-    return JobResult("ruleEvaluator", {}, [out], results)
+    return JobResult("ruleEvaluator", {"Basic:Records": rows_seen},
+                     [out], results)
 
 
 @job("cramerCorrelation", "crc", "org.avenir.explore.CramerCorrelation")
@@ -829,7 +830,7 @@ def cramer_job(cfg: JobConfig, inputs: List[str], output: str) -> JobResult:
     with open(out, "w") as fh:
         for ordinal, v in sorted(corr.items()):
             fh.write(f"{ordinal}{delim}{v:.6f}\n")
-    return JobResult(name, {}, [out], corr)
+    return JobResult(name, {"Basic:Records": acc.n}, [out], corr)
 
 
 @job("heterogeneityReduction", "hrc",
@@ -850,7 +851,8 @@ def heterogeneity_job(cfg: JobConfig, inputs: List[str], output: str) -> JobResu
     with open(out, "w") as fh:
         for ordinal, v in sorted(corr.items()):
             fh.write(f"{ordinal}{delim}{v:.6f}\n")
-    return JobResult("heterogeneityReduction", {}, [out], corr)
+    return JobResult("heterogeneityReduction",
+                     {"Basic:Records": acc.n}, [out], corr)
 
 
 @job("numericalCorrelation", "nuc",
@@ -878,7 +880,8 @@ def numerical_corr_job(cfg: JobConfig, inputs: List[str], output: str) -> JobRes
             # feature-vs-class correlation: the relevance signal this
             # family of jobs exists to emit
             fh.write(f"{oi}{delim}class{delim}{corr[i, -1]:.6f}\n")
-    return JobResult("numericalCorrelation", {}, [out], corr)
+    return JobResult("numericalCorrelation",
+                     {"Basic:Records": acc.n}, [out], corr)
 
 
 @job("reliefFeatureRelevance", "ffr",
@@ -925,7 +928,8 @@ def class_affinity_job(cfg: JobConfig, inputs: List[str], output: str) -> JobRes
                 for val, score in pairs:
                     fh.write(f"{fld.ordinal}{delim}{cv}{delim}{val}"
                              f"{delim}{score:.6f}\n")
-    return JobResult("categoricalClassAffinity", {}, [out], payload)
+    return JobResult("categoricalClassAffinity",
+                     {"Basic:Records": acc.n}, [out], payload)
 
 
 @job("categoricalContinuousEncoding", "coe",
@@ -957,7 +961,8 @@ def supervised_encoding_job(cfg: JobConfig, inputs: List[str], output: str) -> J
             payload[fld.ordinal] = enc
             for val, code in enc.items():
                 fh.write(f"{fld.ordinal}{delim}{val}{delim}{code:.6f}\n")
-    return JobResult("categoricalContinuousEncoding", {}, [out], payload)
+    return JobResult("categoricalContinuousEncoding",
+                     {"Basic:Records": acc.n}, [out], payload)
 
 
 @job("topMatchesByClass", "tmc", "org.avenir.explore.TopMatchesByClass")
@@ -1359,19 +1364,23 @@ def markov_model_job(cfg: JobConfig, inputs: List[str], output: str) -> JobResul
             index: Dict[str, int] = {}
             for data in stream_job_byte_blocks(cfg, inputs):
                 enc = seq_encode_native(data, delim, states)
-                # rows too short to carry every key column are a crisp
-                # error on BOTH engines (the python path raises the same)
                 lens = np.diff(enc[1])
-                short = lens <= max(key_ords)
-                if short.any():
-                    raise ValueError(
-                        f"row {int(np.argmax(short))} has no "
-                        f"id/class field (ordinal {max(key_ords)})")
-                cols = [extract_column_native(data, delim, o)
-                        for o in key_ords]
-                keys = cols[0]
-                for col in cols[1:]:
-                    keys = np.char.add(np.char.add(keys, ","), col)
+                if key_ords:
+                    # rows too short to carry every key column are a
+                    # crisp error on BOTH engines
+                    short = lens <= max(key_ords)
+                    if short.any():
+                        raise ValueError(
+                            f"row {int(np.argmax(short))} has no "
+                            f"id/class field (ordinal {max(key_ords)})")
+                    cols = [extract_column_native(data, delim, o)
+                            for o in key_ords]
+                    keys = cols[0]
+                    for col in cols[1:]:
+                        keys = np.char.add(np.char.add(keys, ","), col)
+                else:
+                    # degenerate config (no id/class columns): one key
+                    keys = np.full(lens.shape[0], "")
                 # first-seen entity order, vectorized: unique keys
                 # ordered by first occurrence, then row indices
                 uniq, first, inv = np.unique(
@@ -1397,7 +1406,7 @@ def markov_model_job(cfg: JobConfig, inputs: List[str], output: str) -> JobResul
                 entity_of_row: List[str] = []
                 for ln in lines:
                     toks = [t.strip(" \t\r") for t in ln.split(delim)]
-                    if len(toks) <= max(key_ords):
+                    if key_ords and len(toks) <= max(key_ords):
                         raise ValueError(
                             f"row {len(entity_of_row)} has no id/class "
                             f"field (ordinal {max(key_ords)})")
@@ -1408,6 +1417,10 @@ def markov_model_job(cfg: JobConfig, inputs: List[str], output: str) -> JobResul
                     seqs.append(toks[seq_start:])
                 model.fit_entities(seqs, entity_of_row)
         entities = model.class_labels or []
+        if not entities:
+            raise ValueError(
+                f"markovStateTransitionModel: empty input "
+                f"(no records in {inputs})")
         model.save(out, delim=cfg.field_delim, marker="entity")
         return JobResult("markovStateTransitionModel",
                          {"Entities:Count": len(entities)}, [out], model)
