@@ -1342,9 +1342,11 @@ def markov_model_job(cfg: JobConfig, inputs: List[str], output: str) -> JobResul
         class_ord = cfg.get_int("class.attr.ordinal")
         # mandatory in the Spark reference (getMandatoryIntParam, :54);
         # the convenience default must skip the class column too
+        key_ords_default = id_ords + ([class_ord]
+                                      if class_ord is not None else [])
         seq_start = cfg.get_int(
             "seq.start.ordinal",
-            max(id_ords + ([class_ord] if class_ord is not None else [])) + 1)
+            max(key_ords_default) + 1 if key_ords_default else 0)
         delim = cfg.field_delim_regex
         model = MarkovStateTransitionModel(states, scale=scale)
         from avenir_tpu.native.ingest import (extract_column_native,
