@@ -70,10 +70,11 @@ class TextNaiveBayes:
     rows are `text,classVal`; each Lucene token contributes a
     (classVal, token) count) with the matching multinomial predictor.
 
-    TPU design: tokens dictionary-encode on host (string work), then both
-    training counts and prediction scores are device work — counting is a
-    segment_sum over class*V+token keys; scoring is one bag-of-words
-    [n, V] x log P[V, K] matmul on the MXU."""
+    TPU design: tokens dictionary-encode on host (string work); training
+    counts fold per streamed chunk with a host bincount over class*V+token
+    keys (the vocabulary grows chunk to chunk, so table shapes are not
+    jit-stable — and the count is memory-bound string work, not FLOPs);
+    scoring is one bag-of-words [n, V] x log P[V, K] matmul on the MXU."""
 
     def __init__(self, laplace: float = 1.0, drop_stop_words: bool = True):
         self.laplace = laplace
@@ -82,6 +83,12 @@ class TextNaiveBayes:
         self.class_values: List[str] = []
         self.log_prob: Optional[np.ndarray] = None      # [V, K]
         self.log_prior: Optional[np.ndarray] = None     # [K]
+        # streaming accumulator state (first-seen class order; finish()
+        # sorts classes so chunked == whole-fit output exactly)
+        self._classes: List[str] = []
+        self._cidx: Dict[str, int] = {}
+        self._counts = np.zeros((0, 0), np.float64)     # [V, K]
+        self._class_counts = np.zeros(0, np.float64)    # [K]
 
     def _encode(self, texts: Sequence[str], grow: bool):
         doc_ids, tok_ids = [], []
@@ -95,25 +102,52 @@ class TextNaiveBayes:
                 tok_ids.append(self.vocab[tok])
         return (np.asarray(doc_ids, np.int32), np.asarray(tok_ids, np.int32))
 
-    def fit(self, texts: Sequence[str], labels: Sequence[str]) -> "TextNaiveBayes":
-        import jax
-        import jax.numpy as jnp
-
-        self.class_values = sorted(set(labels))
-        cidx = {v: i for i, v in enumerate(self.class_values)}
-        y = np.asarray([cidx[v] for v in labels], np.int32)
+    def accumulate(self, texts: Sequence[str], labels: Sequence[str]
+                   ) -> "TextNaiveBayes":
+        """Fold one chunk of (classVal, token) counts — additive, so the
+        free-text mode streams like the tabular one; vocabulary and class
+        set grow across chunks (count tables zero-pad)."""
+        for lab in labels:
+            if lab not in self._cidx:
+                self._cidx[lab] = len(self._classes)
+                self._classes.append(lab)
+        y = np.asarray([self._cidx[v] for v in labels], np.int32)
         doc_ids, tok_ids = self._encode(texts, grow=True)
-        v, k = len(self.vocab), len(self.class_values)
-        # (class, token) counts in one device reduction
-        key = jnp.asarray(tok_ids) * k + jnp.asarray(y[doc_ids])
-        counts = np.asarray(jax.ops.segment_sum(
-            jnp.ones(len(tok_ids), jnp.float32), key, num_segments=v * k
-        )).reshape(v, k)
+        v, k = len(self.vocab), len(self._classes)
+        if self._counts.shape != (v, k):
+            grown = np.zeros((v, k), np.float64)
+            grown[: self._counts.shape[0], : self._counts.shape[1]] = \
+                self._counts
+            self._counts = grown
+            self._class_counts = np.pad(
+                self._class_counts, (0, k - self._class_counts.shape[0]))
+        if len(tok_ids):
+            self._counts += np.bincount(
+                np.asarray(tok_ids, np.int64) * k + y[doc_ids],
+                minlength=v * k).reshape(v, k)
+        self._class_counts += np.bincount(y, minlength=k)
+        return self
+
+    def finish(self) -> "TextNaiveBayes":
+        """Derive the model; classes sort so chunked == whole-fit."""
+        order = np.argsort(self._classes)
+        self.class_values = [self._classes[i] for i in order]
+        counts = self._counts[:, order]
+        class_counts = self._class_counts[order]
         smoothed = counts + self.laplace
         self.log_prob = np.log(smoothed / smoothed.sum(axis=0, keepdims=True))
-        class_counts = np.bincount(y, minlength=k).astype(np.float64)
-        self.log_prior = np.log(np.maximum(class_counts / len(y), 1e-30))
+        self.log_prior = np.log(np.maximum(
+            class_counts / max(class_counts.sum(), 1.0), 1e-30))
         return self
+
+    def fit(self, texts: Sequence[str], labels: Sequence[str]) -> "TextNaiveBayes":
+        # refit from scratch (fit has always been idempotent); streaming
+        # callers use accumulate()/finish() directly
+        self.vocab = {}
+        self._classes, self._cidx = [], {}
+        self._counts = np.zeros((0, 0), np.float64)
+        self._class_counts = np.zeros(0, np.float64)
+        return self.accumulate(texts, labels).finish()
 
     def _bow(self, texts: Sequence[str]) -> np.ndarray:
         doc_ids, tok_ids = self._encode(texts, grow=False)

@@ -175,20 +175,33 @@ def bayesian_distribution(cfg: JobConfig, inputs: List[str], output: str) -> Job
     if not cfg.get_bool("tabular.input", True):
         from avenir_tpu.models.text import TextNaiveBayes
 
-        texts, labels = [], []
+        # token counts fold per streamed line block: the free-text mode
+        # streams like the tabular one (mapText's per-line contract)
+        from avenir_tpu.core.stream import iter_line_blocks, prefetched
+
+        tmodel = TextNaiveBayes()
+        rows = 0
+        block = int(cfg.get_float("stream.block.size.mb", 64.0) * (1 << 20))
         for path in inputs:
-            for lineno, ln in enumerate(_read_lines(path), start=1):
-                text, sep, cls = ln.rpartition(cfg.field_delim_regex)
-                if not sep:
-                    raise ValueError(
-                        f"{path}:{lineno}: text-mode row has no "
-                        f"{cfg.field_delim_regex!r} delimiter (want text,classVal)")
-                texts.append(text)
-                labels.append(cls.strip())
-        tmodel = TextNaiveBayes().fit(texts, labels)
+            lineno = 0
+            for lines in prefetched(iter_line_blocks(path, block)):
+                texts, labels = [], []
+                for ln in lines:
+                    lineno += 1
+                    text, sep, cls = ln.rpartition(cfg.field_delim_regex)
+                    if not sep:
+                        raise ValueError(
+                            f"{path}:{lineno}: text-mode row has no "
+                            f"{cfg.field_delim_regex!r} delimiter "
+                            f"(want text,classVal)")
+                    texts.append(text)
+                    labels.append(cls.strip())
+                tmodel.accumulate(texts, labels)
+                rows += len(texts)
+        tmodel.finish()
         tmodel.save(out, delim=cfg.field_delim)
         return JobResult("bayesianDistr",
-                         {"Distribution Data:Records": len(texts)},
+                         {"Distribution Data:Records": rows},
                          [out], tmodel)
 
     from avenir_tpu.core.stream import stream_job_inputs
@@ -1342,19 +1355,17 @@ def markov_model_job(cfg: JobConfig, inputs: List[str], output: str) -> JobResul
         class_ord = cfg.get_int("class.attr.ordinal")
         # mandatory in the Spark reference (getMandatoryIntParam, :54);
         # the convenience default must skip the class column too
-        key_ords_default = id_ords + ([class_ord]
-                                      if class_ord is not None else [])
+        key_ords = list(id_ords) + ([class_ord]
+                                    if class_ord is not None else [])
         seq_start = cfg.get_int(
             "seq.start.ordinal",
-            max(key_ords_default) + 1 if key_ords_default else 0)
+            max(key_ords) + 1 if key_ords else 0)
         delim = cfg.field_delim_regex
         model = MarkovStateTransitionModel(states, scale=scale)
         from avenir_tpu.native.ingest import (extract_column_native,
                                               native_seq_ready,
                                               seq_encode_native)
 
-        key_ords = list(id_ords) + ([class_ord]
-                                    if class_ord is not None else [])
         if native_seq_ready(delim):
             # native path: states CSR-encode natively; only the (open-
             # vocabulary) entity key columns materialize as strings

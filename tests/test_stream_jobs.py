@@ -406,3 +406,21 @@ def test_markov_per_entity_native_and_python_agree(tmp_path, monkeypatch):
     run_job("markovStateTransitionModel", props, [path], out_p)
     assert open(out_n).read() == open(out_p).read()
     assert "entity:" in open(out_n).read()
+
+
+def test_text_nb_chunked_equals_whole(tmp_path):
+    rng = np.random.default_rng(13)
+    path = str(tmp_path / "docs.csv")
+    pos = ["great product works fine", "love the service quality",
+           "excellent fast support"]
+    neg = ["terrible broken product", "awful slow support experience",
+           "bad service never again"]
+    with open(path, "w") as fh:
+        for _ in range(200):
+            good = rng.random() < 0.5
+            fh.write(f"{rng.choice(pos if good else neg)},"
+                     f"{'P' if good else 'N'}\n")
+    props = {"bad.tabular.input": "false"}
+    whole, chunked = _run_both("bayesianDistr", props, [path],
+                               tmp_path, "bad")
+    assert whole == chunked and whole.strip()
