@@ -134,6 +134,134 @@ class SequenceSet:
         return self.rows.shape[0]
 
 
+class StreamingSequenceSource:
+    """Re-iterable chunked sequence reader for unbounded-size GSP mining.
+
+    GSP is inherently multi-pass (the reference runs one MR job per
+    sequence length k over the same input); streaming means each k-pass
+    re-scans the file at O(block) host RSS. scan() freezes the token
+    vocabulary, row count and max sequence length; chunks() then yields
+    fixed-shape padded [block_rows, t_max] blocks encoded against that
+    vocabulary (native seq_encode when built, python split otherwise)."""
+
+    def __init__(self, paths: Sequence[str], delim: str = ",",
+                 skip_field_count: int = 1, block_bytes: int = 64 << 20):
+        self.paths = list(paths)
+        self.delim = delim
+        self.skip = skip_field_count
+        self.block_bytes = block_bytes
+        self.vocab: List[str] = []
+        self.index: Dict[str, int] = {}
+        self.n_rows = 0
+        self.t_max = 1
+        self._item_counts: Optional[np.ndarray] = None
+
+    def _line_blocks(self):
+        from avenir_tpu.core.stream import iter_line_blocks, prefetched
+
+        for path in self.paths:
+            yield from prefetched(
+                iter_line_blocks(path, self.block_bytes))
+
+    def scan(self) -> Tuple[List[str], np.ndarray, int]:
+        """Pass 1: (vocab, per-token row-presence counts, n_rows) — the
+        k=1 support counts; also records t_max for fixed-shape chunks."""
+        if self._item_counts is not None:
+            return self.vocab, self._item_counts, self.n_rows
+        counts: List[int] = []
+        for lines in self._line_blocks():
+            for ln in lines:
+                toks = [t.strip(" \t\r")
+                        for t in ln.split(self.delim)][self.skip:]
+                toks = [t for t in toks if t != ""]
+                self.n_rows += 1
+                self.t_max = max(self.t_max, len(toks))
+                seen = set()
+                for tok in toks:
+                    i = self.index.get(tok)
+                    if i is None:
+                        i = len(self.vocab)
+                        self.index[tok] = i
+                        self.vocab.append(tok)
+                        counts.append(0)
+                    seen.add(i)
+                for i in seen:
+                    counts[i] += 1
+        self._item_counts = np.asarray(counts, np.int64)
+        return self.vocab, self._item_counts, self.n_rows
+
+    def chunks(self, block_rows: int = 65536):
+        """Yield padded int32 [rows_bucket, t_bucket] blocks (pad -1;
+        all-pad rows support no candidate, so padding never counts).
+
+        Both axes quantize to power-of-2 buckets PER BLOCK instead of
+        padding everything to global maxima: one anomalously long input
+        line must not inflate every block (O(block) RSS is the point of
+        this class), and bucketing keeps recompiles logarithmic."""
+        from avenir_tpu.native.ingest import (csr_rows, native_seq_ready,
+                                              seq_encode_native)
+
+        def bucket(x: int, lo: int) -> int:
+            return max(lo, 1 << (max(x, 1) - 1).bit_length())
+
+        if native_seq_ready(self.delim):
+            from avenir_tpu.core.stream import iter_byte_blocks, prefetched
+
+            for path in self.paths:
+                for data in prefetched(
+                        iter_byte_blocks(path, self.block_bytes)):
+                    codes, offsets = seq_encode_native(
+                        data, self.delim, self.vocab)
+                    n = offsets.shape[0] - 1
+                    if n <= 0:
+                        continue
+                    row_of, starts = csr_rows(offsets)
+                    idx = np.arange(codes.shape[0])
+                    # sequence region, empty/meta tokens dropped like the
+                    # python path (ids can collide with item tokens only
+                    # at positions < skip, which this mask excludes)
+                    valid = ((idx >= starts[row_of] + self.skip)
+                             & (codes >= 0))
+                    order = np.flatnonzero(valid)
+                    rows_v = row_of[order]
+                    pos = (np.arange(order.shape[0])
+                           - np.searchsorted(rows_v, rows_v))
+                    enc = codes[order]
+                    bounds = np.searchsorted(
+                        rows_v, np.arange(0, n + block_rows, block_rows))
+                    for page, (lo, hi) in enumerate(
+                            zip(bounds[:-1], bounds[1:])):
+                        rows_here = min(block_rows, n - page * block_rows)
+                        t_here = int(pos[lo:hi].max(initial=0)) + 1
+                        blk = np.full((bucket(rows_here, 1024),
+                                       bucket(t_here, 16)), -1, np.int32)
+                        blk[rows_v[lo:hi] - page * block_rows,
+                            pos[lo:hi]] = enc[lo:hi]
+                        yield blk
+            return
+
+        buf: List[List[int]] = []
+
+        def emit(rows_enc):
+            t_here = max((len(r) for r in rows_enc), default=1)
+            blk = np.full((bucket(len(rows_enc), 1024),
+                           bucket(t_here, 16)), -1, np.int32)
+            for r, row in enumerate(rows_enc):
+                blk[r, : len(row)] = row
+            return blk
+
+        for lines in self._line_blocks():
+            for ln in lines:
+                toks = [t.strip(" \t\r")
+                        for t in ln.split(self.delim)][self.skip:]
+                buf.append([self.index[t] for t in toks if t != ""])
+                if len(buf) >= block_rows:
+                    yield emit(buf)
+                    buf = []
+        if buf:
+            yield emit(buf)
+
+
 class GSPMiner:
     """Frequent-sequence miner: host GSP joins per k + device support scans.
 
@@ -176,6 +304,45 @@ class GSPMiner:
                 break
             counts = self._count(ss, cands, k)
             freq = {c: cnt / n for c, cnt in zip(cands, counts)
+                    if cnt > min_count}
+            if not freq:
+                break
+            out[k] = freq
+        return out
+
+    def mine_stream(self, src: StreamingSequenceSource
+                    ) -> Dict[int, Dict[Tuple[str, ...], float]]:
+        """mine() at unbounded input size: one streamed scan per sequence
+        length k (the reference's one-MR-job-per-k driver), candidate
+        support folded across fixed-shape padded blocks so host RSS stays
+        O(block)."""
+        vocab, counts1, n = src.scan()
+        min_count = self.support_threshold * n
+        out: Dict[int, Dict[Tuple[str, ...], float]] = {}
+        freq = {(tok,): cnt / n for tok, cnt in zip(vocab, counts1)
+                if cnt > min_count}
+        out[1] = freq
+
+        for k in range(2, self.max_length + 1):
+            cands = generate_sequence_candidates(list(freq))
+            if not cands:
+                break
+            # candidate axis padded to a pow2 bucket (executable reuse);
+            # the -2 sentinel never matches any token, so pad rows count 0
+            c_pad = max(64, 1 << (len(cands) - 1).bit_length())
+            cand_pad = np.full((c_pad, k), -2, np.int32)
+            cand_pad[: len(cands)] = np.array(
+                [[src.index.get(t, -2) for t in cd] for cd in cands],
+                np.int32)
+            counts = np.zeros(c_pad, np.int64)
+            cand_d = jnp.asarray(cand_pad)
+            for blk in src.chunks(self.block):
+                counts += np.asarray(_subseq_support_kernel(
+                    jnp.asarray(blk),
+                    jnp.zeros(blk.shape[0], jnp.int32), cand_d, k),
+                    dtype=np.int64)
+            freq = {c: cnt / n
+                    for c, cnt in zip(cands, counts[: len(cands)])
                     if cnt > min_count}
             if not freq:
                 break

@@ -1092,17 +1092,35 @@ def gsp_job(cfg: JobConfig, inputs: List[str], output: str) -> JobResult:
     """GSP frequent-sequence mining; the reference's per-k self-join rounds
     (CandidateGenerationWithSelfJoin.java:44-49) run internally up to
     cgs.item.set.length, with per-k output files."""
-    from avenir_tpu.models.sequence import GSPMiner, SequenceSet
+    from avenir_tpu.models.sequence import (GSPMiner, SequenceSet,
+                                            StreamingSequenceSource)
 
     skip = cfg.get_int("skip.field.count", 1)
-    rows = [[t.strip() for t in ln.split(cfg.field_delim_regex)]
-            for p in inputs for ln in _read_lines(p)]
-    ss = SequenceSet.from_token_rows(rows, skip_field_count=skip)
     miner = GSPMiner(
         support_threshold=cfg.assert_float("support.threshold"),
         max_length=cfg.get_int("item.set.length", 3),
     )
-    levels = miner.mine(ss)
+    total_bytes = sum(os.path.getsize(p) for p in inputs
+                      if os.path.exists(p))
+    in_ram = (cfg.get("stream.block.size.mb") is None
+              and total_bytes < (256 << 20))
+    if in_ram:
+        rows = [[t.strip(" \t\r") for t in ln.split(cfg.field_delim_regex)]
+                for p in inputs for ln in _read_lines(p)]
+        # the in-RAM cost is the padded [N, T] matrix: one anomalously
+        # long row must not blow it up — gate on the footprint
+        t_max = max((len(r) - skip for r in rows), default=1)
+        in_ram = len(rows) * max(t_max, 1) * 4 < (2 << 30)
+    if in_ram:
+        # in-RAM: one [N, T] upload, device-resident across k rounds
+        levels = miner.mine(SequenceSet.from_token_rows(
+            rows, skip_field_count=skip))
+    else:
+        # beyond-RAM (or explicitly chunked): one streamed scan per k
+        levels = miner.mine_stream(StreamingSequenceSource(
+            inputs, delim=cfg.field_delim_regex, skip_field_count=skip,
+            block_bytes=int(cfg.get_float("stream.block.size.mb", 64.0)
+                            * (1 << 20))))
     os.makedirs(output or ".", exist_ok=True)
     outs = []
     delim = cfg.field_delim

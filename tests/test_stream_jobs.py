@@ -424,3 +424,47 @@ def test_text_nb_chunked_equals_whole(tmp_path):
     whole, chunked = _run_both("bayesianDistr", props, [path],
                                tmp_path, "bad")
     assert whole == chunked and whole.strip()
+
+
+def _gsp_file(tmp_path):
+    rng = np.random.default_rng(21)
+    path = str(tmp_path / "gseq.csv")
+    with open(path, "w") as fh:
+        for i in range(250):
+            seq = ["login", "browse"]
+            if rng.random() < 0.6:
+                seq += ["cart", "buy"]
+            if rng.random() < 0.3:
+                seq.append("logout")
+            fh.write(f"u{i}," + ",".join(seq) + "\n")
+    return path
+
+
+def test_gsp_chunked_equals_whole(tmp_path):
+    path = _gsp_file(tmp_path)
+    props = {"cgs.support.threshold": "0.2", "cgs.item.set.length": "3",
+             "cgs.skip.field.count": "1"}
+    res_w = run_job("candidateGenerationWithSelfJoin", props, [path],
+                    str(tmp_path / "gw"))
+    res_c = run_job("candidateGenerationWithSelfJoin",
+                    {**props, "cgs.stream.block.size.mb": TINY_BLOCK},
+                    [path], str(tmp_path / "gc"))
+    assert len(res_w.outputs) == len(res_c.outputs) >= 2
+    for a, b in zip(res_w.outputs, res_c.outputs):
+        assert open(a).read() == open(b).read()
+
+
+def test_gsp_stream_native_and_python_agree(tmp_path, monkeypatch):
+    import avenir_tpu.native.ingest as ingest
+
+    path = _gsp_file(tmp_path)
+    props = {"cgs.support.threshold": "0.2", "cgs.item.set.length": "3",
+             "cgs.skip.field.count": "1",
+             "cgs.stream.block.size.mb": TINY_BLOCK}
+    res_n = run_job("candidateGenerationWithSelfJoin", props, [path],
+                    str(tmp_path / "gn"))
+    monkeypatch.setattr(ingest, "native_available", lambda: False)
+    res_p = run_job("candidateGenerationWithSelfJoin", props, [path],
+                    str(tmp_path / "gp"))
+    for a, b in zip(res_n.outputs, res_p.outputs):
+        assert open(a).read() == open(b).read()
