@@ -7,11 +7,16 @@ asserting host RSS stays O(block) — a whole-file ingest of either input
 would need >2x the file size resident; the streamed jobs are asserted
 under 3GB regardless of input size.
 
-Writes one JSON line per job and a summary to STREAM_SCALE_r05.json.
-Works on CPU (pins the platform; the point is ingest scale, not device
-speed — bench.py measures the TPU fold rates).
+With --extra, also runs the multi-pass miners over the same 100M rows:
+  3. frequentItemsApriori (one streamed scan per itemset length);
+  4. candidateGenerationWithSelfJoin / GSP (one scan per sequence length).
 
-Usage: python tools/stream_scale_check.py [--rows N_MILLION]
+Writes one JSON line per job and a summary to STREAM_SCALE_r05.json
+(merged into any existing records, so a partial re-run never erases
+previously recorded jobs). Works on CPU (pins the platform; the point is
+ingest scale, not device speed — bench.py measures the TPU fold rates).
+
+Usage: python tools/stream_scale_check.py [--rows N_MILLION] [--extra]
 """
 
 import json
@@ -116,8 +121,31 @@ def main():
         {"mst.model.states": "L,M,H", "mst.class.label.field.ord": "1",
          "mst.skip.field.count": "2", "mst.class.labels": "T,F"},
         SEQ_CSV, "/tmp/avenir_scale_mst.txt")
+    if "--extra" in sys.argv:
+        # the multi-pass miners: one streamed scan per k over the same
+        # 100M-row file (transactions reuse the sequence rows: tokens
+        # after the meta fields are the items / the sequence)
+        results["frequentItemsApriori"] = run_child(
+            "frequentItemsApriori",
+            {"fia.support.threshold": "0.3", "fia.item.set.length": "2",
+             "fia.skip.field.count": "2",
+             "fia.stream.block.size.mb": "64"},
+            SEQ_CSV, "/tmp/avenir_scale_fia")
+        results["candidateGenerationWithSelfJoin"] = run_child(
+            "candidateGenerationWithSelfJoin",
+            {"cgs.support.threshold": "0.3", "cgs.item.set.length": "2",
+             "cgs.skip.field.count": "2",
+             "cgs.stream.block.size.mb": "64"},
+            SEQ_CSV, "/tmp/avenir_scale_gsp")
+    merged = {}
+    if os.path.exists("STREAM_SCALE_r05.json"):
+        try:
+            merged = json.load(open("STREAM_SCALE_r05.json"))
+        except ValueError:
+            merged = {}
+    merged.update(results)
     with open("STREAM_SCALE_r05.json", "w") as fh:
-        json.dump(results, fh, indent=1)
+        json.dump(merged, fh, indent=1)
     print(json.dumps({"stream_scale": "done",
                       "mi_rows_per_sec": round(
                           results["rows"]
