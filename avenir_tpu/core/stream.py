@@ -69,55 +69,12 @@ class CsvBlockReader:
         self.byte_range = byte_range
 
     def __iter__(self) -> Iterator[Dataset]:
-        size = os.path.getsize(self.path)
-        start, end = self.byte_range if self.byte_range else (0, size)
-        end = min(end, size)
-        with open(self.path, "rb") as fh:
-            if start > 0:
-                # skip the partial boundary line (it belongs to the prior
-                # split) UNLESS start falls exactly on a line start — the
-                # byte before it tells which (LineRecordReader seeks to
-                # start-1 and always discards one line for the same effect)
-                fh.seek(start - 1)
-                if fh.read(1) != b"\n":
-                    fh.readline()
-            pos = fh.tell()
-            carry = b""
-            while pos < end:
-                block = fh.read(self.block_bytes)
-                if not block:
-                    break
-                pos += len(block)
-                data = carry + block
-                if pos >= end:
-                    # index of byte `end` within data; we own every line
-                    # starting before it, so finish the line containing
-                    # end-1 (reading further if its newline isn't buffered)
-                    b = len(data) - (pos - end)
-                    if b > 0 and data[b - 1:b] == b"\n":
-                        cut = b
-                    else:
-                        nl = data.find(b"\n", b)
-                        while nl < 0:
-                            extra = fh.read(self.block_bytes)
-                            if not extra:
-                                break
-                            off = len(data)
-                            data += extra
-                            nl = data.find(b"\n", off)
-                        cut = (nl + 1) if nl >= 0 else len(data)
-                    if data[:cut].strip():
-                        yield self._parse(data[:cut])
-                    carry = b""
-                    break
-                cut = data.rfind(b"\n")
-                if cut < 0:        # no line boundary yet: keep reading
-                    carry = data
-                    continue
-                carry = data[cut + 1:]
-                yield self._parse(data[: cut + 1])
-            if carry.strip():
-                yield self._parse(carry)
+        # one copy of the split-boundary algorithm: the byte blocks come
+        # from iter_byte_blocks (same LineRecordReader contract), parsed
+        # against the shared schema
+        for blk in iter_byte_blocks(self.path, self.block_bytes,
+                                    self.byte_range):
+            yield self._parse(blk)
 
     def _parse(self, chunk: bytes) -> Dataset:
         return Dataset.from_csv(chunk, self.schema, delim=self.delim,
@@ -194,20 +151,64 @@ def stream_job_inputs(cfg, inputs: Iterable[str], schema: FeatureSchema,
 
 
 def iter_byte_blocks(path: str,
-                     block_bytes: int = DEFAULT_BLOCK_BYTES
+                     block_bytes: int = DEFAULT_BLOCK_BYTES,
+                     byte_range: Optional[Tuple[int, int]] = None
                      ) -> Iterator[bytes]:
     """Yield ~block_bytes raw byte blocks cut at line boundaries — the
     zero-copy feed for native block consumers (seq_encode): no decode,
-    no per-line Python strings."""
+    no per-line Python strings.
+
+    byte_range=(start, end) restricts to one INPUT SPLIT with the same
+    Hadoop LineRecordReader boundary contract as CsvBlockReader: a split
+    starting mid-line skips past its first newline (the previous split
+    owns that line) and owns every line that STARTS before `end`, so
+    disjoint ranges covering [0, size) yield every line exactly once —
+    multi-host ingest for the sequence jobs."""
     if not os.path.exists(path):
         raise FileNotFoundError(f"no such input file: {path!r}")
+    if block_bytes < 1:
+        raise ValueError(f"block_bytes must be positive, got {block_bytes}")
+    if byte_range is not None:
+        s, e = byte_range
+        if s < 0 or e < s:
+            raise ValueError(f"invalid byte_range {byte_range}")
+    size = os.path.getsize(path)
+    start, end = byte_range if byte_range else (0, size)
+    end = min(end, size)
     with open(path, "rb") as fh:
+        if start > 0:
+            fh.seek(start - 1)
+            if fh.read(1) != b"\n":
+                fh.readline()
+        pos = fh.tell()
         carry = b""
-        while True:
+        while pos < end:
             block = fh.read(block_bytes)
             if not block:
                 break
+            pos += len(block)
             data = carry + block
+            if pos >= end:
+                # finish the line containing byte end-1 (we own every
+                # line starting before `end`), reading past end if its
+                # newline isn't buffered yet
+                b = len(data) - (pos - end)
+                if b > 0 and data[b - 1:b] == b"\n":
+                    cut = b
+                else:
+                    nl = data.find(b"\n", b)
+                    while nl < 0:
+                        extra = fh.read(block_bytes)
+                        if not extra:
+                            break
+                        off = len(data)
+                        data += extra
+                        nl = data.find(b"\n", off)
+                    cut = (nl + 1) if nl >= 0 else len(data)
+                if data[:cut].strip():
+                    yield data[:cut]
+                carry = b""
+                break
             cut = data.rfind(b"\n")
             if cut < 0:
                 carry = data

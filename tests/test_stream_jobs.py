@@ -468,3 +468,38 @@ def test_gsp_stream_native_and_python_agree(tmp_path, monkeypatch):
                     str(tmp_path / "gp"))
     for a, b in zip(res_n.outputs, res_p.outputs):
         assert open(a).read() == open(b).read()
+
+
+def test_byte_block_splits_cover_every_line_once(tmp_path):
+    """iter_byte_blocks(byte_range=...) follows the LineRecordReader
+    split contract: disjoint ranges covering the file yield every line
+    exactly once — partial Markov models from splits merge to the whole
+    model (the multi-host sequence ingest story)."""
+    from avenir_tpu.core.stream import iter_byte_blocks
+    from avenir_tpu.models.markov import MarkovStateTransitionModel
+    from avenir_tpu.native.ingest import seq_encode_native
+
+    path = _markov_file(tmp_path)
+    size = os.path.getsize(path)
+    # awkward split points (mid-line) across 3 ranges
+    cuts = [0, size // 3 + 7, 2 * size // 3 + 3, size]
+    merged_lines = []
+    part_counts = np.zeros((2, 3, 3))
+    label_codes = np.asarray([3, 4])
+    for lo, hi in zip(cuts[:-1], cuts[1:]):
+        m = MarkovStateTransitionModel(["L", "M", "H"],
+                                       class_labels=["T", "F"])
+        for blk in iter_byte_blocks(path, 512, byte_range=(lo, hi)):
+            merged_lines += [ln for ln in
+                             blk.decode().split("\n") if ln.strip()]
+            enc = seq_encode_native(blk, ",", ["L", "M", "H", "T", "F"])
+            m.fit_csr(*enc, skip=2, class_ord=1, label_codes=label_codes)
+        part_counts += m.counts
+    assert sorted(merged_lines) == sorted(
+        ln for ln in open(path).read().split("\n") if ln.strip())
+    whole = MarkovStateTransitionModel(["L", "M", "H"],
+                                       class_labels=["T", "F"])
+    for blk in iter_byte_blocks(path, 1 << 20):
+        enc = seq_encode_native(blk, ",", ["L", "M", "H", "T", "F"])
+        whole.fit_csr(*enc, skip=2, class_ord=1, label_codes=label_codes)
+    np.testing.assert_array_equal(part_counts, whole.counts)
