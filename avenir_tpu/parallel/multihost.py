@@ -58,8 +58,9 @@ def host_shard_bounds(n_rows_global: int) -> tuple:
 
 
 def host_csv_byte_range(path: str) -> tuple:
-    """This host's input split of ONE big CSV file: a contiguous byte
-    range to hand to CsvBlockReader(byte_range=...), which applies the
+    """This host's input split of ONE big input file: a contiguous byte
+    range to hand to CsvBlockReader(byte_range=...) — or, for the ragged
+    sequence jobs, iter_byte_blocks(byte_range=...) — both applying the
     Hadoop LineRecordReader boundary contract so the per-host splits
     partition the lines exactly. With host_shard_bounds this covers both
     ingest layouts the reference's HDFS splits served: one file per host,
