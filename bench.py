@@ -351,14 +351,19 @@ def bench_knn_stream():
     return KNN_STREAM_TRAIN / dt, nq * KNN_STREAM_TRAIN / dt, dt, use_pallas
 
 
-def bench_knn(dim: int):
+def bench_knn(dim: int, mode: str = "both"):
     """One fused classify step (top-k + kernel vote) per query batch.
 
     Returns (queries/sec, achieved FLOP/s) counting only the 2*nq*nt*d
     distance matmul flops (vote flops are negligible). Uses the
     lane-resident packed kernel (ops/pallas_knn.knn_topk_lanes) in
     bfloat16 — the opt-in fast path (NeighborIndex(packed=True)); the
-    model-layer default stays the exact kernel."""
+    model-layer default stays the exact kernel.
+
+    mode: "composed" times only the top-k kernel + XLA vote path,
+    "fused" only the in-kernel vote (knn_classify_lanes), "both" both —
+    the bank runs them as separate stages so a Mosaic failure in the
+    rebuilt fused kernel cannot take the composed number down with it."""
     import jax
     import jax.numpy as jnp
     from avenir_tpu.models.knn import _vote
@@ -371,30 +376,33 @@ def bench_knn(dim: int):
     t_labels = jnp.asarray(rng.integers(0, 2, KNN_TRAIN).astype(np.int32))
     use_pallas = pallas_available()
 
-    @jax.jit
-    def classify_many(q, t, t_labels):
-        def step(i):
-            qi = jnp.roll(q, i, axis=0)
-            if use_pallas:
-                # lane-resident packed kernel: tile stays in VMEM, carries
-                # persist across train blocks, extraction deferred to XLA
-                dist, idx = knn_topk_lanes(qi, t, k=KNN_K, block_q=1024,
-                                           block_t=4096, metric="euclidean",
-                                           compute_dtype="bfloat16")
-            else:
-                dist, idx = blocked_topk_neighbors(
-                    qi, t, k=KNN_K, block=KNN_BLOCK, metric="euclidean")
-            scores = _vote(dist, t_labels[idx], jnp.ones_like(dist),
-                           "gaussian", 30.0, 2, False, False)
-            return jnp.sum(scores).astype(jnp.float32)
-        return jax.lax.map(step, jnp.arange(1, KNN_STEPS + 1)).sum()
+    qps = flops = float("nan")
+    if mode in ("both", "composed"):
+        @jax.jit
+        def classify_many(q, t, t_labels):
+            def step(i):
+                qi = jnp.roll(q, i, axis=0)
+                if use_pallas:
+                    # lane-resident packed kernel: tile stays in VMEM,
+                    # carries persist across train blocks, extraction
+                    # deferred to XLA
+                    dist, idx = knn_topk_lanes(
+                        qi, t, k=KNN_K, block_q=1024, block_t=4096,
+                        metric="euclidean", compute_dtype="bfloat16")
+                else:
+                    dist, idx = blocked_topk_neighbors(
+                        qi, t, k=KNN_K, block=KNN_BLOCK, metric="euclidean")
+                scores = _vote(dist, t_labels[idx], jnp.ones_like(dist),
+                               "gaussian", 30.0, 2, False, False)
+                return jnp.sum(scores).astype(jnp.float32)
+            return jax.lax.map(step, jnp.arange(1, KNN_STEPS + 1)).sum()
 
-    dt = _timed(classify_many, q, t, t_labels)
-    qps = KNN_QUERIES * KNN_STEPS / dt
-    flops = 2.0 * KNN_QUERIES * KNN_TRAIN * dim * KNN_STEPS / dt
+        dt = _timed(classify_many, q, t, t_labels)
+        qps = KNN_QUERIES * KNN_STEPS / dt
+        flops = 2.0 * KNN_QUERIES * KNN_TRAIN * dim * KNN_STEPS / dt
 
     fused_qps = float("nan")
-    if use_pallas:
+    if use_pallas and mode in ("both", "fused"):
         from avenir_tpu.ops.pallas_knn import knn_classify_lanes
 
         @jax.jit
@@ -639,11 +647,278 @@ def _backend_reachable(timeout_s: float = 180.0) -> bool:
     return _accelerator_reachable(timeout_s)
 
 
-def main():
-    import jax
-    from avenir_tpu.utils.profiling import enable_persistent_compilation_cache
+def _json_safe(obj):
+    """NaN/inf (e.g. a skipped optional section) would emit invalid
+    JSON tokens; the driver parses this line, so null them."""
+    if isinstance(obj, dict):
+        return {k: _json_safe(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return [_json_safe(v) for v in obj]
+    if isinstance(obj, float) and not np.isfinite(obj):
+        return None
+    return obj
 
-    if not _backend_reachable():
+
+# ---------------------------------------------------------------------------
+# Measurement bank: flap-tolerant sectioned execution.
+#
+# Round-4/5 lesson: the tunnel to the chip FLAPS — it answered one probe at
+# 03:49 and wedged 15 seconds later, taking a whole in-process bench run
+# with it. So every section runs in its OWN subprocess with a hard timeout,
+# and each success is immediately persisted to BANK_PATH; the final JSON
+# line is assembled from the bank. A mid-run outage then costs only the
+# sections not yet (re)measured — their last banked values still carry the
+# round — instead of zeroing everything (BENCH_r04.json was an error
+# object for exactly this reason).
+# ---------------------------------------------------------------------------
+
+BANK_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                         "TPU_BANK_r05.json")
+
+
+def _sec_sanity():
+    """Device identity + a timed matmul: proves the tunnel executes (a
+    wedged tunnel hangs here, inside this stage's subprocess timeout,
+    not inside the parent)."""
+    import jax
+    import jax.numpy as jnp
+
+    dev = jax.devices()[0]
+    a = jnp.ones((2048, 2048), jnp.bfloat16)
+
+    @jax.jit
+    def mm_many(a):
+        def step(x, _):
+            return x @ a, None
+        out, _ = jax.lax.scan(step, a, None, length=8)
+        return jnp.sum(out.astype(jnp.float32))
+
+    _ = float(mm_many(a))
+    t0 = time.perf_counter()
+    _ = float(mm_many(a))
+    return {"device_kind": dev.device_kind, "platform": dev.platform,
+            "matmul8_s": round(time.perf_counter() - t0, 4)}
+
+
+def _sec_nb():
+    train_rps, predict_rps, nb_rps = bench_naive_bayes()
+    return {"train_rps": train_rps, "predict_rps": predict_rps,
+            "nb_rps": nb_rps}
+
+
+def _sec_knn_d8():
+    qps, flops, _ = bench_knn(8, mode="composed")
+    return {"qps": qps, "flops": flops}
+
+
+def _sec_knn_d128():
+    qps, flops, _ = bench_knn(128, mode="composed")
+    return {"qps": qps, "flops": flops}
+
+
+def _sec_fused_d8():
+    _, _, fused = bench_knn(8, mode="fused")
+    return {"fused_qps": fused}
+
+
+def _sec_fused_d128():
+    _, _, fused = bench_knn(128, mode="fused")
+    return {"fused_qps": fused}
+
+
+def _sec_ceiling_d128():
+    return {"flops": bench_knn_matmul_ceiling(128)}
+
+
+def _sec_rf():
+    rls, levels, predict_rps = bench_random_forest()
+    return {"rls": rls, "levels": levels, "predict_rps": predict_rps}
+
+
+def _sec_apriori():
+    txs, rounds, found = bench_apriori()
+    return {"txs": txs, "rounds": rounds, "found": found}
+
+
+def _sec_bandit():
+    return {"gds": bench_bandit()}
+
+
+def _sec_anchor():
+    nb_node_rps, pair_node_pps = measure_baseline_anchor()
+    return {"nb_node_rps": nb_node_rps, "pair_node_pps": pair_node_pps}
+
+
+def _sec_nb_stream():
+    gen_rps, csv_rps, parse_rps, overlap_eff, rss_mb = bench_nb_stream()
+    return {"gen_rps": gen_rps, "csv_rps": csv_rps, "parse_rps": parse_rps,
+            "overlap_eff": overlap_eff, "rss_mb": rss_mb}
+
+
+def _sec_knn_stream():
+    rps, pds, elapsed_s, use_pallas = bench_knn_stream()
+    return {"rps": rps, "pds": pds, "elapsed_s": elapsed_s,
+            "pallas": bool(use_pallas)}
+
+
+def _sec_kernel_sweep():
+    """The full compiled-kernel hardware sweep (tools/tpu_kernel_check.py),
+    including the exhausted-rounds fused-vote edge."""
+    import subprocess
+
+    proc = subprocess.run(
+        [sys.executable, "tools/tpu_kernel_check.py"],
+        capture_output=True, text=True, timeout=3000,
+        cwd=os.path.dirname(os.path.abspath(__file__)),
+        env=dict(os.environ, AVENIR_SKIP_DEVICE_PROBE="1"))
+    tail = proc.stdout.strip().splitlines()[-1] if proc.stdout else ""
+    if proc.returncode != 0:
+        raise RuntimeError(f"kernel sweep failed: "
+                           f"{tail or proc.stderr[-300:]}")
+    return {"tail": tail}
+
+
+# (name, fn, timeout_s, needs_tpu) in execution order: cheap core metrics
+# first so a flap mid-drain loses the least; the two 1B-row streams next;
+# the outage-rebuilt fused kernel and the sweep LAST so a Mosaic lowering
+# failure there cannot cost anything already banked.
+SECTIONS = [
+    ("sanity", _sec_sanity, 600, True),
+    ("anchor", _sec_anchor, 900, False),
+    ("nb", _sec_nb, 1500, True),
+    ("knn_d8", _sec_knn_d8, 1500, True),
+    ("knn_d128", _sec_knn_d128, 1500, True),
+    ("ceiling_d128", _sec_ceiling_d128, 1200, True),
+    ("rf", _sec_rf, 1800, True),
+    ("apriori", _sec_apriori, 1500, True),
+    ("bandit", _sec_bandit, 1500, True),
+    ("nb_stream", _sec_nb_stream, 3600, True),
+    ("knn_stream", _sec_knn_stream, 3600, True),
+    ("fused_d8", _sec_fused_d8, 1500, True),
+    ("fused_d128", _sec_fused_d128, 1500, True),
+    ("kernel_sweep", _sec_kernel_sweep, 3300, True),
+]
+SECTION_FNS = {name: fn for name, fn, _, _ in SECTIONS}
+
+
+def _load_bank() -> dict:
+    try:
+        with open(BANK_PATH) as fh:
+            return json.load(fh)
+    except (OSError, ValueError):
+        return {}
+
+
+def _save_bank(bank: dict) -> None:
+    tmp = BANK_PATH + ".tmp"
+    with open(tmp, "w") as fh:
+        json.dump(_json_safe(bank), fh, indent=1)
+    os.replace(tmp, BANK_PATH)
+
+
+def _section_child(name: str) -> int:
+    """Run ONE section in this process and print a single JSON line.
+    Invoked by the drain as `bench.py --section NAME` so a hang or crash
+    is contained by the parent's subprocess timeout."""
+    t0 = time.perf_counter()
+    try:
+        if name != "anchor":
+            from avenir_tpu.utils.profiling import (
+                enable_persistent_compilation_cache)
+            enable_persistent_compilation_cache()
+        values = SECTION_FNS[name]()
+        print(json.dumps(_json_safe(
+            {"ok": True, "section": name,
+             "s": round(time.perf_counter() - t0, 1), "values": values})))
+        return 0
+    except Exception as e:  # noqa: BLE001 — reported as data, parent decides
+        print(json.dumps({"ok": False, "section": name,
+                          "error": repr(e)[:400]}))
+        return 1
+
+
+def _run_section(name: str, timeout_s: float):
+    """(values, error): run one section as a subprocess with a hard
+    timeout; the child skips the device probe (the drain already did it)."""
+    import subprocess
+
+    env = dict(os.environ, AVENIR_SKIP_DEVICE_PROBE="1")
+    here = os.path.dirname(os.path.abspath(__file__))
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.join(here, "bench.py"),
+             "--section", name],
+            capture_output=True, text=True, timeout=timeout_s, env=env,
+            cwd=here)
+    except subprocess.TimeoutExpired:
+        return None, f"section hung >{timeout_s:.0f}s (tunnel flap?)"
+    obj = None
+    for line in reversed((proc.stdout or "").strip().splitlines()):
+        try:
+            obj = json.loads(line)
+            break
+        except ValueError:
+            continue
+    if obj and obj.get("ok"):
+        return obj["values"], None
+    if obj and obj.get("error"):
+        return None, obj["error"]
+    tail = (proc.stderr or proc.stdout or "").strip().splitlines()
+    return None, (tail[-1][:400] if tail
+                  else f"section exited {proc.returncode} with no output")
+
+
+def drain(force: bool = False, only=None, probe_timeout: float = 120.0):
+    """Measure every (unbanked, or all when force=True) section, each in
+    its own subprocess; persist each success to the bank immediately.
+    Failures never clobber an earlier banked success. Returns the list of
+    (name, error) failures this pass."""
+    failures = []
+    tpu_ok = None  # probed lazily, re-probed after any TPU-section failure
+    for name, _fn, timeout_s, needs_tpu in SECTIONS:
+        if only is not None and name not in only:
+            continue
+        bank = _load_bank()
+        prior = bank.get(name, {})
+        if prior.get("ok") and not force:
+            continue
+        if needs_tpu:
+            if tpu_ok is None:
+                tpu_ok = _backend_reachable(probe_timeout)
+            if not tpu_ok:
+                failures.append((name, "tunnel down at probe"))
+                continue
+        t0 = time.perf_counter()
+        values, err = _run_section(name, timeout_s)
+        if values is not None:
+            bank = _load_bank()
+            bank[name] = {"ok": True, "ts": round(time.time(), 1),
+                          "s": round(time.perf_counter() - t0, 1),
+                          "values": values}
+            _save_bank(bank)
+            print(f"# banked {name} ({bank[name]['s']}s)", file=sys.stderr)
+        else:
+            failures.append((name, err))
+            print(f"# FAILED {name}: {err}", file=sys.stderr)
+            if not prior.get("ok"):
+                bank = _load_bank()
+                bank[name] = {"ok": False, "ts": round(time.time(), 1),
+                              "error": err}
+                _save_bank(bank)
+            if needs_tpu:
+                tpu_ok = None  # flap suspected: re-probe before next one
+    return failures
+
+
+def main():
+    bank = _load_bank()
+    reachable = _backend_reachable()
+    if reachable:
+        drain(force=True)
+        bank = _load_bank()
+    banked_ok = [n for n, _f, _t, _n in SECTIONS
+                 if bank.get(n, {}).get("ok")]
+    if not reachable and not banked_ok:
         print(json.dumps({
             "metric": "nb_knn_rows_per_sec_per_chip", "value": 0,
             "unit": "rows/sec", "vs_baseline": 0,
@@ -651,37 +926,65 @@ def main():
                       ">180s) - transient tunnel outage, not a framework "
                       "failure; rerun when the device responds"),
             "outage_note": (
-                "tools/tpu_watcher.sh auto-runs tools/"
-                "tpu_validation_queue.py --full the moment the tunnel "
-                "returns (evidence lands in tpu_queue_r05.log); "
-                "measured CPU-side scale evidence from this round: "
-                "STREAM_SCALE_r05.json (100M-row MI/markov/apriori/GSP "
-                "at O(block) RSS) and nb_stream_1b_r05.log (1e9 real "
+                "tools/tpu_watcher.sh loops `bench.py --drain` and banks "
+                "each section to TPU_BANK_r05.json the moment the tunnel "
+                "returns; measured CPU-side scale evidence from this "
+                "round: STREAM_SCALE_r05.json (100M-row MI/markov/apriori/"
+                "GSP at O(block) RSS) and nb_stream_1b_r05.log (1e9 real "
                 "on-disk rows end-to-end); last real chip numbers: "
                 "BENCH_r03.json")}))
         return
-    enable_persistent_compilation_cache()
-    dev = jax.devices()[0]
-    peak = PEAK_FLOPS.get(dev.device_kind, DEFAULT_PEAK)
-    train_rps, predict_rps, nb_rps = bench_naive_bayes()
-    (stream_rps, stream_csv_rps, parse_rps, overlap_eff,
-     rss_mb) = bench_nb_stream()
-    (knn_stream_rps, knn_stream_pds, knn_stream_s,
-     knn_stream_pallas) = bench_knn_stream()
-    rf_rls, rf_levels, rf_predict_rps = bench_random_forest()
-    ap_txs, ap_rounds, ap_found = bench_apriori()
-    bandit_gds = bench_bandit()
-    knn_qps, knn_flops, knn_fused_qps = bench_knn(8)
-    knn_qps_hi, knn_flops_hi, knn_fused_qps_hi = bench_knn(128)
-    on_tpu = dev.platform == "tpu"
-    ceiling = bench_knn_matmul_ceiling(128) if on_tpu else float("nan")
+    print(json.dumps(_json_safe(_assemble(bank, live=reachable))))
+
+
+def _bv(bank, section, key, default=float("nan")):
+    entry = bank.get(section, {})
+    if not entry.get("ok"):
+        return default
+    v = entry["values"].get(key, default)
+    return default if v is None else v
+
+
+def _assemble(bank: dict, live: bool) -> dict:
+    """Build the one-line bench JSON from banked section values."""
+    device_kind = _bv(bank, "sanity", "device_kind", "unknown")
+    platform = _bv(bank, "sanity", "platform", "unknown")
+    on_tpu = platform == "tpu"
+    peak = PEAK_FLOPS.get(device_kind, DEFAULT_PEAK)
+    train_rps = _bv(bank, "nb", "train_rps")
+    predict_rps = _bv(bank, "nb", "predict_rps")
+    nb_rps = _bv(bank, "nb", "nb_rps")
+    stream_rps = _bv(bank, "nb_stream", "gen_rps")
+    stream_csv_rps = _bv(bank, "nb_stream", "csv_rps")
+    parse_rps = _bv(bank, "nb_stream", "parse_rps")
+    overlap_eff = _bv(bank, "nb_stream", "overlap_eff")
+    rss_mb = _bv(bank, "nb_stream", "rss_mb")
+    knn_stream_rps = _bv(bank, "knn_stream", "rps")
+    knn_stream_pds = _bv(bank, "knn_stream", "pds")
+    knn_stream_s = _bv(bank, "knn_stream", "elapsed_s")
+    knn_stream_pallas = bool(_bv(bank, "knn_stream", "pallas", False))
+    rf_rls = _bv(bank, "rf", "rls")
+    rf_levels = _bv(bank, "rf", "levels")
+    rf_predict_rps = _bv(bank, "rf", "predict_rps")
+    ap_txs = _bv(bank, "apriori", "txs")
+    ap_rounds = _bv(bank, "apriori", "rounds")
+    ap_found = _bv(bank, "apriori", "found")
+    bandit_gds = _bv(bank, "bandit", "gds")
+    knn_qps = _bv(bank, "knn_d8", "qps")
+    knn_flops = _bv(bank, "knn_d8", "flops")
+    knn_qps_hi = _bv(bank, "knn_d128", "qps")
+    knn_flops_hi = _bv(bank, "knn_d128", "flops")
+    knn_fused_qps = _bv(bank, "fused_d8", "fused_qps")
+    knn_fused_qps_hi = _bv(bank, "fused_d128", "fused_qps")
+    ceiling = _bv(bank, "ceiling_d128", "flops")
+    anchor_nb_rps = _bv(bank, "anchor", "nb_node_rps")
+    anchor_pair_pps = _bv(bank, "anchor", "pair_node_pps")
     combined = 2.0 / (1.0 / nb_rps + 1.0 / knn_qps)
     nb_speedup = nb_rps / HADOOP_NB_ROWS_PER_SEC
     knn_speedup = knn_qps / (HADOOP_PAIR_DIST_PER_SEC / KNN_TRAIN)
     vs_baseline = float(np.sqrt(nb_speedup * knn_speedup))
     # measured anchor: native per-node rate measured on this host, scaled
     # by the documented MR efficiency factor, x 32 nodes
-    anchor_nb_rps, anchor_pair_pps = measure_baseline_anchor()
     anchored_nb_cluster = 32 * MR_EFFICIENCY * anchor_nb_rps
     anchored_pair_cluster = 32 * MR_EFFICIENCY * anchor_pair_pps
     nb_speedup_anchor = nb_rps / anchored_nb_cluster
@@ -701,7 +1004,7 @@ def main():
     mfu_d128 = knn_flops_hi / peak
     ceiling_frac = knn_flops_hi / ceiling if on_tpu else float("nan")
     print(
-        f"# device={dev.device_kind} nb_train={train_rps:.3e} "
+        f"# device={device_kind} nb_train={train_rps:.3e} "
         f"nb_predict={predict_rps:.3e} nb={nb_rps:.3e} knn_d8={knn_qps:.3e} "
         f"q/s ({knn_flops/1e12:.1f} TF/s, MFU {mfu_d8*100:.1f}% — d=8 is "
         f"8 MACs (16 FLOPs)/distance, VPU/memory-bound by construction) "
@@ -714,18 +1017,14 @@ def main():
         f"(parse {parse_rps:.3e} r/s) peak_rss={rss_mb:.0f}MB",
         file=sys.stderr,
     )
-    def _json_safe(obj):
-        """NaN/inf (e.g. a skipped optional section) would emit invalid
-        JSON tokens; the driver parses this line, so null them."""
-        if isinstance(obj, dict):
-            return {k: _json_safe(v) for k, v in obj.items()}
-        if isinstance(obj, (list, tuple)):
-            return [_json_safe(v) for v in obj]
-        if isinstance(obj, float) and not np.isfinite(obj):
-            return None
-        return obj
-
-    print(json.dumps(_json_safe({
+    provenance = {
+        name: ({"measured_at": entry.get("ts"), "seconds": entry.get("s")}
+               if entry.get("ok")
+               else {"failed": entry.get("error", "not measured")})
+        for name, _f, _t, _n in SECTIONS
+        for entry in [bank.get(name, {})]
+    }
+    out = {
         "metric": "nb_knn_rows_per_sec_per_chip",
         "value": round(combined, 1),
         "unit": "rows/sec",
@@ -830,7 +1129,24 @@ def main():
             "public v5e ICI ballparks); rows give 65k-rows/device bench "
             "steps and the 4M-row streaming-fold steps that amortize hop "
             "latency away"),
-    })))
+        "kernel_sweep": _bv(bank, "kernel_sweep", "tail", None),
+        "bank_provenance": provenance,
+        "bank_note": (
+            "each section ran in its own subprocess with a hard timeout "
+            "and was banked to TPU_BANK_r05.json on success (the tunnel "
+            "to the chip flaps; round 4 lost every number to one "
+            "mid-run outage). measured_at is the unix time the section "
+            "last succeeded on the real device"
+            + ("" if live else "; THIS assembly ran during an outage, "
+               "so every value is a banked earlier-in-round measurement")),
+    }
+    if not np.isfinite(combined):
+        out["value"] = 0
+        out["vs_baseline"] = 0
+        out["error"] = ("core sections (nb, knn_d8) have no banked "
+                        "measurement yet - tunnel outage before any "
+                        "successful drain; see bank_provenance")
+    return out
 
 
 def _scaling_projection(train_rps: float):
@@ -838,6 +1154,8 @@ def _scaling_projection(train_rps: float):
     from avenir_tpu.parallel.scaling import (nb_payload_bytes,
                                              project_efficiency)
 
+    if not np.isfinite(train_rps):
+        return None
     # the payload the scaling harness validates against the compiled HLO
     payload = nb_payload_bytes()
     return {
@@ -849,4 +1167,15 @@ def _scaling_projection(train_rps: float):
 
 
 if __name__ == "__main__":
-    main()
+    if "--section" in sys.argv:
+        sys.exit(_section_child(sys.argv[sys.argv.index("--section") + 1]))
+    elif "--drain" in sys.argv:
+        fails = drain(force="--force" in sys.argv)
+        bank = _load_bank()
+        done = [n for n, _f, _t, _n in SECTIONS if bank.get(n, {}).get("ok")]
+        print(json.dumps({"banked_ok": done,
+                          "failures": [list(f) for f in fails]}))
+        sys.exit(0 if len(done) == len(SECTIONS) else
+                 (2 if any("tunnel down" in e for _, e in fails) else 1))
+    else:
+        main()

@@ -1,100 +1,44 @@
 """One-shot TPU validation queue for work that landed during an outage.
 
-Runs, in order, everything that needs the real chip and prints a PASS/FAIL
-line per stage plus one summary JSON line:
+Round-5 rework: the tunnel FLAPS (it answered a probe at 03:49 and wedged
+15 seconds later, hanging the previous in-process version of this queue
+indefinitely). All hardware measurement now lives in bench.py's section
+bank — every section runs in its OWN subprocess with a hard timeout and
+each success is persisted to TPU_BANK_r05.json the moment it lands. This
+queue is the operator entry point over that machinery:
 
-  1. fused knn_classify_lanes compiles + runs (f32 and bf16) — the kernel
-     was rebuilt (argmin-free epilogue, full-tile label OR, vmem cap)
-     without hardware available;
-  2. tools/tpu_kernel_check.py (the full compiled-kernel sweep, including
-     the exhausted-rounds edge);
-  3. the reworked bench sections one by one (apriori device-resident scan,
-     forest-batched RF, resident-state bandit, 1B-row NB stream, 1B-row
-     streaming KNN);
-  4. (optional, --full) the whole bench.py.
+  python tools/tpu_validation_queue.py          # drain unbanked sections
+  python tools/tpu_validation_queue.py --full   # re-measure everything
 
-Usage: python tools/tpu_validation_queue.py [--full]
-Exit 0 iff every attempted stage passes.
+It prints one PASS/FAIL line per section from the bank plus a summary
+JSON line. Exit 0 iff every section is banked ok. The fused classify
+kernel (f32/bf16 correctness vs an XLA oracle) and the exhausted-rounds
+edge are covered inside the kernel_sweep section
+(tools/tpu_kernel_check.py).
 """
 
 import json
-import subprocess
 import sys
-import time
 
 sys.path.insert(0, ".")
 
 
 def main() -> int:
-    from __graft_entry__ import _probe_accelerator
+    from bench import SECTIONS, _load_bank, drain
 
-    ok, why = _probe_accelerator(120)
-    if not ok:
-        print(json.dumps({"queue": "aborted", "reason": why}))
-        return 1
-
-    results = {}
-
-    def stage(name, fn):
-        t0 = time.perf_counter()
-        try:
-            out = fn()
-            results[name] = {"ok": True, "s": round(time.perf_counter() - t0, 1)}
-            if out is not None:
-                results[name]["value"] = out
-            print(f"PASS {name} ({results[name]['s']}s)", flush=True)
-        except Exception as e:  # keep draining the queue
-            results[name] = {"ok": False, "error": repr(e)[:300]}
-            print(f"FAIL {name}: {e!r}", flush=True)
-
-    def fused_kernel():
-        import numpy as np
-        import jax.numpy as jnp
-        from avenir_tpu.ops.pallas_knn import knn_classify_lanes
-
-        rng = np.random.default_rng(2)
-        q = jnp.asarray(rng.normal(size=(8192, 128)).astype(np.float32))
-        t = jnp.asarray(rng.normal(size=(131072, 128)).astype(np.float32))
-        tl = jnp.asarray(rng.integers(0, 2, 131072).astype(np.int32))
-        sums = {}
-        for dt in ("float32", "bfloat16"):
-            s = knn_classify_lanes(q, t, tl, k=5, n_classes=2,
-                                   kernel_fn="gaussian", kernel_param=30.0,
-                                   block_q=1024, block_t=4096,
-                                   metric="euclidean", compute_dtype=dt)
-            sums[dt] = float(jnp.sum(s))
-            assert np.isfinite(sums[dt])
-        return sums
-
-    def kernel_check():
-        proc = subprocess.run([sys.executable, "tools/tpu_kernel_check.py"],
-                              capture_output=True, text=True, timeout=3600)
-        tail = proc.stdout.strip().splitlines()[-1] if proc.stdout else ""
-        assert proc.returncode == 0, tail or proc.stderr[-300:]
-        return tail
-
-    stage("fused_classify_kernel", fused_kernel)
-    stage("kernel_check_sweep", kernel_check)
-
-    import bench
-
-    stage("bench_apriori", lambda: bench.bench_apriori()[0])
-    stage("bench_random_forest", lambda: bench.bench_random_forest()[0])
-    stage("bench_bandit", bench.bench_bandit)
-    stage("bench_nb_stream_1b", lambda: bench.bench_nb_stream()[0])
-    stage("bench_knn_stream_1b", lambda: bench.bench_knn_stream()[0])
-
-    if "--full" in sys.argv[1:]:
-        def full_bench():
-            proc = subprocess.run([sys.executable, "bench.py"],
-                                  capture_output=True, text=True,
-                                  timeout=5400)
-            assert proc.returncode == 0, proc.stderr[-300:]
-            return json.loads(proc.stdout.strip().splitlines()[-1])
-        stage("bench_full", full_bench)
-
-    print(json.dumps({"queue": "done", "stages": results}))
-    return 0 if all(r.get("ok") for r in results.values()) else 1
+    drain(force="--full" in sys.argv[1:])
+    bank = _load_bank()
+    all_ok = True
+    for name, _fn, _timeout, _needs_tpu in SECTIONS:
+        entry = bank.get(name, {})
+        if entry.get("ok"):
+            print(f"PASS {name} ({entry.get('s', '?')}s)", flush=True)
+        else:
+            all_ok = False
+            print(f"FAIL {name}: {entry.get('error', 'not measured')}",
+                  flush=True)
+    print(json.dumps({"queue": "done", "banked": bank}))
+    return 0 if all_ok else 1
 
 
 if __name__ == "__main__":

@@ -1,21 +1,32 @@
 #!/bin/bash
-# Probe the TPU tunnel every 5 min; the moment it is up, run the full
-# validation queue (fused kernel, kernel sweep, reworked bench sections,
-# whole bench.py) and bank the evidence in tpu_queue_r05.log.
+# Probe-and-drain loop for a flapping TPU tunnel: every pass runs
+# `bench.py --drain`, which probes the backend (120s hard timeout) and
+# measures every not-yet-banked section, EACH in its own subprocess with
+# its own timeout, banking every success to TPU_BANK_r05.json
+# immediately. A flap mid-pass therefore costs one section, not the
+# round (round 4 lost all its numbers to one in-process hang).
+#
+# Exit codes from --drain: 0 = all sections banked (stop); 2 = tunnel
+# down (keep probing indefinitely — outages last hours); 1 = a section
+# failed for a non-tunnel reason (retry a bounded number of times: a
+# flap can kill the last section of a pass and still exit 1, but a
+# DETERMINISTIC failure, e.g. a Mosaic lowering bug, would otherwise
+# re-run the same expensive section every 3 min forever).
 set -o pipefail
 cd /root/repo
+hard_fails=0
 while true; do
-  if python -c "
-from __graft_entry__ import _accelerator_reachable
-import sys
-sys.exit(0 if _accelerator_reachable(90) else 1)
-" 2>/dev/null; then
-    echo "=== TUNNEL UP at $(date -u +%H:%M:%S) — running validation queue ===" | tee -a tpu_queue_r05.log
-    python tools/tpu_validation_queue.py --full 2>&1 | tee -a tpu_queue_r05.log
-    rc=${PIPESTATUS[0]}
-    echo "=== QUEUE EXIT ${rc} at $(date -u +%H:%M:%S) ===" | tee -a tpu_queue_r05.log
-    break
+  python bench.py --drain >> tpu_watch_r05.log 2>&1
+  rc=$?
+  echo "drain exit ${rc} at $(date -u +%H:%M:%S)" >> tpu_watch_r05.log
+  [ "$rc" -eq 0 ] && break
+  if [ "$rc" -eq 1 ]; then
+    hard_fails=$((hard_fails + 1))
+    if [ "$hard_fails" -ge 5 ]; then
+      echo "GIVING UP after ${hard_fails} non-tunnel failures at $(date -u +%H:%M:%S)" >> tpu_watch_r05.log
+      exit 1
+    fi
   fi
-  echo "probe: tunnel down at $(date -u +%H:%M:%S)" >> tpu_watch_r05.log
-  sleep 300
+  sleep 180
 done
+echo "BANK COMPLETE at $(date -u +%H:%M:%S)" >> tpu_watch_r05.log
