@@ -7,23 +7,32 @@
 # round (round 4 lost all its numbers to one in-process hang).
 #
 # Exit codes from --drain: 0 = all sections banked (stop); 2 = tunnel
-# down (keep probing indefinitely — outages last hours); 1 = a section
-# failed for a non-tunnel reason (retry a bounded number of times: a
-# flap can kill the last section of a pass and still exit 1, but a
-# DETERMINISTIC failure, e.g. a Mosaic lowering bug, would otherwise
-# re-run the same expensive section every 3 min forever).
+# down or a section hung (keep probing indefinitely — outages last
+# hours); 1 = a section failed crisply for a non-tunnel reason (e.g. a
+# Mosaic lowering bug). Crisp failures are deterministic and cheap, so
+# give up after 5 of them WITHOUT forward progress in between — a pass
+# that banked something new resets the strike count.
 set -o pipefail
 cd /root/repo
 hard_fails=0
+last_banked=-1
 while true; do
   python bench.py --drain >> tpu_watch_r05.log 2>&1
   rc=$?
-  echo "drain exit ${rc} at $(date -u +%H:%M:%S)" >> tpu_watch_r05.log
+  banked=$(python -c "
+import json
+try: print(sum(1 for v in json.load(open('TPU_BANK_r05.json')).values() if v.get('ok')))
+except Exception: print(0)")
+  echo "drain exit ${rc} (banked ${banked}) at $(date -u +%H:%M:%S)" >> tpu_watch_r05.log
   [ "$rc" -eq 0 ] && break
+  if [ "$banked" -gt "$last_banked" ]; then
+    hard_fails=0
+  fi
+  last_banked=$banked
   if [ "$rc" -eq 1 ]; then
     hard_fails=$((hard_fails + 1))
     if [ "$hard_fails" -ge 5 ]; then
-      echo "GIVING UP after ${hard_fails} non-tunnel failures at $(date -u +%H:%M:%S)" >> tpu_watch_r05.log
+      echo "GIVING UP after ${hard_fails} no-progress crisp failures at $(date -u +%H:%M:%S)" >> tpu_watch_r05.log
       exit 1
     fi
   fi
