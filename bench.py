@@ -38,6 +38,7 @@ the 32-node Hadoop reference:
   3.2e7 / KNN_TRAIN queries/sec (~244 q/s), evaluated at the d=8 config.
 """
 
+import contextlib
 import json
 import os
 import sys
@@ -878,6 +879,26 @@ def _run_section(name: str, timeout_s: float):
                   else f"section exited {proc.returncode} with no output")
 
 
+@contextlib.contextmanager
+def _chip_lock():
+    """Exclusive cross-process lock for anything that touches the chip.
+    The background watcher (tools/tpu_watcher.sh) and the driver's
+    round-end bench run must never hit the single chip concurrently —
+    two clients contending through the tunnel is exactly the load
+    pattern that wedges it. Held PER SECTION (not per drain) so a
+    waiting drain blocks for at most one section, and two drains
+    interleave section-by-section instead of serializing wholesale."""
+    import fcntl
+
+    lock = open(BANK_PATH + ".lock", "w")
+    fcntl.flock(lock, fcntl.LOCK_EX)
+    try:
+        yield
+    finally:
+        fcntl.flock(lock, fcntl.LOCK_UN)
+        lock.close()
+
+
 def drain(force: bool = False, only=None, probe_timeout: float = 120.0):
     """Measure every (unbanked, or all when force=True) section, each in
     its own subprocess; persist each success to the bank immediately.
@@ -892,14 +913,15 @@ def drain(force: bool = False, only=None, probe_timeout: float = 120.0):
         prior = bank.get(name, {})
         if prior.get("ok") and not force:
             continue
-        if needs_tpu:
-            if tpu_ok is None:
-                tpu_ok = _backend_reachable(probe_timeout)
-            if not tpu_ok:
-                failures.append((name, "tunnel down at probe"))
-                continue
-        t0 = time.perf_counter()
-        values, err = _run_section(name, timeout_s)
+        with _chip_lock():
+            if needs_tpu:
+                if tpu_ok is None:
+                    tpu_ok = _backend_reachable(probe_timeout)
+                if not tpu_ok:
+                    failures.append((name, "tunnel down at probe"))
+                    continue
+            t0 = time.perf_counter()
+            values, err = _run_section(name, timeout_s)
         if values is not None:
             bank = _load_bank()
             bank[name] = {"ok": True, "ts": round(time.time(), 1),
@@ -922,7 +944,8 @@ def drain(force: bool = False, only=None, probe_timeout: float = 120.0):
 
 def main():
     bank = _load_bank()
-    reachable = _backend_reachable()
+    with _chip_lock():   # don't probe into a watcher section in flight
+        reachable = _backend_reachable()
     if reachable:
         drain(force=True)
         bank = _load_bank()
