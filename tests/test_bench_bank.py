@@ -97,6 +97,8 @@ def _full_bank():
                       "overlap_eff": 0.9, "rss_mb": 1500.0},
         "knn_stream": {"rps": 1e7, "pds": 5e9, "elapsed_s": 90.0,
                        "pallas": True},
+        "knn_stream_csv": {"rps": 7e4, "parse_rps": 7.7e4,
+                           "overlap_eff": 0.9},
         "fused_d8": {"fused_qps": 7e5},
         "fused_d128": {"fused_qps": 7e5},
         "kernel_sweep": {"tail": "PASS"},
