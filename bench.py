@@ -381,7 +381,7 @@ def bench_knn_stream_csv():
     Like the NB CSV section, the rate is HOST-PARSE-BOUND at this host's
     single core; the native parser stripes across cores on a real v5e
     host (csv_ingest.cpp, csv_parse_mt). Returns (train_rows_per_sec,
-    parse_rows_per_sec, overlap_efficiency)."""
+    parse_rows_per_sec, fold_rows_per_sec, overlap_efficiency)."""
     import jax.numpy as jnp
     from avenir_tpu.core.schema import FeatureSchema
     from avenir_tpu.core.stream import iter_csv_chunks, prefetched
@@ -493,7 +493,7 @@ def bench_knn_stream_csv():
     best_i = np.take_along_axis(i_all, order, axis=1)
     assert best_i.shape == (nq, k) and (best_i >= 0).all()
     e2e_rps = rows / dt
-    return e2e_rps, parse_rps, e2e_rps / min(parse_rps, fold_rps)
+    return e2e_rps, parse_rps, fold_rps, e2e_rps / min(parse_rps, fold_rps)
 
 
 def bench_knn(dim: int, mode: str = "both"):
@@ -917,8 +917,8 @@ def _sec_knn_stream():
 
 
 def _sec_knn_stream_csv():
-    rps, parse_rps, overlap_eff = bench_knn_stream_csv()
-    return {"rps": rps, "parse_rps": parse_rps,
+    rps, parse_rps, fold_rps, overlap_eff = bench_knn_stream_csv()
+    return {"rps": rps, "parse_rps": parse_rps, "fold_rps": fold_rps,
             "overlap_eff": overlap_eff}
 
 
@@ -1149,6 +1149,7 @@ def _assemble(bank: dict, live: bool) -> dict:
     knn_stream_pallas = bool(_bv(bank, "knn_stream", "pallas", False))
     knn_csv_rps = _bv(bank, "knn_stream_csv", "rps")
     knn_csv_parse_rps = _bv(bank, "knn_stream_csv", "parse_rps")
+    knn_csv_fold_rps = _bv(bank, "knn_stream_csv", "fold_rps")
     knn_csv_overlap = _bv(bank, "knn_stream_csv", "overlap_eff")
     rf_rls = _bv(bank, "rf", "rls")
     rf_levels = _bv(bank, "rf", "levels")
@@ -1251,14 +1252,19 @@ def _assemble(bank: dict, live: bool) -> dict:
             "proxy, the kernel cost being data-independent)"),
         "knn_stream_csv_rows_per_sec": round(knn_csv_rps, 1),
         "knn_stream_csv_parse_rows_per_sec": round(knn_csv_parse_rps, 1),
+        "knn_stream_csv_fold_rows_per_sec": round(knn_csv_fold_rps, 1),
         "knn_stream_csv_overlap_efficiency": round(knn_csv_overlap, 3),
         "knn_stream_csv_note": (
             f"REAL on-disk end-to-end: {KNN_CSV_ROWS/1e6:.0f}M x 128-float "
             "rows (~"
             f"{KNN_CSV_ROWS*965/1e9:.1f}GB) stream disk -> native parse -> "
             "device top-k fold with prefetch overlap — no rotation proxy; "
-            "HOST-PARSE-BOUND at this host's single core (the native "
-            "parser stripes across cores on a real v5e host)"),
+            "bound by the slower stage (this run: "
+            + ("parse" if not np.isfinite(knn_csv_parse_rps)
+               or not np.isfinite(knn_csv_fold_rps)
+               or knn_csv_parse_rps <= knn_csv_fold_rps else "fold")
+            + "; the native parser stripes across cores on a real v5e "
+            "host — this host has 1)"),
         "nb_stream_csv_rows_per_sec": round(stream_csv_rps, 1),
         "csv_parse_rows_per_sec": round(parse_rps, 1),
         "csv_overlap_efficiency": round(overlap_eff, 3),

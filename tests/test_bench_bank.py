@@ -98,7 +98,7 @@ def _full_bank():
         "knn_stream": {"rps": 1e7, "pds": 5e9, "elapsed_s": 90.0,
                        "pallas": True},
         "knn_stream_csv": {"rps": 7e4, "parse_rps": 7.7e4,
-                           "overlap_eff": 0.9},
+                           "fold_rps": 5e6, "overlap_eff": 0.9},
         "fused_d8": {"fused_qps": 7e5},
         "fused_d128": {"fused_qps": 7e5},
         "kernel_sweep": {"tail": "PASS"},

@@ -34,11 +34,14 @@ def timed(many_fn, *args, repeats=3):
 def run(dim):
     import jax
     import jax.numpy as jnp
-    from avenir_tpu.ops.pallas_knn import knn_topk_lanes, knn_topk_pallas
+    from avenir_tpu.models.knn import _vote
+    from avenir_tpu.ops.pallas_knn import (knn_classify_lanes,
+                                           knn_topk_lanes, knn_topk_pallas)
 
     rng = np.random.default_rng(2)
     q = jnp.asarray(rng.normal(size=(KNN_QUERIES, dim)).astype(np.float32))
     t = jnp.asarray(rng.normal(size=(KNN_TRAIN, dim)).astype(np.float32))
+    labels = jnp.asarray(rng.integers(0, 2, KNN_TRAIN).astype(np.int32))
 
     configs = [
         ("old_packed", knn_topk_pallas, 512, 4096, "float32", {"packed": True}),
@@ -69,6 +72,43 @@ def run(dim):
         qps = KNN_QUERIES * STEPS / dt
         tfs = 2.0 * KNN_QUERIES * KNN_TRAIN * dim * STEPS / dt / 1e12
         print(f"{name} bq={bq} bt={bt} {cdt}: {qps:.3e} q/s  {tfs:.1f} TF/s")
+
+    # fused-vs-composed A/B at the same block configs (VERDICT item: the
+    # fused in-kernel vote must beat topk+XLA-vote on hardware, or its
+    # bench default stays off). Same timing methodology.
+    ab_configs = [(1024, 4096), (512, 4096), (1024, 2048), (512, 8192)]
+    for bq, bt in ab_configs:
+        @jax.jit
+        def composed(q, t, labels):
+            def step(i):
+                qi = jnp.roll(q, i, axis=0)
+                dist, idx = knn_topk_lanes(
+                    qi, t, k=K, block_q=bq, block_t=bt,
+                    metric="euclidean", compute_dtype="bfloat16")
+                scores = _vote(dist, labels[idx], jnp.ones_like(dist),
+                               "gaussian", 30.0, 2, False, False)
+                return jnp.sum(scores).astype(jnp.float32)
+            return jax.lax.map(step, jnp.arange(1, STEPS + 1)).sum()
+
+        @jax.jit
+        def fused(q, t, labels):
+            def step(i):
+                scores = knn_classify_lanes(
+                    jnp.roll(q, i, axis=0), t, labels, k=K, n_classes=2,
+                    kernel_fn="gaussian", kernel_param=30.0, block_q=bq,
+                    block_t=bt, metric="euclidean",
+                    compute_dtype="bfloat16")
+                return jnp.sum(scores)
+            return jax.lax.map(step, jnp.arange(1, STEPS + 1)).sum()
+
+        for label, fn2 in (("composed", composed), ("fused", fused)):
+            try:
+                dt = timed(fn2, q, t, labels)
+                print(f"{label} bq={bq} bt={bt}: "
+                      f"{KNN_QUERIES * STEPS / dt:.3e} classify q/s")
+            except Exception as exc:
+                print(f"{label} bq={bq} bt={bt}: FAILED "
+                      f"{type(exc).__name__}: {str(exc)[:200]}")
 
 
 if __name__ == "__main__":
