@@ -21,6 +21,7 @@ from __future__ import annotations
 
 import os
 import queue
+import re
 import threading
 from typing import Iterable, Iterator, Optional, Tuple, TypeVar
 
@@ -28,6 +29,9 @@ from avenir_tpu.core.dataset import Dataset
 from avenir_tpu.core.schema import FeatureSchema
 
 DEFAULT_BLOCK_BYTES = 64 << 20
+# first non-whitespace byte, located without copying the block the way
+# bytes.strip() would (pattern.search scans the buffer in place)
+_NONWS = re.compile(rb"\S")
 
 T = TypeVar("T")
 
@@ -175,6 +179,7 @@ def iter_byte_blocks(path: str,
     size = os.path.getsize(path)
     start, end = byte_range if byte_range else (0, size)
     end = min(end, size)
+    nonblank = _NONWS.search   # no-copy emptiness check (strip() copies)
     with open(path, "rb") as fh:
         if start > 0:
             fh.seek(start - 1)
@@ -187,11 +192,12 @@ def iter_byte_blocks(path: str,
             if not block:
                 break
             pos += len(block)
-            data = carry + block
             if pos >= end:
                 # finish the line containing byte end-1 (we own every
                 # line starting before `end`), reading past end if its
                 # newline isn't buffered yet
+                data = carry + block if carry else block
+                carry = b""
                 b = len(data) - (pos - end)
                 if b > 0 and data[b - 1:b] == b"\n":
                     cut = b
@@ -205,18 +211,23 @@ def iter_byte_blocks(path: str,
                         data += extra
                         nl = data.find(b"\n", off)
                     cut = (nl + 1) if nl >= 0 else len(data)
-                if data[:cut].strip():
-                    yield data[:cut]
-                carry = b""
+                out = data[:cut]
+                if nonblank(out):
+                    yield out
                 break
-            cut = data.rfind(b"\n")
+            # carry never contains a newline, so the cut within `block`
+            # is the cut within carry+block — splice with ONE copy
+            # (join reads the memoryview; no intermediate slice bytes)
+            cut = block.rfind(b"\n")
             if cut < 0:
-                carry = data
+                carry += block
                 continue
-            carry = data[cut + 1:]
-            if data[:cut].strip():
-                yield data[:cut + 1]
-        if carry.strip():
+            out = (b"".join((carry, memoryview(block)[:cut + 1]))
+                   if carry else block[:cut + 1])
+            carry = block[cut + 1:]
+            if nonblank(out):
+                yield out
+        if carry and nonblank(carry):
             yield carry
 
 
