@@ -75,9 +75,14 @@ class CsvBlockReader:
     def __iter__(self) -> Iterator[Dataset]:
         # one copy of the split-boundary algorithm: the byte blocks come
         # from iter_byte_blocks (same LineRecordReader contract), parsed
-        # against the shared schema
-        for blk in iter_byte_blocks(self.path, self.block_bytes,
-                                    self.byte_range):
+        # against the shared schema. The block read runs in a prefetch
+        # thread so file IO overlaps the native parse (a ctypes call
+        # releases the GIL) on multi-core hosts
+        # depth=1: one block ahead is all the IO/parse overlap needs, and
+        # it caps the raw bytes in flight at ~2 x block_bytes (jobs stack
+        # an outer prefetched() of parsed Datasets on top of this)
+        for blk in prefetched(iter_byte_blocks(self.path, self.block_bytes,
+                                               self.byte_range), depth=1):
             yield self._parse(blk)
 
     def _parse(self, chunk: bytes) -> Dataset:
