@@ -1050,12 +1050,19 @@ def _chip_lock():
         lock.close()
 
 
-def drain(force: bool = False, only=None, probe_timeout: float = 120.0):
+def drain(force: bool = False, only=None, probe_timeout: float = 120.0,
+          budget_s: float = None):
     """Measure every (unbanked, or all when force=True) section, each in
     its own subprocess; persist each success to the bank immediately.
     Failures never clobber an earlier banked success. Returns the list of
-    (name, error) failures this pass."""
+    (name, error) failures this pass.
+
+    budget_s bounds the WHOLE pass: once spent, remaining sections are
+    left as they are in the bank (not marked failed). main() uses this so
+    a driver-side timeout can never kill the bench before it prints its
+    JSON line — earlier-banked values cover whatever didn't refresh."""
     failures = []
+    deadline = None if budget_s is None else time.monotonic() + budget_s
     tpu_ok = None  # probed lazily, re-probed after any TPU-section failure
     for name, _fn, timeout_s, needs_tpu in SECTIONS:
         if only is not None and name not in only:
@@ -1065,6 +1072,15 @@ def drain(force: bool = False, only=None, probe_timeout: float = 120.0):
         if prior.get("ok") and not force:
             continue
         with _chip_lock():
+            # deadline checked INSIDE the lock so a long wait on a
+            # watcher section in flight counts against the budget; the
+            # remaining overrun is bounded by one probe + one section
+            # timeout, so drivers should allow budget + ~eps margin
+            if deadline is not None and time.monotonic() > deadline:
+                # no lookahead: launch while budget remains, so a fast
+                # healthy pass never skips its tail sections
+                print(f"# budget spent: skipping {name}", file=sys.stderr)
+                continue
             if needs_tpu:
                 if tpu_ok is None:
                     tpu_ok = _backend_reachable(probe_timeout)
@@ -1098,7 +1114,14 @@ def main():
     with _chip_lock():   # don't probe into a watcher section in flight
         reachable = _backend_reachable()
     if reachable:
-        drain(force=True)
+        # the budget keeps the whole run's wall clock bounded (a driver
+        # timeout that killed this process would lose the JSON line);
+        # sections that don't fit keep their earlier banked values
+        try:
+            budget_s = float(os.environ.get("AVENIR_BENCH_BUDGET_S", 5400))
+        except ValueError:   # malformed env var must not lose the line
+            budget_s = 5400.0
+        drain(force=True, budget_s=budget_s)
         bank = _load_bank()
     banked_ok = [n for n, _f, _t, _n in SECTIONS
                  if bank.get(n, {}).get("ok")]

@@ -142,6 +142,18 @@ def test_assemble_missing_optional_sections_null_not_crash():
     json.dumps(out)
 
 
+def test_drain_budget_skips_without_marking_failed(bank_path, monkeypatch):
+    bench._save_bank({"nb": {"ok": True, "ts": 1.0,
+                             "values": {"nb_rps": 7.0}}})
+    monkeypatch.setattr(bench, "_backend_reachable", lambda *a: True)
+    monkeypatch.setattr(bench, "_run_section", lambda name, t: ({}, None))
+    # an already-spent budget skips every section silently: nothing runs,
+    # nothing is marked failed, banked values survive
+    failures = bench.drain(force=True, budget_s=-1.0)
+    assert failures == []
+    assert bench._load_bank()["nb"]["values"]["nb_rps"] == 7.0
+
+
 def test_fused_section_fails_on_nonfinite_rate():
     # bench_knn turns a fused-kernel exception into NaN (so a combined
     # run survives); the bank section must turn that NaN back into a
