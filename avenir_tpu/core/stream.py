@@ -148,6 +148,16 @@ def prefetched(items: Iterable[T], depth: int = 2) -> Iterator[T]:
         cancel.set()
 
 
+def double_buffered(items: Iterable[T]) -> Iterator[T]:
+    """Depth-1 prefetch: host production of block k+1 overlaps consumption
+    (device counting) of block k, and at most ONE finished block waits in
+    the queue — the bounded-RSS flavor of prefetched() the multi-pass
+    miners put between chunk encode/pack and the device support fold.
+    Stacks safely on the inner byte-block prefetch: the pipeline then
+    holds one block being read, one being encoded, one being counted."""
+    return prefetched(items, depth=1)
+
+
 def stream_job_inputs(cfg, inputs: Iterable[str], schema: FeatureSchema,
                       keep_raw: bool = False) -> Iterator[Dataset]:
     """Per-job streaming input helper: prefetched block chunks of every

@@ -117,9 +117,13 @@ class StreamingTransactionSource:
     (FrequentItemsApriori.java:123-126) — so streaming means each k-pass
     re-scans the file at O(block) host RSS instead of holding the [N, V]
     multi-hot matrix. Pass 1 (scan_items) freezes the item vocabulary and
-    per-item supports; chunks() then yields fixed-row-count multi-hot
-    blocks encoded against that frozen vocabulary, zero-padded so the
-    device counting kernel compiles once."""
+    per-item supports — natively when the C encoder is built, so no
+    per-row Python runs even on the discovery pass. After the k=1 round
+    the miner installs the frequent-item mask (mask_items — the ingest
+    form of the reference's InfrequentItemMarker), and packed_chunks()
+    then yields uint32 BITSET blocks over the frequent vocabulary only:
+    V shrinks to the surviving items and each block is ~8x smaller than
+    the uint8 multi-hot it replaces."""
 
     def __init__(self, paths: Sequence[str], delim: str = ",",
                  trans_id_ord: int = 0, skip_field_count: int = 1,
@@ -135,93 +139,180 @@ class StreamingTransactionSource:
         self.index: Dict[str, int] = {}
         self.n_trans = 0
         self._item_counts: Optional[np.ndarray] = None
+        self._kept_ids: Optional[np.ndarray] = None   # orig ids, ascending
+        self._remap: Optional[np.ndarray] = None      # orig id -> masked|-1
 
     def _row_blocks(self):
         from avenir_tpu.core.stream import iter_line_blocks, prefetched
 
         for path in self.paths:
             for lines in prefetched(
-                    iter_line_blocks(path, self.block_bytes)):
+                    iter_line_blocks(path, self.block_bytes), depth=1):
                 # trim set matches the native seq_encode trim exactly
                 # (space/tab/CR): the vocab pass and the native counting
                 # pass must agree on token identity
                 yield [[t.strip(" \t\r") for t in ln.split(self.delim)]
                        for ln in lines]
 
+    # ------------------------------------------------------------ pass 1
     def scan_items(self) -> Tuple[List[str], np.ndarray, int]:
         """Pass 1: (vocab, per-item transaction counts, n_trans). An item
         repeated within one transaction counts once (multi-hot algebra)."""
         if self._item_counts is not None:
             return self.vocab, self._item_counts, self.n_trans
+        from avenir_tpu.native.ingest import native_seq_ready
+
         counts: List[int] = []
-        for rows in self._row_blocks():
-            for row in rows:
-                self.n_trans += 1
-                seen = set()
-                for tok in row[self.skip:]:
-                    if tok == "" or tok == self.marker:
-                        continue
-                    i = self.index.get(tok)
-                    if i is None:
-                        i = len(self.vocab)
-                        self.index[tok] = i
-                        self.vocab.append(tok)
-                        counts.append(0)
-                    seen.add(i)
-                for i in seen:
-                    counts[i] += 1
-        self._item_counts = np.asarray(counts, np.int64)
+        if native_seq_ready(self.delim):
+            self._item_counts = self._scan_items_native()
+        else:
+            for rows in self._row_blocks():
+                for row in rows:
+                    self.n_trans += 1
+                    seen = set()
+                    for tok in row[self.skip:]:
+                        if tok == "" or tok == self.marker:
+                            continue
+                        i = self.index.get(tok)
+                        if i is None:
+                            i = len(self.vocab)
+                            self.index[tok] = i
+                            self.vocab.append(tok)
+                            counts.append(0)
+                        seen.add(i)
+                    for i in seen:
+                        counts[i] += 1
+            self._item_counts = np.asarray(counts, np.int64)
         return self.vocab, self._item_counts, self.n_trans
 
-    def chunks(self, block_rows: int = 8192, with_ids: bool = False):
-        """Yield (multihot uint8 [block_rows, V], ids) blocks; zero-pad
-        row tails (an all-zero row contains no k>=1 candidate, so it
-        never counts). The counting passes (no ids needed) ride the
-        native ragged encoder when built — no per-row Python exists on
-        the N-proportional path."""
-        from avenir_tpu.native.ingest import (csr_rows, native_seq_ready,
+    def _scan_items_native(self) -> np.ndarray:
+        """Vocabulary discovery + k=1 support counts at native speed:
+        the shared scan_encode_blocks engine (vocabulary-stable blocks
+        never touch per-row Python) + deduped (transaction, item) counts
+        in numpy."""
+        from avenir_tpu.native.ingest import (csr_rows,
+                                              distinct_row_code_counts,
+                                              scan_encode_blocks)
+
+        counts = np.zeros(0, np.int64)
+        for codes, offsets, region, n in scan_encode_blocks(
+                self.paths, self.delim, self.skip, self.vocab, self.index,
+                self.block_bytes, marker=self.marker):
+            v = len(self.vocab)
+            if counts.shape[0] < v:
+                counts = np.concatenate(
+                    [counts, np.zeros(v - counts.shape[0], np.int64)])
+            row_of, _ = csr_rows(offsets)
+            counts += distinct_row_code_counts(row_of, codes, region, v)
+            self.n_trans += n
+        return counts
+
+    # ----------------------------------------------------- frequent mask
+    def mask_items(self, keep_ids: Sequence[int]) -> int:
+        """Install the frequent-item vocabulary mask (the ingest analog of
+        InfrequentItemMarker.java:41-46): packed_chunks() thereafter
+        encodes over ONLY these items, in masked id space 0..len(keep)-1
+        (ascending original order, so sorted tuples stay sorted). Returns
+        the masked vocabulary width."""
+        kept = np.asarray(sorted(keep_ids), np.int32)
+        remap = np.full(max(len(self.vocab), 1), -1, np.int32)
+        remap[kept] = np.arange(kept.shape[0], dtype=np.int32)
+        self._kept_ids, self._remap = kept, remap
+        return int(kept.shape[0])
+
+    @property
+    def masked_width(self) -> int:
+        return (len(self.vocab) if self._kept_ids is None
+                else int(self._kept_ids.shape[0]))
+
+    def masked_token(self, masked_id: int) -> str:
+        """Token for a masked item id (identity when no mask installed)."""
+        if self._kept_ids is None:
+            return self.vocab[masked_id]
+        return self.vocab[int(self._kept_ids[masked_id])]
+
+    def _apply_mask(self, r: np.ndarray, c: np.ndarray):
+        if self._remap is None:
+            return r, c
+        m = self._remap[c]
+        ok = m >= 0
+        return r[ok], m[ok]
+
+    # ------------------------------------------------------- chunk feeds
+    def packed_chunks(self, block_rows: int = 8192):
+        """Yield uint32 bitset blocks [block_rows, words(V_masked)] over
+        the (masked) vocabulary; row tails zero-pad (an all-zero row
+        contains no nonempty candidate, so it never counts). Rides the
+        native ragged encoder when built — no per-row Python on the
+        N-proportional path; the Python fallback packs the same blocks
+        from split rows."""
+        from avenir_tpu.ops.bitset import pack_rows_u32
+
+        for mh in self._dense_chunks(block_rows):
+            yield pack_rows_u32(mh)
+
+    def _dense_chunks(self, block_rows: int):
+        """uint8 [block_rows, V_masked] multi-hot blocks (mask applied)."""
+        from avenir_tpu.native.ingest import (csr_region_mask, csr_rows,
+                                              native_seq_ready,
                                               seq_encode_native)
 
-        V = max(len(self.vocab), 1)
-        if not with_ids and native_seq_ready(self.delim):
+        vm = max(self.masked_width, 1)
+        if native_seq_ready(self.delim):
             from avenir_tpu.core.stream import iter_byte_blocks, prefetched
 
             for path in self.paths:
                 for data in prefetched(
-                        iter_byte_blocks(path, self.block_bytes)):
+                        iter_byte_blocks(path, self.block_bytes), depth=1):
                     # cannot be None: availability + 1-byte delim checked
                     codes, offsets = seq_encode_native(
                         data, self.delim, self.vocab)
                     n = offsets.shape[0] - 1
                     if n <= 0:
                         continue
-                    row_of, starts = csr_rows(offsets)
-                    idx = np.arange(codes.shape[0])
                     # item region only; unknown tokens (-1: ids, marker,
                     # empties) drop exactly like the python path
-                    valid = (idx >= starts[row_of] + self.skip) & (codes >= 0)
-                    r, c = row_of[valid], codes[valid]
+                    valid = csr_region_mask(offsets, self.skip,
+                                            codes.shape[0])
+                    np.logical_and(valid, codes >= 0, out=valid)
+                    row_of, _ = csr_rows(offsets)
+                    r, c = self._apply_mask(row_of[valid], codes[valid])
                     # r is sorted (row_of nondecreasing): each page is a
                     # searchsorted slice, not a full-array rescan
                     bounds = np.searchsorted(
                         r, np.arange(0, n + block_rows, block_rows))
                     for page, (lo, hi) in enumerate(
                             zip(bounds[:-1], bounds[1:])):
-                        mh = np.zeros((block_rows, V), np.uint8)
+                        mh = np.zeros((block_rows, vm), np.uint8)
                         mh[r[lo:hi] - page * block_rows, c[lo:hi]] = 1
-                        yield mh, []
+                        yield mh
             return
 
+        for mh, _ids in self.chunks(block_rows):
+            yield mh
+
+    def chunks(self, block_rows: int = 8192, with_ids: bool = False):
+        """Yield (multihot uint8 [block_rows, V_masked], ids) blocks from
+        the Python row path — the id-bearing feed (the exact-trans-id
+        pass needs per-row ids, which the native CSR encode drops) and
+        the no-compiler fallback behind _dense_chunks."""
+        vm = max(self.masked_width, 1)
+
         def emit(rows):
-            mh = np.zeros((block_rows, V), np.uint8)
+            mh = np.zeros((block_rows, vm), np.uint8)
             ids = []
             for r, row in enumerate(rows):
                 if with_ids:
                     ids.append(row[self.trans_id_ord])
                 for tok in row[self.skip:]:
                     i = self.index.get(tok)
-                    if i is not None:
-                        mh[r, i] = 1
+                    if i is None:
+                        continue
+                    if self._remap is not None:
+                        i = int(self._remap[i])
+                        if i < 0:
+                            continue
+                    mh[r, i] = 1
             return mh, ids
 
         buf: List[List[str]] = []
@@ -232,7 +323,6 @@ class StreamingTransactionSource:
                 buf = buf[block_rows:]
         if buf:
             yield emit(buf)
-
 
 # --------------------------------------------------------------------------
 # Itemset containers (the between-rounds file state)
@@ -428,65 +518,100 @@ class FrequentItemsApriori:
                     ) -> List[ItemSetList]:
         """mine() at unbounded input size: one streamed scan per itemset
         length k (the reference's one-MR-job-per-k driver loop,
-        FrequentItemsApriori.java:123-126), support counts folded across
-        fixed-shape multi-hot blocks so host RSS stays O(block)."""
+        FrequentItemsApriori.java:123-126).
+
+        The N-proportional counting is a blocked BIT-PACKED device fold:
+        after the k=1 pass the frequent-item mask shrinks the vocabulary
+        (InfrequentItemMarker at ingest), chunks arrive as uint32 bitsets
+        (~8x less block RSS than uint8 multi-hot), and the popcount
+        containment kernel takes candidates of any length — one compiled
+        executable serves every round, and the exact-transaction-id pass
+        runs ONCE over the kept sets of ALL lengths fused into a single
+        candidate matrix instead of one streamed scan per k. Chunk
+        encode/pack double-buffers against the device fold."""
+        from avenir_tpu.core.stream import double_buffered
+        from avenir_tpu.ops.bitset import (bitset_contain_counts,
+                                           pack_index_rows_u32)
+
         vocab, col_counts, n = src.scan_items()
         min_count = self.support_threshold * n
-        out: List[ItemSetList] = []
 
-        freq_ids: List[Tuple[int, ...]] = [
-            (i,) for i in range(len(vocab)) if col_counts[i] > min_count
-        ]
-        out.append(self._pack_stream(
-            src, freq_ids, 1, [int(col_counts[i]) for (i,) in freq_ids]))
+        # k = 1 from the scan; install the frequent-item mask so every
+        # later block encodes over the surviving vocabulary only.
+        # Masked ids are ranks of the ascending original ids, so sorted
+        # candidate tuples stay sorted under the remap.
+        freq1 = [i for i in range(len(vocab)) if col_counts[i] > min_count]
+        vm = src.mask_items(freq1)
+        rounds: List[Tuple[int, List[Tuple[int, ...]], List[int]]] = [
+            (1, [(m,) for m in range(vm)],
+             [int(col_counts[i]) for i in freq1])]
 
+        freq_ids: List[Tuple[int, ...]] = rounds[0][1]
         for k in range(2, self.max_length + 1):
             cands = _generate_candidates(freq_ids, k)
             if not cands:
                 break
+            # pad the candidate axis to a bucket size so recurring rounds
+            # reuse the compiled executable; zero candidate rows count 0
             c_pad = max(64, 1 << (len(cands) - 1).bit_length())
-            cand_rows = np.zeros((c_pad, max(len(vocab), 1)), np.float32)
-            for ci, items in enumerate(cands):
-                cand_rows[ci, list(items)] = 1.0
-            cand_d = jnp.asarray(cand_rows)
+            cand_d = jnp.asarray(pack_index_rows_u32(cands, vm, c_pad))
             counts = np.zeros(c_pad, np.int64)
-            for mh, _ in src.chunks(self.block):
-                counts += np.asarray(_contain_counts(
-                    jnp.asarray(mh, dtype=jnp.float32), cand_d, k), np.int64)
+            for packed in double_buffered(src.packed_chunks(self.block)):
+                counts += np.asarray(
+                    bitset_contain_counts(jnp.asarray(packed), cand_d),
+                    np.int64)
             kept = [(c, int(cnt)) for c, cnt in zip(cands, counts[:len(cands)])
                     if cnt > min_count]
             if not kept:
                 break
             freq_ids = [c for c, _ in kept]
+            rounds.append((k, freq_ids, [cnt for _, cnt in kept]))
+
+        tids = self._collect_trans_ids(src, rounds) \
+            if self.emit_trans_id else None
+        out: List[ItemSetList] = []
+        at = 0
+        for k, ids_k, counts_k in rounds:
             out.append(self._pack_stream(
-                src, freq_ids, k, [cnt for _, cnt in kept]))
+                src, ids_k, k, counts_k,
+                tids[at:at + len(ids_k)] if tids is not None else None))
+            at += len(ids_k)
         return out
+
+    def _collect_trans_ids(self, src: StreamingTransactionSource,
+                           rounds) -> List[List[str]]:
+        """ONE extra streamed pass for fia.emit.trans.id: the kept sets of
+        every length fuse into a single packed candidate matrix (the
+        popcount kernel needs no per-length dispatch), so exact per-set
+        transaction id lists cost one scan total, not one per k."""
+        from avenir_tpu.ops.bitset import (bitset_contain_mask,
+                                           pack_index_rows_u32, pack_rows_u32)
+
+        all_sets = [ids_t for _k, ids_k, _c in rounds for ids_t in ids_k]
+        if not all_sets:
+            return []
+        vm = src.masked_width
+        c_pad = max(64, 1 << (len(all_sets) - 1).bit_length())
+        cand_d = jnp.asarray(pack_index_rows_u32(all_sets, vm, c_pad))
+        tids: List[List[str]] = [[] for _ in all_sets]
+        for mh, ids in src.chunks(self.block, with_ids=True):
+            m = np.asarray(bitset_contain_mask(
+                jnp.asarray(pack_rows_u32(mh)), cand_d))
+            for ci in range(len(all_sets)):
+                for r in np.flatnonzero(m[:len(ids), ci]):
+                    tids[ci].append(str(ids[r]))
+        return tids
 
     def _pack_stream(self, src: StreamingTransactionSource,
                      freq_ids: List[Tuple[int, ...]], k: int,
-                     counts: List[int]) -> ItemSetList:
+                     counts: List[int],
+                     tids: Optional[List[List[str]]] = None) -> ItemSetList:
         if not freq_ids:
             return ItemSetList(k, [])
         n = src.n_trans
-        tids: Optional[List[List[str]]] = None
-        if self.emit_trans_id:
-            # one extra streamed pass over the KEPT sets only: exact
-            # per-set transaction id lists (fia.emit.trans.id)
-            c_pad = max(64, 1 << (len(freq_ids) - 1).bit_length())
-            cand_rows = np.zeros((c_pad, max(len(src.vocab), 1)), np.float32)
-            for ci, items in enumerate(freq_ids):
-                cand_rows[ci, list(items)] = 1.0
-            cand_d = jnp.asarray(cand_rows)
-            tids = [[] for _ in freq_ids]
-            for mh, ids in src.chunks(self.block, with_ids=True):
-                m = np.asarray(_contain_mask(
-                    jnp.asarray(mh, dtype=jnp.float32), cand_d, k))
-                for ci in range(len(freq_ids)):
-                    for r in np.flatnonzero(m[:len(ids), ci]):
-                        tids[ci].append(str(ids[r]))
         sets = []
         for ci, ids_t in enumerate(freq_ids):
-            tokens = tuple(sorted(src.vocab[i] for i in ids_t))
+            tokens = tuple(sorted(src.masked_token(i) for i in ids_t))
             sets.append(ItemSet(tokens, counts[ci] / n, int(counts[ci]),
                                 tids[ci] if tids is not None else None))
         sets.sort(key=lambda s: s.items)

@@ -23,7 +23,6 @@ compiled pass with [N, C] state.
 from __future__ import annotations
 
 from dataclasses import dataclass
-from functools import partial
 from typing import Dict, Iterable, List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -76,26 +75,34 @@ def generate_sequence_candidates(frequent: Iterable[Sequence[str]]
     return sorted(out)
 
 
-@partial(jax.jit, static_argnames=("k",))
-def _subseq_support_kernel(rows: jnp.ndarray, lengths: jnp.ndarray,
-                           cands: jnp.ndarray, k: int):
+@jax.jit
+def _subseq_support_kernel(rows: jnp.ndarray, cands: jnp.ndarray,
+                           k_vec: jnp.ndarray):
     """counts[c] = #rows containing candidate c as an order-preserving
     (not necessarily contiguous) subsequence.
 
-    rows int32 [N, T] padded with -1, cands int32 [C, k]. One scan over the
-    T time steps advances ptr[n, c] (next candidate position to match);
-    a row supports the candidate when its pointer reaches k."""
+    rows int32 [N, T] padded with -1, cands int32 [C, k_max] padded with
+    -2, k_vec int32 [C] the per-candidate length. One scan over the T
+    time steps advances ptr[n, c] (next candidate position to match); a
+    row supports the candidate when its pointer reaches k_vec[c]. The
+    candidate length rides as DATA, not a static argument, so one
+    compiled executable serves every mining round (per block-shape
+    bucket) instead of recompiling per k — and candidates of mixed
+    lengths can share a call. Zero-length rows (k_vec 0: shape padding)
+    never count."""
     n, t = rows.shape
-    c = cands.shape[0]
+    c, k_max = cands.shape
 
     def step(ptr, tok):                      # ptr [N, C], tok [N]
         expect = cands[jnp.arange(c)[None, :],
-                       jnp.clip(ptr, 0, k - 1)]          # [N, C]
-        hit = (tok[:, None] == expect) & (ptr < k) & (tok[:, None] >= 0)
+                       jnp.clip(ptr, 0, k_max - 1)]      # [N, C]
+        hit = ((tok[:, None] == expect) & (ptr < k_vec[None, :])
+               & (tok[:, None] >= 0))
         return ptr + hit.astype(jnp.int32), None
 
     ptr, _ = jax.lax.scan(step, jnp.zeros((n, c), jnp.int32), rows.T)
-    return jnp.sum(ptr >= k, axis=0, dtype=jnp.int32)
+    return jnp.sum((ptr >= k_vec[None, :]) & (k_vec > 0)[None, :],
+                   axis=0, dtype=jnp.int32)
 
 
 @dataclass
@@ -155,18 +162,55 @@ class StreamingSequenceSource:
         self.n_rows = 0
         self.t_max = 1
         self._item_counts: Optional[np.ndarray] = None
+        self._kept_ids: Optional[np.ndarray] = None   # orig ids, ascending
+        self._remap: Optional[np.ndarray] = None      # orig id -> masked|-1
 
     def _line_blocks(self):
         from avenir_tpu.core.stream import iter_line_blocks, prefetched
 
         for path in self.paths:
             yield from prefetched(
-                iter_line_blocks(path, self.block_bytes))
+                iter_line_blocks(path, self.block_bytes), depth=1)
+
+    # ----------------------------------------------------- frequent mask
+    def mask_tokens(self, keep_ids: Sequence[int]) -> int:
+        """Install the frequent-token mask after the k=1 scan: chunks()
+        thereafter DROPS infrequent tokens and compacts each sequence
+        (sound for GSP — every element of a frequent sequence is itself a
+        frequent 1-sequence, so no candidate can require a dropped
+        token), shrinking both the vocabulary and the time axis the
+        support scan walks. Masked ids are ranks of the ascending
+        original ids. Returns the masked vocabulary size."""
+        kept = np.asarray(sorted(keep_ids), np.int32)
+        remap = np.full(max(len(self.vocab), 1), -1, np.int32)
+        remap[kept] = np.arange(kept.shape[0], dtype=np.int32)
+        self._kept_ids, self._remap = kept, remap
+        return int(kept.shape[0])
+
+    def token_code(self, tok: str) -> int:
+        """Candidate-encoding lookup in the chunks() id space (masked when
+        a mask is installed); -2 never matches any token."""
+        i = self.index.get(tok)
+        if i is None:
+            return -2
+        if self._remap is not None:
+            i = int(self._remap[i])
+            if i < 0:
+                return -2
+        return i
 
     def scan(self) -> Tuple[List[str], np.ndarray, int]:
         """Pass 1: (vocab, per-token row-presence counts, n_rows) — the
-        k=1 support counts; also records t_max for fixed-shape chunks."""
+        k=1 support counts; also records t_max for fixed-shape chunks.
+        Rides the native encoder when built (vocabulary-stable blocks
+        never touch per-row Python, same discovery scheme as the
+        association source)."""
+        from avenir_tpu.native.ingest import native_seq_ready
+
         if self._item_counts is not None:
+            return self.vocab, self._item_counts, self.n_rows
+        if native_seq_ready(self.delim):
+            self._item_counts = self._scan_native()
             return self.vocab, self._item_counts, self.n_rows
         counts: List[int] = []
         for lines in self._line_blocks():
@@ -190,6 +234,31 @@ class StreamingSequenceSource:
         self._item_counts = np.asarray(counts, np.int64)
         return self.vocab, self._item_counts, self.n_rows
 
+    def _scan_native(self) -> np.ndarray:
+        """Vocabulary discovery + k=1 row-presence counts + t_max at
+        native speed: the shared scan_encode_blocks engine + deduped
+        (row, token) counts, plus the per-row valid-token maximum for
+        t_max (fixed-shape chunk sizing)."""
+        from avenir_tpu.native.ingest import (csr_rows,
+                                              distinct_row_code_counts,
+                                              scan_encode_blocks)
+
+        counts = np.zeros(0, np.int64)
+        for codes, offsets, region, n in scan_encode_blocks(
+                self.paths, self.delim, self.skip, self.vocab, self.index,
+                self.block_bytes):
+            v = len(self.vocab)
+            if counts.shape[0] < v:
+                counts = np.concatenate(
+                    [counts, np.zeros(v - counts.shape[0], np.int64)])
+            row_of, _ = csr_rows(offsets)
+            per_row = np.bincount(row_of[region].astype(np.intp),
+                                  minlength=n)
+            self.t_max = max(self.t_max, int(per_row.max(initial=0)))
+            counts += distinct_row_code_counts(row_of, codes, region, v)
+            self.n_rows += n
+        return counts
+
     def chunks(self, block_rows: int = 65536):
         """Yield padded int32 [rows_bucket, t_bucket] blocks (pad -1;
         all-pad rows support no candidate, so padding never counts).
@@ -198,7 +267,8 @@ class StreamingSequenceSource:
         padding everything to global maxima: one anomalously long input
         line must not inflate every block (O(block) RSS is the point of
         this class), and bucketing keeps recompiles logarithmic."""
-        from avenir_tpu.native.ingest import (csr_rows, native_seq_ready,
+        from avenir_tpu.native.ingest import (csr_region_mask, csr_rows,
+                                              native_seq_ready,
                                               seq_encode_native)
 
         def bucket(x: int, lo: int) -> int:
@@ -209,22 +279,28 @@ class StreamingSequenceSource:
 
             for path in self.paths:
                 for data in prefetched(
-                        iter_byte_blocks(path, self.block_bytes)):
+                        iter_byte_blocks(path, self.block_bytes), depth=1):
                     codes, offsets = seq_encode_native(
                         data, self.delim, self.vocab)
                     n = offsets.shape[0] - 1
                     if n <= 0:
                         continue
-                    row_of, starts = csr_rows(offsets)
-                    idx = np.arange(codes.shape[0])
                     # sequence region, empty/meta tokens dropped like the
                     # python path (ids can collide with item tokens only
                     # at positions < skip, which this mask excludes)
-                    valid = ((idx >= starts[row_of] + self.skip)
-                             & (codes >= 0))
+                    valid = csr_region_mask(offsets, self.skip,
+                                            codes.shape[0])
+                    np.logical_and(valid, codes >= 0, out=valid)
+                    if self._remap is not None:
+                        # frequent-token mask: infrequent tokens drop and
+                        # positions compact (pos derives from survivors)
+                        codes = np.where(valid, self._remap[
+                            np.clip(codes, 0, None)], -1)
+                        np.logical_and(valid, codes >= 0, out=valid)
+                    row_of, _ = csr_rows(offsets)
                     order = np.flatnonzero(valid)
                     rows_v = row_of[order]
-                    pos = (np.arange(order.shape[0])
+                    pos = (np.arange(order.shape[0], dtype=np.int64)
                            - np.searchsorted(rows_v, rows_v))
                     enc = codes[order]
                     bounds = np.searchsorted(
@@ -254,7 +330,11 @@ class StreamingSequenceSource:
             for ln in lines:
                 toks = [t.strip(" \t\r")
                         for t in ln.split(self.delim)][self.skip:]
-                buf.append([self.index[t] for t in toks if t != ""])
+                enc = [self.index[t] for t in toks if t != ""]
+                if self._remap is not None:
+                    enc = [m for m in
+                           (int(self._remap[i]) for i in enc) if m >= 0]
+                buf.append(enc)
                 if len(buf) >= block_rows:
                     yield emit(buf)
                     buf = []
@@ -275,16 +355,32 @@ class GSPMiner:
         self.max_length = max_length
         self.block = block
 
-    def _count(self, ss: SequenceSet, cands: List[Tuple[str, ...]], k: int
+    @staticmethod
+    def _cand_arrays(cands: List[Tuple[str, ...]], code_of, c_pad: int
+                     ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+        """Packed (cands int32 [c_pad, k_bucket], k_vec int32 [c_pad]):
+        mixed-length candidate rows padded with the -2 never-matches
+        sentinel, zero-length pad rows never counted. The length axis
+        quantizes to a pow2 bucket so successive mining rounds hit the
+        same compiled shape."""
+        k_max = max((len(cd) for cd in cands), default=1)
+        k_max = max(4, 1 << (k_max - 1).bit_length())
+        arr = np.full((c_pad, k_max), -2, np.int32)
+        kv = np.zeros(c_pad, np.int32)
+        for ci, cd in enumerate(cands):
+            arr[ci, :len(cd)] = [code_of(tok) for tok in cd]
+            kv[ci] = len(cd)
+        return jnp.asarray(arr), jnp.asarray(kv)
+
+    def _count(self, ss: SequenceSet, cands: List[Tuple[str, ...]]
                ) -> np.ndarray:
-        cand_arr = np.array(
-            [[ss.index.get(tok, -2) for tok in cd] for cd in cands], np.int32)
+        cand_d, kv = self._cand_arrays(
+            cands, lambda tok: ss.index.get(tok, -2), len(cands))
         counts = np.zeros(len(cands), np.int64)
         for s in range(0, len(ss), self.block):
             counts += np.asarray(_subseq_support_kernel(
                 jnp.asarray(ss.rows[s:s + self.block]),
-                jnp.asarray(ss.lengths[s:s + self.block]),
-                jnp.asarray(cand_arr), k), dtype=np.int64)
+                cand_d, kv), dtype=np.int64)
         return counts
 
     def mine(self, ss: SequenceSet) -> Dict[int, Dict[Tuple[str, ...], float]]:
@@ -293,7 +389,7 @@ class GSPMiner:
         out: Dict[int, Dict[Tuple[str, ...], float]] = {}
 
         cands1 = [(tok,) for tok in ss.vocab]
-        counts = self._count(ss, cands1, 1)
+        counts = self._count(ss, cands1)
         freq = {c: cnt / n for c, cnt in zip(cands1, counts)
                 if cnt > min_count}
         out[1] = freq
@@ -302,7 +398,7 @@ class GSPMiner:
             cands = generate_sequence_candidates(list(freq))
             if not cands:
                 break
-            counts = self._count(ss, cands, k)
+            counts = self._count(ss, cands)
             freq = {c: cnt / n for c, cnt in zip(cands, counts)
                     if cnt > min_count}
             if not freq:
@@ -315,32 +411,36 @@ class GSPMiner:
         """mine() at unbounded input size: one streamed scan per sequence
         length k (the reference's one-MR-job-per-k driver), candidate
         support folded across fixed-shape padded blocks so host RSS stays
-        O(block)."""
+        O(block). After the k=1 scan the frequent-token mask drops
+        infrequent tokens at ingest (shrinking the time axis every later
+        support scan walks), the candidate length rides as data so one
+        compiled executable serves all rounds, and block encode
+        double-buffers against the device fold."""
+        from avenir_tpu.core.stream import double_buffered
+
         vocab, counts1, n = src.scan()
         min_count = self.support_threshold * n
         out: Dict[int, Dict[Tuple[str, ...], float]] = {}
         freq = {(tok,): cnt / n for tok, cnt in zip(vocab, counts1)
                 if cnt > min_count}
         out[1] = freq
+        src.mask_tokens([src.index[tok] for (tok,) in freq])
 
         for k in range(2, self.max_length + 1):
             cands = generate_sequence_candidates(list(freq))
             if not cands:
                 break
             # candidate axis padded to a pow2 bucket (executable reuse);
-            # the -2 sentinel never matches any token, so pad rows count 0
-            c_pad = max(64, 1 << (len(cands) - 1).bit_length())
-            cand_pad = np.full((c_pad, k), -2, np.int32)
-            cand_pad[: len(cands)] = np.array(
-                [[src.index.get(t, -2) for t in cd] for cd in cands],
-                np.int32)
+            # the -2 sentinel never matches any token, so pad rows count 0.
+            # Floor 16, not 64: the scan kernel carries [block, C] pointer
+            # state through every time step, so a small round's padding
+            # multiplies real work (unlike the bitset matmul's free lanes)
+            c_pad = max(16, 1 << (len(cands) - 1).bit_length())
+            cand_d, kv = self._cand_arrays(cands, src.token_code, c_pad)
             counts = np.zeros(c_pad, np.int64)
-            cand_d = jnp.asarray(cand_pad)
-            for blk in src.chunks(self.block):
+            for blk in double_buffered(src.chunks(self.block)):
                 counts += np.asarray(_subseq_support_kernel(
-                    jnp.asarray(blk),
-                    jnp.zeros(blk.shape[0], jnp.int32), cand_d, k),
-                    dtype=np.int64)
+                    jnp.asarray(blk), cand_d, kv), dtype=np.int64)
             freq = {c: cnt / n
                     for c, cnt in zip(cands, counts[: len(cands)])
                     if cnt > min_count}

@@ -230,9 +230,95 @@ def native_seq_ready(delim: str) -> bool:
 def csr_rows(offsets: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
     """(row_of [total_tokens], starts [n_rows]) for a CSR offsets array —
     the shared row-decode of every seq_encode consumer (markov fit_csr,
-    HMM add_csr, apriori counting chunks)."""
-    return (np.repeat(np.arange(offsets.shape[0] - 1), np.diff(offsets)),
+    HMM add_csr, apriori counting chunks). row_of is int32: a block
+    never holds 2^31 rows (blocks are tens of MB), and the token-
+    proportional arrays dominate a streaming pass's transient RSS, so
+    halving them matters at scale."""
+    return (np.repeat(np.arange(offsets.shape[0] - 1, dtype=np.int32),
+                      np.diff(offsets)),
             offsets[:-1])
+
+
+def csr_region_mask(offsets: np.ndarray, skip: int, n_tokens: int
+                    ) -> np.ndarray:
+    """bool [n_tokens]: True where a token sits at within-row position
+    >= skip (the item/sequence region past the meta fields). Built by
+    unmarking the first `skip` positions of each row — O(rows * skip)
+    small arrays instead of the arange(n_tokens) + starts[row_of]
+    int64 temporaries the naive position compare materializes (those
+    were the largest transients of the miners' streaming passes)."""
+    region = np.ones(n_tokens, bool)
+    starts, ends = offsets[:-1], offsets[1:]
+    for j in range(skip):
+        pos = starts + j
+        region[pos[pos < ends]] = False
+    return region
+
+
+def scan_encode_blocks(paths, delim: str, skip: int, vocab: List[str],
+                       index: Dict[str, int], block_bytes: int,
+                       marker: Optional[str] = None):
+    """Vocabulary-DISCOVERING native scan: yield (codes, offsets, region,
+    n_rows) per byte block — the shared pass-1 engine of the streaming
+    miners (association scan_items, sequence scan).
+
+    Each block encodes against the CURRENT vocab plus two drop
+    sentinels (the infrequent-item marker and the empty token, which
+    would otherwise read as unknown and force the slow path on every
+    block of a trailing-delimiter CSV). A block with genuinely unknown
+    tokens takes one Python pass to extend `vocab`/`index` in place,
+    then re-encodes — but only if that pass actually added something;
+    steady-state blocks of a vocabulary-stable stream never touch
+    per-row Python. `region` is True exactly at item positions holding
+    a REAL vocab code (sentinels, ids and short rows excluded), so
+    callers can fold counts straight off (codes[region], row_of[region]).
+    """
+    from avenir_tpu.core.stream import iter_byte_blocks, prefetched
+
+    sentinels = ([marker] if marker is not None else []) + [""]
+    for path in paths:
+        for data in prefetched(iter_byte_blocks(path, block_bytes),
+                               depth=1):
+            codes, offsets = seq_encode_native(data, delim,
+                                               vocab + sentinels)
+            n = offsets.shape[0] - 1
+            if n <= 0:
+                continue
+            region = csr_region_mask(offsets, skip, codes.shape[0])
+            if (codes[region] < 0).any():
+                added = False
+                for ln in data.decode("utf-8", "replace").split("\n"):
+                    if not ln.strip():
+                        continue
+                    for tok in [t.strip(" \t\r")
+                                for t in ln.split(delim)][skip:]:
+                        if tok and tok != marker and tok not in index:
+                            index[tok] = len(vocab)
+                            vocab.append(tok)
+                            added = True
+                if added:
+                    codes, offsets = seq_encode_native(data, delim,
+                                                       vocab + sentinels)
+            v = len(vocab)
+            np.logical_and(region, codes >= 0, out=region)
+            np.logical_and(region, codes < v, out=region)   # sentinels drop
+            yield codes, offsets, region, n
+
+
+def distinct_row_code_counts(row_of: np.ndarray, codes: np.ndarray,
+                             region: np.ndarray, v: int) -> np.ndarray:
+    """counts[c] = #rows whose region tokens include code c, each row
+    counted once (the multi-hot k=1 support algebra): in-place sort +
+    consecutive-diff dedup, so the int64 key array is the only
+    token-sized temporary — no np.unique copy."""
+    keys = row_of[region].astype(np.int64) * v + codes[region]
+    keys.sort()
+    if not keys.shape[0]:
+        return np.zeros(v, np.int64)
+    uniq = np.empty(keys.shape[0], bool)
+    uniq[0] = True
+    np.not_equal(keys[1:], keys[:-1], out=uniq[1:])
+    return np.bincount((keys[uniq] % v).astype(np.intp), minlength=v)
 
 
 def extract_column_native(data: bytes, delim: str, ordinal: int

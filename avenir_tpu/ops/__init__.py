@@ -6,8 +6,11 @@ Primitive vocabulary (SURVEY §2.12 mapping):
 - topk_by_group   : per-group ranked selection       (secondary sort)
 - allpairs_distance: blocked pairwise distances      (sifarish SameTypeSimilarity)
 - infotheory      : entropy / gini / MI algebra      (InfoContentStat et al.)
+- bitset          : packed popcount containment      (Apriori/GSP support counts)
 """
 
 from avenir_tpu.ops.reduce import keyed_reduce, combine_codes, one_hot_count
 from avenir_tpu.ops.distance import pairwise_distance, blocked_topk_neighbors
 from avenir_tpu.ops.infotheory import entropy, gini, bits_entropy
+from avenir_tpu.ops.bitset import (bitset_contain_counts, bitset_contain_mask,
+                                   pack_rows_u32, pack_index_rows_u32)

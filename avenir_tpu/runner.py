@@ -26,6 +26,7 @@ import json
 import math
 import os
 import sys
+import time
 from dataclasses import dataclass, field
 from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
@@ -35,7 +36,7 @@ from avenir_tpu.core.config import (JobConfig, MissingConfigError,
                                     load_properties)
 from avenir_tpu.core.dataset import Dataset
 from avenir_tpu.core.schema import FeatureSchema
-from avenir_tpu.utils.metrics import ConfusionMatrix
+from avenir_tpu.utils.metrics import ConfusionMatrix, throughput_counters
 
 
 @dataclass
@@ -1104,6 +1105,10 @@ def gsp_job(cfg: JobConfig, inputs: List[str], output: str) -> JobResult:
                       if os.path.exists(p))
     in_ram = (cfg.get("stream.block.size.mb") is None
               and total_bytes < (256 << 20))
+    # timer starts BEFORE the in-RAM probe reads the file: RowsPerSec
+    # must price the whole job's I/O identically on both paths, or the
+    # tripwire mis-alarms when a corpus crosses the in-RAM gate
+    t0 = time.perf_counter()
     if in_ram:
         rows = [[t.strip(" \t\r") for t in ln.split(cfg.field_delim_regex)]
                 for p in inputs for ln in _read_lines(p)]
@@ -1115,12 +1120,17 @@ def gsp_job(cfg: JobConfig, inputs: List[str], output: str) -> JobResult:
         # in-RAM: one [N, T] upload, device-resident across k rounds
         levels = miner.mine(SequenceSet.from_token_rows(
             rows, skip_field_count=skip))
+        n_rows = len(rows)
     else:
         # beyond-RAM (or explicitly chunked): one streamed scan per k
-        levels = miner.mine_stream(StreamingSequenceSource(
+        src = StreamingSequenceSource(
             inputs, delim=cfg.field_delim_regex, skip_field_count=skip,
             block_bytes=int(cfg.get_float("stream.block.size.mb", 64.0)
-                            * (1 << 20))))
+                            * (1 << 20)))
+        levels = miner.mine_stream(src)
+        n_rows = src.n_rows
+    counters = {"GSP:MaxLength": max(levels) if levels else 0,
+                **throughput_counters(n_rows, time.perf_counter() - t0)}
     os.makedirs(output or ".", exist_ok=True)
     outs = []
     delim = cfg.field_delim
@@ -1130,8 +1140,7 @@ def gsp_job(cfg: JobConfig, inputs: List[str], output: str) -> JobResult:
             for cand, support in sorted(seqs.items()):
                 fh.write(delim.join([*cand, f"{support:.6f}"]) + "\n")
         outs.append(p)
-    return JobResult("candidateGenerationWithSelfJoin",
-                     {"GSP:MaxLength": max(levels) if levels else 0},
+    return JobResult("candidateGenerationWithSelfJoin", counters,
                      outs, levels)
 
 
@@ -1253,6 +1262,9 @@ def apriori_job(cfg: JobConfig, inputs: List[str], output: str) -> JobResult:
                       if os.path.exists(p))
     in_ram = (cfg.get("stream.block.size.mb") is None
               and total_bytes < (256 << 20))
+    # timer before the in-RAM probe's file read: RowsPerSec must price
+    # both paths' I/O identically (see gsp_job)
+    t0 = time.perf_counter()
     if in_ram:
         # space/tab/CR trim: both apriori entry points and the native
         # counting pass must agree on token identity
@@ -1269,23 +1281,28 @@ def apriori_job(cfg: JobConfig, inputs: List[str], output: str) -> JobResult:
         levels = miner.mine(TransactionSet.from_rows(
             rows, trans_id_ord=trans_id_ord, skip_field_count=skip,
             marker=marker))
+        n_rows = len(rows)
     else:
         # beyond-RAM (or explicitly chunked): one streamed scan per
         # itemset length — the reference's per-k MR jobs over the same
-        # HDFS input; host RSS stays O(block) at any size
-        levels = miner.mine_stream(StreamingTransactionSource(
+        # HDFS input, bit-packed over the frequent vocabulary after k=1;
+        # host RSS stays O(block) at any size
+        src = StreamingTransactionSource(
             inputs, delim=cfg.field_delim_regex,
             trans_id_ord=trans_id_ord, skip_field_count=skip, marker=marker,
             block_bytes=int(cfg.get_float("stream.block.size.mb", 64.0)
-                            * (1 << 20))))
+                            * (1 << 20)))
+        levels = miner.mine_stream(src)
+        n_rows = src.n_trans
+    counters = {"Apriori:MaxLength": len(levels),
+                **throughput_counters(n_rows, time.perf_counter() - t0)}
     outs = []
     os.makedirs(output or ".", exist_ok=True)
     for k, isl in enumerate(levels, start=1):
         p = os.path.join(output, f"itemsets-{k}.txt")
         isl.save(p, delim=cfg.field_delim)
         outs.append(p)
-    return JobResult("frequentItemsApriori",
-                     {"Apriori:MaxLength": len(levels)}, outs, levels)
+    return JobResult("frequentItemsApriori", counters, outs, levels)
 
 
 @job("associationRuleMiner", "arm",
@@ -1819,7 +1836,9 @@ def run_from_cli(argv: Sequence[str]) -> JobResult:
     ap.add_argument("--conf", required=False, default=None,
                     help="properties file (the -Dconf.path analog)")
     ap.add_argument("paths", nargs="*", help="input paths... output path")
-    args = ap.parse_args(argv)
+    # intermixed: `jobname --conf props IN OUT` splits the positionals
+    # around the optional, which plain parse_args cannot reassemble
+    args = ap.parse_intermixed_args(argv)
     if not args.paths:
         ap.error("expected IN... OUT paths (at least an output path)")
     # a down accelerator tunnel hangs backend init in-process with no

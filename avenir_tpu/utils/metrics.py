@@ -122,6 +122,18 @@ class CostBasedArbitrator:
         return np.asarray(prob_pos) > thr
 
 
+def throughput_counters(records: int, seconds: float) -> Dict[str, float]:
+    """The regression-tripwire pair every streamed job should report:
+    the Hadoop-style Basic:Records plus a derived Basic:RowsPerSec, so
+    scale harnesses (tools/stream_scale_check.py, bench_scaling.py) get a
+    non-null rows figure AND a rate to alarm on without re-deriving
+    either. A non-positive wall clock (mocked timers) yields rate 0
+    rather than inf/ZeroDivision."""
+    rate = records / seconds if seconds > 0 else 0.0
+    return {"Basic:Records": int(records),
+            "Basic:RowsPerSec": round(rate, 1)}
+
+
 class Counters:
     """A flat stand-in for Hadoop counter groups: "Group:Name" -> value."""
 

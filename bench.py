@@ -906,8 +906,12 @@ def _sec_anchor():
 
 def _sec_nb_stream():
     gen_rps, csv_rps, parse_rps, overlap_eff, rss_mb = bench_nb_stream()
+    # csv_rows rides IN the banked values: the assembled note must state
+    # the corpus size these rates were MEASURED at, not whatever
+    # AVENIR_BENCH_CSV_ROWS the assembling process happens to see
     return {"gen_rps": gen_rps, "csv_rps": csv_rps, "parse_rps": parse_rps,
-            "overlap_eff": overlap_eff, "rss_mb": rss_mb}
+            "overlap_eff": overlap_eff, "rss_mb": rss_mb,
+            "csv_rows": STREAM_CSV_ROWS}
 
 
 def _sec_knn_stream():
@@ -918,8 +922,10 @@ def _sec_knn_stream():
 
 def _sec_knn_stream_csv():
     rps, parse_rps, fold_rps, overlap_eff = bench_knn_stream_csv()
+    # same provenance rule as _sec_nb_stream: the measured corpus size is
+    # part of the measurement, not of the assembling process's env
     return {"rps": rps, "parse_rps": parse_rps, "fold_rps": fold_rps,
-            "overlap_eff": overlap_eff}
+            "overlap_eff": overlap_eff, "csv_rows": KNN_CSV_ROWS}
 
 
 def _sec_kernel_sweep():
@@ -978,6 +984,28 @@ def _save_bank(bank: dict) -> None:
     os.replace(tmp, BANK_PATH)
 
 
+@contextlib.contextmanager
+def _bank_lock():
+    """Exclusive cross-process lock for the bank's load->merge->save
+    read-modify-write. Two drains may legally interleave (watcher +
+    round-end bench, section by section under _chip_lock), but a drain
+    used to do its bank merge AFTER releasing the chip lock — so two
+    concurrent merges could interleave load/save and silently drop the
+    other process's just-banked section, a lost update contradicting the
+    'each success is immediately persisted' guarantee. Dedicated lock
+    (not _chip_lock) so a bank write never waits on a chip section in
+    flight."""
+    import fcntl
+
+    lock = open(BANK_PATH + ".banklock", "w")
+    fcntl.flock(lock, fcntl.LOCK_EX)
+    try:
+        yield
+    finally:
+        fcntl.flock(lock, fcntl.LOCK_UN)
+        lock.close()
+
+
 def _section_child(name: str) -> int:
     """Run ONE section in this process and print a single JSON line.
     Invoked by the drain as `bench.py --section NAME` so a hang or crash
@@ -999,6 +1027,35 @@ def _section_child(name: str) -> int:
         return 1
 
 
+def _run_process_group(cmd, timeout_s: float, env=None, cwd=None):
+    """subprocess.run(capture_output=True, timeout=...) but the child is
+    launched as its own PROCESS GROUP leader and a timeout kills the
+    WHOLE group: a section that spawned a grandchild (kernel_sweep runs
+    tools/tpu_kernel_check.py) must not leave that grandchild driving
+    the chip after the parent times out — the next section would then
+    contend with it under a fresh lock, the exact two-clients pattern
+    the chip lock exists to prevent. Raises subprocess.TimeoutExpired
+    AFTER the group is dead."""
+    import signal
+    import subprocess
+
+    proc = subprocess.Popen(cmd, stdout=subprocess.PIPE,
+                            stderr=subprocess.PIPE, text=True, env=env,
+                            cwd=cwd, start_new_session=True)
+    try:
+        stdout, stderr = proc.communicate(timeout=timeout_s)
+    except subprocess.TimeoutExpired:
+        try:
+            os.killpg(proc.pid, signal.SIGKILL)
+        except (ProcessLookupError, PermissionError):
+            pass          # group already gone (or not ours): nothing to kill
+        proc.communicate()   # reap; cannot hang once the group is SIGKILLed
+        raise
+    proc.stdout = stdout
+    proc.stderr = stderr
+    return proc
+
+
 def _run_section(name: str, timeout_s: float):
     """(values, error): run one section as a subprocess with a hard
     timeout; the child skips the device probe (the drain already did it)."""
@@ -1007,11 +1064,10 @@ def _run_section(name: str, timeout_s: float):
     env = dict(os.environ, AVENIR_SKIP_DEVICE_PROBE="1")
     here = os.path.dirname(os.path.abspath(__file__))
     try:
-        proc = subprocess.run(
+        proc = _run_process_group(
             [sys.executable, os.path.join(here, "bench.py"),
              "--section", name],
-            capture_output=True, text=True, timeout=timeout_s, env=env,
-            cwd=here)
+            timeout_s, env=env, cwd=here)
     except subprocess.TimeoutExpired:
         return None, f"section hung >{timeout_s:.0f}s (tunnel flap?)"
     obj = None
@@ -1090,20 +1146,29 @@ def drain(force: bool = False, only=None, probe_timeout: float = 120.0,
             t0 = time.perf_counter()
             values, err = _run_section(name, timeout_s)
         if values is not None:
-            bank = _load_bank()
-            bank[name] = {"ok": True, "ts": round(time.time(), 1),
-                          "s": round(time.perf_counter() - t0, 1),
-                          "values": values}
-            _save_bank(bank)
+            # the reload+merge+save runs UNDER the bank lock: a watcher
+            # drain and a round-end drain merging concurrently must not
+            # interleave load/save and drop each other's banked section
+            with _bank_lock():
+                bank = _load_bank()
+                bank[name] = {"ok": True, "ts": round(time.time(), 1),
+                              "s": round(time.perf_counter() - t0, 1),
+                              "values": values}
+                _save_bank(bank)
             print(f"# banked {name} ({bank[name]['s']}s)", file=sys.stderr)
         else:
             failures.append((name, err))
             print(f"# FAILED {name}: {err}", file=sys.stderr)
             if not prior.get("ok"):
-                bank = _load_bank()
-                bank[name] = {"ok": False, "ts": round(time.time(), 1),
-                              "error": err}
-                _save_bank(bank)
+                with _bank_lock():
+                    bank = _load_bank()
+                    # re-check under the lock: another drain may have
+                    # banked a success for this section since our read
+                    if not bank.get(name, {}).get("ok"):
+                        bank[name] = {"ok": False,
+                                      "ts": round(time.time(), 1),
+                                      "error": err}
+                        _save_bank(bank)
             if needs_tpu:
                 tpu_ok = None  # flap suspected: re-probe before next one
     return failures
@@ -1123,23 +1188,31 @@ def main():
             budget_s = 5400.0
         drain(force=True, budget_s=budget_s)
         bank = _load_bank()
-    banked_ok = [n for n, _f, _t, _n in SECTIONS
-                 if bank.get(n, {}).get("ok")]
-    if not reachable and not banked_ok:
-        print(json.dumps({
+    else:
+        # outage: still take the one measurement that needs no chip — the
+        # CPU-only Hadoop anchor — so a fully-down round banks something
+        drain(force=True, only={"anchor"})
+        bank = _load_bank()
+    banked_tpu_ok = [n for n, _f, _t, needs in SECTIONS
+                     if needs and bank.get(n, {}).get("ok")]
+    if not reachable and not banked_tpu_ok:
+        print(json.dumps(_json_safe({
             "metric": "nb_knn_rows_per_sec_per_chip", "value": 0,
             "unit": "rows/sec", "vs_baseline": 0,
             "error": ("accelerator backend unreachable (device probe hung "
                       ">180s) - transient tunnel outage, not a framework "
                       "failure; rerun when the device responds"),
+            "baseline_anchor_values": bank.get("anchor", {}).get("values"),
             "outage_note": (
                 "tools/tpu_watcher.sh loops `bench.py --drain` and banks "
                 "each section to TPU_BANK_r05.json the moment the tunnel "
-                "returns; measured CPU-side scale evidence from this "
+                "returns; the CPU-only baseline anchor above was still "
+                "measured and banked during the outage; measured CPU-side "
+                "scale evidence from this "
                 "round: STREAM_SCALE_r05.json (100M-row MI/markov/apriori/"
                 "GSP at O(block) RSS) and nb_stream_1b_r05.log (1e9 real "
                 "on-disk rows end-to-end); last real chip numbers: "
-                "BENCH_r03.json")}))
+                "BENCH_r03.json")})))
         return
     print(json.dumps(_json_safe(_assemble(bank, live=reachable))))
 
@@ -1174,6 +1247,15 @@ def _assemble(bank: dict, live: bool) -> dict:
     knn_csv_parse_rps = _bv(bank, "knn_stream_csv", "parse_rps")
     knn_csv_fold_rps = _bv(bank, "knn_stream_csv", "fold_rps")
     knn_csv_overlap = _bv(bank, "knn_stream_csv", "overlap_eff")
+    # corpus sizes come from the BANK (recorded by the measuring drain):
+    # the banked rates may have been measured under a different
+    # AVENIR_BENCH_*_ROWS than this process sees — the notes must state
+    # the size of the numbers they annotate. Module constants only back
+    # fill banks written before the csv_rows key existed.
+    stream_csv_rows = int(_bv(bank, "nb_stream", "csv_rows",
+                              STREAM_CSV_ROWS))
+    knn_csv_rows = int(_bv(bank, "knn_stream_csv", "csv_rows",
+                           KNN_CSV_ROWS))
     rf_rls = _bv(bank, "rf", "rls")
     rf_levels = _bv(bank, "rf", "levels")
     rf_predict_rps = _bv(bank, "rf", "predict_rps")
@@ -1278,9 +1360,9 @@ def _assemble(bank: dict, live: bool) -> dict:
         "knn_stream_csv_fold_rows_per_sec": round(knn_csv_fold_rps, 1),
         "knn_stream_csv_overlap_efficiency": round(knn_csv_overlap, 3),
         "knn_stream_csv_note": (
-            f"REAL on-disk end-to-end: {KNN_CSV_ROWS/1e6:.0f}M x 128-float "
+            f"REAL on-disk end-to-end: {knn_csv_rows/1e6:.0f}M x 128-float "
             "rows (~"
-            f"{KNN_CSV_ROWS*965/1e9:.1f}GB) stream disk -> native parse -> "
+            f"{knn_csv_rows*965/1e9:.1f}GB) stream disk -> native parse -> "
             "device top-k fold with prefetch overlap — no rotation proxy; "
             "bound by the slower stage (this run: "
             + ("parse" if not np.isfinite(knn_csv_parse_rps)
@@ -1297,8 +1379,8 @@ def _assemble(bank: dict, live: bool) -> dict:
                         f"{STREAM_CHUNK//10**6}M-row chunks that never "
                         "coexist in memory (device-generated, isolates the "
                         "fold from host parse); csv figures are MEASURED "
-                        f"over {STREAM_CSV_ROWS//10**6}M real on-disk rows "
-                        f"(~{STREAM_CSV_ROWS*38/10**9:.1f}GB) through "
+                        f"over {stream_csv_rows//10**6}M real on-disk rows "
+                        f"(~{stream_csv_rows*38/10**9:.1f}GB) through "
                         "CsvBlockReader+prefetched() with "
                         "the native csv_parse_mt at the host's core count "
                         "(this host: 1); overlap_efficiency = end-to-end / "

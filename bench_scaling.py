@@ -2,16 +2,76 @@
 
 Prints ONE JSON line:
   {"metric": "scaling_efficiency_nb_knn", "value": <geomean efficiency at
-   max devices>, "unit": "fraction_of_linear", "table": [...]}
+   max devices>, "unit": "fraction_of_linear", "table": [...],
+   "miner_tripwire": {...}}
 
 Runs on real chips when the host has them; otherwise bootstraps a virtual
 CPU device pool (same mechanism as __graft_entry__.dryrun_multichip). See
 avenir_tpu/parallel/scaling.py for what the virtual numbers do and don't
 mean.
+
+miner_tripwire: the two slowest streamed jobs of the 100M-row scale run
+(frequentItemsApriori, candidateGenerationWithSelfJoin — STREAM_SCALE_r05
+measured them at 320.7s/461.8s with rows:null, i.e. no throughput counter
+at all) are exercised here over a small streamed corpus purely so their
+Basic:Records / Basic:RowsPerSec counters are asserted non-null every
+bench round. A regression that silently drops the counters — or tanks the
+streamed rate — now fails/flags the bench instead of going unnoticed
+until the next 100M-row run.
 """
 
 import json
 import sys
+import tempfile
+
+
+def miner_tripwire(rows: int = 20_000) -> dict:
+    """Run both streamed miners over `rows` synthetic transactions and
+    return their throughput counters; raises if either job comes back
+    without a non-null Basic:Records (the VERDICT Weak-#3 regression)."""
+    import os
+    import shutil
+    import numpy as np
+    from avenir_tpu.runner import run_job
+
+    d = tempfile.mkdtemp(prefix="avenir_miner_tripwire_")
+    try:
+        path = os.path.join(d, "seq.csv")
+        rng = np.random.default_rng(12)
+        states = ["L", "M", "H"]
+        with open(path, "w") as fh:
+            for i in range(rows):
+                up = i % 2 == 0
+                s, toks = 1, []
+                for _ in range(6):
+                    p = [0.1, 0.3, 0.6] if up else [0.6, 0.3, 0.1]
+                    s = int(np.clip(s + rng.choice([-1, 0, 1], p=p), 0, 2))
+                    toks.append(states[s])
+                fh.write(f"c{i},{'T' if up else 'F'},"
+                         + ",".join(toks) + "\n")
+
+        out = {}
+        jobs = [
+            ("frequentItemsApriori",
+             {"fia.support.threshold": "0.3", "fia.item.set.length": "2",
+              "fia.skip.field.count": "2", "fia.stream.block.size.mb": "1"}),
+            ("candidateGenerationWithSelfJoin",
+             {"cgs.support.threshold": "0.3", "cgs.item.set.length": "2",
+              "cgs.skip.field.count": "2", "cgs.stream.block.size.mb": "1"}),
+        ]
+        for job, conf in jobs:
+            res = run_job(job, conf, [path], os.path.join(d, job))
+            recs = res.counters.get("Basic:Records")
+            if recs is None or int(recs) != rows:
+                raise RuntimeError(
+                    f"{job} lost its throughput counter: "
+                    f"Basic:Records={recs!r} (expected {rows}) — the "
+                    f"streamed miners are untripwired")
+            out[job] = {"rows": int(recs),
+                        "rows_per_sec": res.counters.get("Basic:RowsPerSec")}
+        return out
+    finally:
+        shutil.rmtree(d, ignore_errors=True)
 
 
 def main(n_devices: int = 8, quick: bool = False):
@@ -43,6 +103,7 @@ def main(n_devices: int = 8, quick: bool = False):
     if result.get("virtual_devices"):
         line["virtual_devices"] = True
         line["note"] = result["note"]
+    line["miner_tripwire"] = miner_tripwire(4_000 if quick else 20_000)
     print(json.dumps(line))
 
 

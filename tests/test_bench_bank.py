@@ -164,6 +164,116 @@ def test_fused_section_fails_on_nonfinite_rate():
         bench._require_finite(float("nan"))
 
 
+def test_drain_bank_merge_runs_under_bank_lock(bank_path, monkeypatch):
+    """The load->merge->save read-modify-write in drain() must hold the
+    dedicated bank lock: two concurrent drains (watcher + round-end, an
+    explicitly supported mode) used to interleave their merges and drop
+    each other's just-banked section. flock conflicts across file
+    descriptors even in one process, so a non-blocking acquire inside
+    _save_bank proves the lock is held at merge time."""
+    import fcntl
+
+    monkeypatch.setattr(bench, "_backend_reachable", lambda *a: True)
+    monkeypatch.setattr(bench, "_run_section",
+                        lambda name, t: ({"v": 1}, None))
+    real_save = bench._save_bank
+    held = []
+
+    def checked_save(bank):
+        with open(bank_path + ".banklock", "w") as probe:
+            try:
+                fcntl.flock(probe, fcntl.LOCK_EX | fcntl.LOCK_NB)
+                held.append(False)          # acquired: lock was NOT held
+                fcntl.flock(probe, fcntl.LOCK_UN)
+            except BlockingIOError:
+                held.append(True)
+        real_save(bank)
+
+    monkeypatch.setattr(bench, "_save_bank", checked_save)
+    bench.drain(force=True, only={"anchor"})
+    assert held == [True]
+    # the failure path's (re-checked) merge is locked too
+    monkeypatch.setattr(bench, "_run_section", lambda name, t: (None, "boom"))
+    held.clear()
+    bench._save_bank = checked_save     # monkeypatch already applied
+    bench.drain(force=True, only={"nb"})
+    assert held == [True]
+    entry = bench._load_bank()["nb"]
+    assert not entry["ok"] and entry["error"] == "boom"
+
+
+def test_run_process_group_kills_grandchildren(tmp_path):
+    """A timed-out section must not orphan grandchildren: kernel_sweep
+    spawns tools/tpu_kernel_check.py, and a wedged grandchild would keep
+    driving the chip under the NEXT section's lock. The runner launches
+    the child as a process-group leader and SIGKILLs the whole group on
+    timeout."""
+    import os
+    import subprocess
+    import time
+
+    pidfile = str(tmp_path / "grandchild.pid")
+    child_src = (
+        "import subprocess, sys, time\n"
+        "p = subprocess.Popen([sys.executable, '-c',"
+        " 'import time; time.sleep(120)'])\n"
+        f"open({pidfile!r}, 'w').write(str(p.pid))\n"
+        "time.sleep(120)\n")
+    with pytest.raises(subprocess.TimeoutExpired):
+        bench._run_process_group([sys.executable, "-c", child_src],
+                                 timeout_s=5.0)
+    # the grandchild was announced before the timeout fired...
+    gpid = int(open(pidfile).read())
+    # ...and must be dead (or a zombie reparented to init) now
+    deadline = time.time() + 5.0
+    while time.time() < deadline:
+        try:
+            os.kill(gpid, 0)
+        except ProcessLookupError:
+            break
+        time.sleep(0.05)
+    else:
+        os.kill(gpid, 9)
+        pytest.fail(f"grandchild {gpid} survived the group kill")
+
+
+def test_outage_still_banks_cpu_anchor(bank_path, monkeypatch, capsys):
+    """A fully-down round must still record the one measurement that
+    needs no chip: main() drains the CPU-only anchor before emitting the
+    outage JSON, and the outage line carries the anchor values."""
+    monkeypatch.setattr(bench, "_backend_reachable", lambda *a: False)
+    monkeypatch.setattr(
+        bench, "_run_section",
+        lambda name, t: ({"nb_node_rps": 5e6, "pair_node_pps": 1.5e7}, None)
+        if name == "anchor" else (None, "should not run"))
+    bench.main()
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert out["value"] == 0 and "unreachable" in out["error"]
+    assert out["baseline_anchor_values"]["nb_node_rps"] == 5e6
+    entry = bench._load_bank()["anchor"]
+    assert entry["ok"] and entry["values"]["pair_node_pps"] == 1.5e7
+
+
+def test_assemble_notes_state_banked_corpus_sizes():
+    """The stream notes must describe the corpus the banked rates were
+    MEASURED over (recorded in the banked values), not this process's
+    env-derived module constants — a drain run under a different
+    AVENIR_BENCH_*_ROWS would otherwise be annotated with the wrong
+    size."""
+    bank = _full_bank()
+    bank["nb_stream"]["values"]["csv_rows"] = 42_000_000
+    bank["knn_stream_csv"]["values"]["csv_rows"] = 7_000_000
+    out = bench._assemble(bank, live=True)
+    assert "42M real on-disk rows" in out["stream_note"]
+    assert bench.STREAM_CSV_ROWS != 42_000_000
+    assert "7M x 128-float" in out["knn_stream_csv_note"]
+    # a bank written before the csv_rows key existed falls back to the
+    # module constants instead of crashing
+    bank2 = _full_bank()
+    out2 = bench._assemble(bank2, live=True)
+    assert f"{bench.STREAM_CSV_ROWS // 10**6}M real" in out2["stream_note"]
+
+
 def test_section_registry_complete():
     # every section the assembler reads exists in the registry, and the
     # child entry point knows every registered section

@@ -1,0 +1,214 @@
+"""Bit-packed containment kernel (ops/bitset): the streamed miners'
+support-counting primitive.
+
+Property: for any uint8 multi-hot block T and any candidate set C (mixed
+itemset lengths), the packed popcount containment counts must equal the
+dense `(T @ C.T) == k` reference the in-RAM miner uses — the algebraic
+guarantee that lets the streaming path swap 8x-smaller uint32 bitset
+blocks for the float multi-hot matmul without changing a single count.
+"""
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from avenir_tpu.ops.bitset import (
+    bitset_contain_counts,
+    bitset_contain_mask,
+    pack_index_rows_u32,
+    pack_rows_u32,
+    packed_block_nbytes,
+    words_for,
+)
+
+
+def dense_reference(mh, cand_lists):
+    """The uint8 path's counting rule: overlap == candidate length."""
+    t = mh.astype(np.float32)
+    out = []
+    for items in cand_lists:
+        c = np.zeros(mh.shape[1], np.float32)
+        c[list(items)] = 1.0
+        out.append(int(((t @ c) >= len(items)).sum()))
+    return np.array(out)
+
+
+class TestPacking:
+    def test_words_for(self):
+        assert words_for(0) == 1
+        assert words_for(1) == 1
+        assert words_for(32) == 1
+        assert words_for(33) == 2
+        assert words_for(96) == 3
+
+    def test_pack_roundtrip_bits(self, rng):
+        v = 71
+        mh = (rng.random((40, v)) < 0.4).astype(np.uint8)
+        packed = pack_rows_u32(mh)
+        assert packed.shape == (40, words_for(v))
+        # unpack and compare
+        unpacked = np.unpackbits(
+            packed.view(np.uint8), axis=1, bitorder="little")[:, :v]
+        np.testing.assert_array_equal(unpacked, mh)
+
+    def test_index_rows_match_dense_pack(self, rng):
+        v = 50
+        cands = [tuple(sorted(rng.choice(v, size=k, replace=False)))
+                 for k in (1, 2, 3, 4) for _ in range(5)]
+        mh = np.zeros((len(cands), v), np.uint8)
+        for r, items in enumerate(cands):
+            mh[r, list(items)] = 1
+        np.testing.assert_array_equal(
+            pack_index_rows_u32(cands, v), pack_rows_u32(mh))
+
+    def test_packed_blocks_are_8x_smaller(self):
+        packed, dense = packed_block_nbytes(8192, 1024)
+        assert dense / packed == pytest.approx(8.0)
+
+
+class TestContainment:
+    @pytest.mark.parametrize("trial", range(6))
+    def test_counts_match_dense_reference(self, rng, trial):
+        n = int(rng.integers(1, 400))
+        v = int(rng.integers(1, 130))       # crosses the 32/64/96-bit words
+        mh = (rng.random((n, v)) < float(rng.uniform(0.05, 0.6))
+              ).astype(np.uint8)
+        cands = []
+        for _ in range(int(rng.integers(1, 50))):
+            k = int(rng.integers(1, min(v, 6) + 1))
+            cands.append(tuple(sorted(rng.choice(v, size=k, replace=False))))
+        got = np.asarray(bitset_contain_counts(
+            jnp.asarray(pack_rows_u32(mh)),
+            jnp.asarray(pack_index_rows_u32(cands, v))))
+        np.testing.assert_array_equal(got, dense_reference(mh, cands))
+
+    def test_mixed_lengths_one_call(self, rng):
+        """Candidates of every itemset length count correctly in ONE
+        fused matrix — the property that lets a whole mining round (and
+        the all-lengths trans-id pass) share a single device call."""
+        v = 40
+        mh = (rng.random((200, v)) < 0.3).astype(np.uint8)
+        cands = [(0,), (1, 2), (3, 4, 5), (6, 7, 8, 9), (0, 1, 2, 3, 4)]
+        got = np.asarray(bitset_contain_counts(
+            jnp.asarray(pack_rows_u32(mh)),
+            jnp.asarray(pack_index_rows_u32(cands, v))))
+        np.testing.assert_array_equal(got, dense_reference(mh, cands))
+
+    def test_padding_rows_never_count(self, rng):
+        v = 20
+        mh = np.ones((50, v), np.uint8)     # every row contains everything
+        cands = [(0, 1)]
+        packed_c = pack_index_rows_u32(cands, v, n_rows=16)
+        got = np.asarray(bitset_contain_counts(
+            jnp.asarray(pack_rows_u32(mh)), jnp.asarray(packed_c)))
+        assert got[0] == 50
+        assert (got[1:] == 0).all()         # all-zero pad rows: weight 0
+        mask = np.asarray(bitset_contain_mask(
+            jnp.asarray(pack_rows_u32(mh)), jnp.asarray(packed_c)))
+        assert mask[:, 0].all() and not mask[:, 1:].any()
+
+    def test_mask_matches_counts(self, rng):
+        v = 33
+        mh = (rng.random((64, v)) < 0.4).astype(np.uint8)
+        cands = [(0,), (1, 32), (2, 3, 4)]
+        t = jnp.asarray(pack_rows_u32(mh))
+        c = jnp.asarray(pack_index_rows_u32(cands, v))
+        np.testing.assert_array_equal(
+            np.asarray(bitset_contain_mask(t, c)).sum(axis=0),
+            np.asarray(bitset_contain_counts(t, c)))
+
+
+class TestStreamingSourceMask:
+    """The vocabulary mask applied at ingest after the k=1 round (the
+    InfrequentItemMarker in its ingest form)."""
+
+    def _source(self, tmp_path, lines):
+        from avenir_tpu.models.association import StreamingTransactionSource
+
+        p = tmp_path / "tx.csv"
+        p.write_text("\n".join(lines) + "\n")
+        return StreamingTransactionSource([str(p)])
+
+    def test_masked_packed_chunks_shrink_and_remap(self, tmp_path):
+        src = self._source(tmp_path, [
+            "T0,a,b,rare1", "T1,a,b", "T2,a,c,rare2", "T3,b,c"])
+        vocab, counts, n = src.scan_items()
+        assert n == 4
+        keep = [src.index["a"], src.index["b"], src.index["c"]]
+        vm = src.mask_items(keep)
+        assert vm == 3
+        blocks = list(src.packed_chunks(block_rows=8))
+        assert len(blocks) == 1 and blocks[0].shape == (8, words_for(3))
+        # masked token space: ranks of the ascending original ids
+        toks = [src.masked_token(m) for m in range(vm)]
+        assert sorted(toks) == ["a", "b", "c"]
+        # unpack and check the rare items are gone but a/b/c survive
+        got = np.unpackbits(blocks[0].view(np.uint8), axis=1,
+                            bitorder="little")[:4, :vm]
+        assert got.sum() == 8  # 2+2+2+2 frequent items across the 4 rows
+
+    def test_python_and_native_packed_chunks_agree(self, tmp_path,
+                                                   monkeypatch):
+        import avenir_tpu.native.ingest as ingest
+
+        lines = [f"T{i},a,{'b' if i % 2 else 'c'},x{i % 7}"
+                 for i in range(64)]
+        src_n = self._source(tmp_path, lines)
+        src_n.scan_items()
+        src_n.mask_items([src_n.index[t] for t in "abc"])
+        native = list(src_n.packed_chunks(block_rows=16))
+        monkeypatch.setattr(ingest, "native_available", lambda: False)
+        src_p = self._source(tmp_path, lines)
+        src_p.scan_items()
+        src_p.mask_items([src_p.index[t] for t in "abc"])
+        python = list(src_p.packed_chunks(block_rows=16))
+        assert len(native) == len(python)
+        for a, b in zip(native, python):
+            np.testing.assert_array_equal(a, b)
+
+    def test_trailing_delims_stay_on_native_path(self, tmp_path,
+                                                 monkeypatch):
+        """Empty tokens (trailing-delimiter CSVs) map to the empty-string
+        sentinel of the discovery encoder, NOT to unknown: a vocabulary-
+        stable block must encode exactly once — no per-block Python
+        decode + re-encode slow path."""
+        import avenir_tpu.native.ingest as ingest
+        from avenir_tpu.models.association import StreamingTransactionSource
+
+        if not ingest.native_seq_ready(","):
+            pytest.skip("native encoder unavailable")
+        p = tmp_path / "tx.csv"
+        # every row ends with a trailing delimiter -> an empty last token
+        p.write_text("".join(f"T{i},a,b,\n" for i in range(400)))
+        calls = []
+        real = ingest.seq_encode_native
+        monkeypatch.setattr(ingest, "seq_encode_native",
+                            lambda *a: calls.append(1) or real(*a))
+        src = StreamingTransactionSource([str(p)], block_bytes=1024)
+        vocab, counts, n = src.scan_items()
+        assert n == 400 and sorted(vocab) == ["a", "b"]
+        from avenir_tpu.core.stream import iter_byte_blocks
+
+        n_blocks = sum(1 for _ in iter_byte_blocks(str(p), 1024))
+        # block 1 discovers a,b (1 encode + 1 re-encode); every later
+        # block is vocabulary-stable and encodes exactly once
+        assert len(calls) == n_blocks + 1
+
+    def test_native_scan_items_matches_python(self, tmp_path, monkeypatch):
+        import avenir_tpu.native.ingest as ingest
+
+        # duplicate items within a row (count once), empties, a marker
+        lines = ["T0,a,a,b", "T1,b,,c", "T2,*,a", "T3,c"]
+        p = tmp_path / "tx.csv"
+        p.write_text("\n".join(lines) + "\n")
+        from avenir_tpu.models.association import StreamingTransactionSource
+
+        src_n = StreamingTransactionSource([str(p)], marker="*")
+        vocab_n, counts_n, n_n = src_n.scan_items()
+        monkeypatch.setattr(ingest, "native_available", lambda: False)
+        src_p = StreamingTransactionSource([str(p)], marker="*")
+        vocab_p, counts_p, n_p = src_p.scan_items()
+        assert n_n == n_p == 4
+        assert vocab_n == vocab_p
+        np.testing.assert_array_equal(counts_n, counts_p)
+        assert dict(zip(vocab_n, counts_n)) == {"a": 2, "b": 2, "c": 2}

