@@ -1,0 +1,24 @@
+"""graftlint: AST-based JAX/TPU hazard analysis for this repo.
+
+PR 1 won its miner speedups by hand-hunting accidental int64 temporaries,
+host-sync points and recompile hazards; this package finds the same code
+shapes mechanically (the Casper move, arXiv:1801.09802: treat the shapes
+worth rewriting as a statically recognizable class, not archaeology).
+
+Entry points:
+  - ``python tools/graftlint.py <paths>`` / the ``graftlint`` console
+    script (avenir_tpu.analysis.cli) — text or ``--json`` output;
+  - :func:`run_paths` — the in-process API (tests/test_graftlint.py runs
+    it over the whole package; bench_scaling.py tripwires on its counts);
+  - ``graftlint_baseline.txt`` — the allowlist: accepted findings keyed
+    by ``path::rule::scope`` with a one-line justification each.
+
+See docs/graftlint.md for the rule catalog and allowlisting policy.
+"""
+
+from avenir_tpu.analysis.engine import (Finding, Report, default_baseline_path,
+                                        load_baseline, run_paths)
+from avenir_tpu.analysis.rules import ALL_RULES, rule_ids
+
+__all__ = ["Finding", "Report", "run_paths", "load_baseline",
+           "default_baseline_path", "ALL_RULES", "rule_ids"]

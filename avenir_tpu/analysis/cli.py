@@ -1,0 +1,107 @@
+"""graftlint CLI: `graftlint <paths>` (console script) or
+`python tools/graftlint.py <paths>`.
+
+Exit codes: 0 clean; 1 non-allowlisted findings, stale baseline entries,
+or parse errors; 2 usage/baseline-format errors. `--json` prints one
+machine-readable object (bench_scaling.py tripwires on its counts).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import List, Optional
+
+from avenir_tpu.analysis.engine import (default_baseline_path, load_baseline,
+                                        run_paths)
+from avenir_tpu.analysis.rules import ALL_RULES, rule_ids
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="graftlint",
+        description="AST-based JAX/TPU hazard analyzer (rule catalog: "
+                    "docs/graftlint.md)")
+    p.add_argument("paths", nargs="+",
+                   help=".py/.md files or directories to lint")
+    p.add_argument("--baseline", default=None,
+                   help="allowlist file (default: "
+                        "avenir_tpu/analysis/graftlint_baseline.txt)")
+    p.add_argument("--no-baseline", action="store_true",
+                   help="report every finding, ignoring the allowlist")
+    p.add_argument("--json", action="store_true", dest="as_json",
+                   help="emit one JSON object instead of text")
+    p.add_argument("--rules", default=None, metavar="ID[,ID...]",
+                   help=f"comma-separated subset of: {', '.join(rule_ids())}")
+    p.add_argument("--no-md", action="store_true",
+                   help="skip ```python fences in .md files")
+    p.add_argument("--allow-stale", action="store_true",
+                   help="do not fail on baseline entries that no longer "
+                        "match (use only while mid-refactor)")
+    return p
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    args = build_parser().parse_args(argv)
+    if args.rules:
+        wanted = [r.strip() for r in args.rules.split(",") if r.strip()]
+        unknown = set(wanted) - set(rule_ids())
+        if unknown:
+            print(f"graftlint: unknown rule(s): {', '.join(sorted(unknown))}",
+                  file=sys.stderr)
+            return 2
+        rules = [r() for r in ALL_RULES if r.rule_id in wanted]
+    else:
+        rules = None
+    try:
+        baseline = ([] if args.no_baseline
+                    else load_baseline(args.baseline or
+                                       default_baseline_path()))
+    except ValueError as e:
+        print(f"graftlint: {e}", file=sys.stderr)
+        return 2
+
+    # finding keys must be cwd-independent so the baseline matches from
+    # anywhere: anchor them to the repo root (the default baseline sits at
+    # <root>/avenir_tpu/analysis/) or to an explicit baseline's directory
+    if args.baseline:
+        root = os.path.dirname(os.path.abspath(args.baseline))
+    elif args.no_baseline:
+        root = None                      # cwd: keys are ephemeral anyway
+    else:
+        root = os.path.dirname(os.path.dirname(os.path.dirname(
+            default_baseline_path())))
+
+    try:
+        report = run_paths(args.paths, rules=rules, baseline=baseline,
+                           root=root, include_md=not args.no_md)
+    except OSError as e:
+        print(f"graftlint: cannot read input: {e}", file=sys.stderr)
+        return 2
+
+    if args.as_json:
+        print(json.dumps(report.to_json(), indent=1))
+    else:
+        for f in report.errors + report.findings:
+            print(f.render())
+        for e in report.stale:
+            print(f"stale baseline entry (line {e.lineno}): {e.key} — the "
+                  f"finding it excused is gone; delete it", file=sys.stderr)
+        print(f"graftlint: {len(report.scanned)} files, "
+              f"{len(report.findings)} finding(s), "
+              f"{len(report.suppressed)} allowlisted, "
+              f"{len(report.stale)} stale baseline entr(y/ies)"
+              + (f", {len(report.errors)} parse error(s)"
+                 if report.errors else ""))
+
+    if report.findings or report.errors:
+        return 1
+    if report.stale and not args.allow_stale:
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -280,7 +280,8 @@ class StreamingTransactionSource:
                     # r is sorted (row_of nondecreasing): each page is a
                     # searchsorted slice, not a full-array rescan
                     bounds = np.searchsorted(
-                        r, np.arange(0, n + block_rows, block_rows))
+                        r, np.arange(0, n + block_rows, block_rows,
+                                     dtype=np.int32))
                     for page, (lo, hi) in enumerate(
                             zip(bounds[:-1], bounds[1:])):
                         mh = np.zeros((block_rows, vm), np.uint8)

@@ -143,7 +143,7 @@ class MutualInformationAnalyzer:
             self.k = ds.schema.num_classes()
             F = len(self.fields)
             self.bins = [0] * F
-            self._fc = [np.zeros((0, self.k)) for _ in range(F)]
+            self._fc = [np.zeros((0, self.k), np.float64) for _ in range(F)]
         codes, bins = ds.feature_codes(self.fields)
         F = len(self.fields)
         self.bins = [max(a, b) for a, b in zip(self.bins, bins)]
@@ -590,7 +590,7 @@ def relief_relevance(
             in_c = y[qs:qe] == ki
             # in-class queries find themselves first: take the runner-up
             sel = np.where(in_c, kk - 1, 0)
-            r = np.arange(qe - qs)
+            r = np.arange(qe - qs, dtype=np.int32)
             d = dist[r, sel]
             j = nidx[r, sel]
             if kk == 1:        # a singleton class has no non-self hit
@@ -752,7 +752,8 @@ def top_matches_by_class(ds: Dataset, k: int = 3, block: int = 4096,
         dists, idxs = [], []
         for qs in range(0, len(rows), query_block):
             d, i = index.neighbors(
-                sub.take(np.arange(qs, min(qs + query_block, len(rows)))))
+                sub.take(np.arange(qs, min(qs + query_block, len(rows)),
+                                   dtype=np.int32)))
             dists.append(np.asarray(d))
             idxs.append(np.asarray(i))
         dist = np.concatenate(dists)

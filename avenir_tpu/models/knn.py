@@ -63,9 +63,10 @@ def _expand_mixed(x_num, ranges, x_cat, bins, metric: str):
         cols.append(np.asarray(x_num, np.float32)
                     / np.maximum(np.asarray(ranges, np.float32), 1e-9))
     scale = (1.0 / np.sqrt(2.0)) if metric == "euclidean" else 0.5
+    rows = np.arange(n, dtype=np.int32)
     for f, b in enumerate(bins or ()):
         oh = np.zeros((n, b), np.float32)
-        oh[np.arange(n), np.asarray(x_cat[:, f], np.int64)] = scale
+        oh[rows, np.asarray(x_cat[:, f], np.int32)] = scale
         cols.append(oh)
     x = np.concatenate(cols, axis=1) if cols else np.zeros((n, 0), np.float32)
     n_attrs = (x_num.shape[1] if x_num is not None else 0) + len(bins or ())

@@ -536,7 +536,7 @@ class ProbabilisticSuffixTree:
                         break
                     ctx = tuple(seq[t - d: t])
                     if ctx not in self.counts:
-                        self.counts[ctx] = np.zeros(nsym)
+                        self.counts[ctx] = np.zeros(nsym, np.float64)
                     self.counts[ctx][enc[t]] += 1
         return self
 

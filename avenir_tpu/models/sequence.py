@@ -297,14 +297,22 @@ class StreamingSequenceSource:
                         codes = np.where(valid, self._remap[
                             np.clip(codes, 0, None)], -1)
                         np.logical_and(valid, codes >= 0, out=valid)
-                    row_of, _ = csr_rows(offsets)
-                    order = np.flatnonzero(valid)
-                    rows_v = row_of[order]
-                    pos = (np.arange(order.shape[0], dtype=np.int64)
-                           - np.searchsorted(rows_v, rows_v))
-                    enc = codes[order]
+                    row_of, starts = csr_rows(offsets)
+                    # within-row rank of each surviving token in int32
+                    # region-mask form: one cumsum over the valid mask
+                    # replaces the flatnonzero/arange/searchsorted int64
+                    # triple that was the GSP pass's largest transient
+                    # (blocks never hold 2^31 tokens — they are tens of MB)
+                    cs = np.cumsum(valid, dtype=np.int32)
+                    base = np.zeros(n, np.int32)
+                    nz = starts > 0
+                    base[nz] = cs[starts[nz] - 1]
+                    rows_v = row_of[valid]
+                    pos = cs[valid] - 1 - base[rows_v]
+                    enc = codes[valid]
                     bounds = np.searchsorted(
-                        rows_v, np.arange(0, n + block_rows, block_rows))
+                        rows_v, np.arange(0, n + block_rows, block_rows,
+                                          dtype=np.int32))
                     for page, (lo, hi) in enumerate(
                             zip(bounds[:-1], bounds[1:])):
                         rows_here = min(block_rows, n - page * block_rows)

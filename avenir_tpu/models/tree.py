@@ -548,8 +548,9 @@ class DevicePathEvaluator:
             return per_tree[:, 0]
         k = len(self.class_values)
         votes = np.zeros((per_tree.shape[0], k), np.int64)
+        rows = np.arange(per_tree.shape[0], dtype=np.int32)
         for t in range(per_tree.shape[1]):
-            votes[np.arange(per_tree.shape[0]), per_tree[:, t]] += 1
+            votes[rows, per_tree[:, t]] += 1
         return votes.argmax(axis=1).astype(np.int32)
 
 
@@ -679,7 +680,8 @@ class DecisionTreeBuilder:
             lf = leaves[li]
             pop = float(leaf_tot[li].max())
             # class counts of this leaf: any split column's segment-sum
-            cls_counts = counts[li, 0].sum(axis=0) if ns else np.zeros(k)
+            cls_counts = (counts[li, 0].sum(axis=0) if ns
+                          else np.zeros(k, np.float64))
             node_imp = float(impurity_fn(cls_counts))
 
             allowed = self._allowed_splits(lf)
@@ -732,7 +734,7 @@ class DecisionTreeBuilder:
                 continue                   # internal node / padded child slot
             cls_counts = (
                 counts_final[li, 0].sum(axis=0)
-                if counts_final is not None else np.zeros(k)
+                if counts_final is not None else np.zeros(k, np.float64)
             )
             tot = cls_counts.sum()
             if tot <= 0 and lf["preds"]:
@@ -889,9 +891,10 @@ class RandomForestBuilder:
             return self._evaluator.predict(ds)
         k = len(self.class_values)
         votes = np.zeros((len(ds), k), np.int64)
+        rows = np.arange(len(ds), dtype=np.int32)
         for tree in self.trees:
             pred = tree.predict(ds, self.class_values)
-            votes[np.arange(len(ds)), pred] += 1
+            votes[rows, pred] += 1
         return votes.argmax(axis=1).astype(np.int32)
 
     def validate(self, ds: Dataset, pos_class: int = 1) -> ConfusionMatrix:

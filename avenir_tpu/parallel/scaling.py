@@ -165,16 +165,20 @@ def _nb_rate(mesh, rows: int, iters: int) -> float:
     labels_d = jax.device_put(labels, shard)
     w_d = jax.device_put(w, shard)
 
+    # the step index rides as an operand, not a closure: a closure-captured
+    # `iters` would bake the shape into the trace and recompile per value
+    steps = jnp.arange(1, iters + 1)
+
     @jax.jit
-    def many(codes_d, labels_d, w_d):
+    def many(codes_d, labels_d, w_d, steps):
         def body(i):
             # distinct data per step: on-device roll along the feature axis
             # keeps the row sharding intact (no cross-shard traffic)
             out = step(jnp.roll(codes_d, i, axis=1), labels_d, w_d)
             return sum(jnp.sum(o) for o in jax.tree.leaves(out))
-        return jax.lax.map(body, jnp.arange(1, iters + 1)).sum()
+        return jax.lax.map(body, steps).sum()
 
-    return rows * iters / _timed_scalar(many, codes_d, labels_d, w_d)
+    return rows * iters / _timed_scalar(many, codes_d, labels_d, w_d, steps)
 
 
 def _nb_compiled_collectives(mesh) -> List[Dict]:
@@ -247,14 +251,17 @@ def _knn_rate(mesh, queries: int, train: int, iters: int, k: int = 5) -> float:
     t_d = jax.device_put(t, rep)
     l_d = jax.device_put(t_labels, rep)
 
+    # step indices as an operand for the same no-recompile reason as _nb_rate
+    steps = jnp.arange(1, iters + 1)
+
     @jax.jit
-    def many(q_d, t_d, l_d):
+    def many(q_d, t_d, l_d, steps):
         def body(i):
             dist, labs = step(jnp.roll(q_d, i, axis=1), t_d, l_d)
             return jnp.sum(dist) + jnp.sum(labs).astype(jnp.float32)
-        return jax.lax.map(body, jnp.arange(1, iters + 1)).sum()
+        return jax.lax.map(body, steps).sum()
 
-    return queries * iters / _timed_scalar(many, q_d, t_d, l_d)
+    return queries * iters / _timed_scalar(many, q_d, t_d, l_d, steps)
 
 
 def measure_scaling(

@@ -122,6 +122,23 @@ class CostBasedArbitrator:
         return np.asarray(prob_pos) > thr
 
 
+def jit_cache_size(fn) -> int:
+    """Number of compiled executables cached on a `jax.jit` callable, or
+    -1 when the runtime doesn't expose it.
+
+    Growth across calls == compile-cache misses == recompiles. This is
+    the runtime cross-check for graftlint's `recompile-hazard` rule: the
+    static analyzer promises a shape-stable fold never recompiles, and
+    bench_scaling.py asserts this counter stays at the shape-bucket bound
+    (pow2-quantized block/candidate axes → logarithmically many entries)
+    instead of growing per block. If the two ever disagree, trust this
+    counter and tighten the rule."""
+    try:
+        return int(fn._cache_size())
+    except (AttributeError, TypeError):
+        return -1
+
+
 def throughput_counters(records: int, seconds: float) -> Dict[str, float]:
     """The regression-tripwire pair every streamed job should report:
     the Hadoop-style Basic:Records plus a derived Basic:RowsPerSec, so

@@ -1,0 +1,411 @@
+"""graftlint: tier-1 hazard gate + per-rule fixture corpus.
+
+Two jobs:
+1. Gate — the whole repo surface (package, tests, docs fences, tools,
+   benches) must lint clean against the allowlist baseline, with no stale
+   baseline entries. New hazards fail the suite the round they land.
+2. Corpus — every rule has known-bad snippets that MUST fire and
+   known-good twins that MUST stay silent, so a rule can't silently stop
+   firing (disable any rule and its corpus test fails).
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from avenir_tpu.analysis import load_baseline, run_paths
+from avenir_tpu.analysis.rules import (ALL_RULES, DefaultInt64Rule,
+                                       HostSyncInFoldRule,
+                                       RecompileHazardRule, TracerLeakRule,
+                                       UnseededStochasticTestRule)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+GATED = ["avenir_tpu", "tests", "docs", "tools", "bench.py",
+         "bench_scaling.py", "__graft_entry__.py"]
+
+
+# ------------------------------------------------------------------- gate
+def test_repo_lints_clean_against_baseline():
+    report = run_paths([os.path.join(REPO, p) for p in GATED],
+                       baseline=load_baseline(), root=REPO)
+    assert not report.errors, [f.render() for f in report.errors]
+    assert not report.findings, "\n" + "\n".join(
+        f.render() for f in report.findings)
+    assert not report.stale, [e.key for e in report.stale]
+    assert len(report.scanned) > 50
+
+
+def test_baseline_entries_all_used():
+    """Every allowlist entry must still excuse a live finding somewhere in
+    the gated surface (stale entries are dead weight that would mask a
+    regression landing in the same scope)."""
+    baseline = load_baseline()
+    assert baseline, "baseline file missing or empty"
+    report = run_paths([os.path.join(REPO, p) for p in GATED],
+                       baseline=baseline, root=REPO)
+    assert len(report.suppressed) >= len(baseline)
+
+
+# ------------------------------------------------- fixture corpus helpers
+def _lint(tmp_path, source, rule_cls, name="snippet.py"):
+    p = tmp_path / name
+    p.write_text(source)
+    report = run_paths([str(p)], rules=[rule_cls()], baseline=[],
+                       root=str(tmp_path))
+    assert not report.errors, [f.render() for f in report.errors]
+    return report.findings
+
+
+_INT64_BAD = """
+import numpy as np
+
+def fold(blocks):
+    out = 0
+    for b in blocks:
+        idx = np.argsort(b)            # always-int64 index array
+        acc = np.cumsum(b)             # 64-bit accumulator by default
+        z = np.zeros(b.shape[0])       # float64 by default
+        hits = [np.flatnonzero(r) for r in b]   # comprehension = loop
+        out += z[idx[0]] + acc[-1] + len(hits)
+    return out
+"""
+
+_INT64_GOOD = """
+import numpy as np
+
+def fold(blocks):
+    base = np.arange(100)              # outside any loop: cold path
+    out = 0
+    for b in blocks:
+        acc = np.cumsum(b, dtype=np.int32)
+        z = np.zeros(b.shape[0], np.float32)
+        keys = np.full(b.shape[0], "")          # dtype follows the str fill
+        m = np.ones(b.shape[0], bool)           # positional narrow dtype
+        out += z[0] + acc[-1] + m.sum() + (keys == "").sum()
+    for u in np.argsort(base):                  # for-iter evaluates once
+        out += u
+    return out
+"""
+
+
+def test_default_int64_fires_on_bad(tmp_path):
+    findings = _lint(tmp_path, _INT64_BAD, DefaultInt64Rule)
+    assert {f.rule for f in findings} == {"default-int64"}
+    assert len(findings) == 4, [f.render() for f in findings]
+    assert all(f.scope == "fold" for f in findings)
+
+
+def test_default_int64_silent_on_good(tmp_path):
+    assert _lint(tmp_path, _INT64_GOOD, DefaultInt64Rule) == []
+
+
+_SYNC_BAD = """
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+@jax.jit
+def kernel(x):
+    return x.sum()
+
+def fold(chunks):
+    tot = 0.0
+    for c in chunks:
+        tot += float(kernel(jnp.asarray(c)))        # scalar sync
+        tot += np.asarray(kernel(jnp.asarray(c)))   # array sync
+        jax.device_get(c)                           # explicit sync
+        tot += c.mean().item()                      # .item() sync
+    return tot
+"""
+
+_SYNC_GOOD = """
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+@jax.jit
+def kernel(x):
+    return x.sum()
+
+def fold(chunks):
+    tot = jnp.zeros((), jnp.float32)
+    for c in chunks:
+        tot = tot + kernel(jnp.asarray(c))   # stays on device
+    return float(tot)                        # one sync, after the loop
+"""
+
+
+def test_host_sync_fires_on_bad(tmp_path):
+    findings = _lint(tmp_path, _SYNC_BAD, HostSyncInFoldRule)
+    assert {f.rule for f in findings} == {"host-sync-in-fold"}
+    assert len(findings) == 4, [f.render() for f in findings]
+
+
+def test_host_sync_silent_on_good(tmp_path):
+    assert _lint(tmp_path, _SYNC_GOOD, HostSyncInFoldRule) == []
+
+
+_RECOMPILE_BAD = """
+import jax
+import jax.numpy as jnp
+
+def per_item(xs):
+    out = []
+    for x in xs:
+        out.append(jax.jit(lambda v: v + 1)(x))   # fresh wrapper per iter
+    return out
+
+@jax.jit
+def pad_to(x, n):
+    return x + jnp.zeros(n)                       # traced param as shape
+
+def make_step(m):
+    width = m * 2
+    @jax.jit
+    def step(x):
+        return x + jnp.ones(width)                # closure local as shape
+    return step
+"""
+
+_RECOMPILE_GOOD = """
+from functools import partial
+import jax
+import jax.numpy as jnp
+
+@partial(jax.jit, static_argnames=("n",))
+def pad_to(x, n):
+    return x + jnp.zeros(n)                       # static: cache per bucket
+
+@jax.jit
+def doubled(x):
+    n = x.shape[0]
+    return x + jnp.zeros(n)                       # operand-derived shape
+
+_WIDTH = 8
+
+@jax.jit
+def widened(x):
+    return x + jnp.ones(_WIDTH)                   # module constant: stable
+"""
+
+
+def test_recompile_hazard_fires_on_bad(tmp_path):
+    findings = _lint(tmp_path, _RECOMPILE_BAD, RecompileHazardRule)
+    assert {f.rule for f in findings} == {"recompile-hazard"}
+    scopes = {f.scope for f in findings}
+    assert "per_item" in scopes                  # jit-in-loop
+    assert "pad_to" in scopes                    # traced shape param
+    assert "make_step.step" in scopes            # closure shape capture
+    assert len(findings) == 3, [f.render() for f in findings]
+
+
+def test_recompile_hazard_silent_on_good(tmp_path):
+    assert _lint(tmp_path, _RECOMPILE_GOOD, RecompileHazardRule) == []
+
+
+_LEAK_BAD = """
+import jax
+
+_cache = None
+
+class Model:
+    @jax.jit
+    def step(self, x):
+        self.state = x * 2                        # tracer onto instance
+        return x
+
+@jax.jit
+def leak(x):
+    global _cache                                 # tracer into module state
+    _cache = x
+    return x
+"""
+
+_LEAK_GOOD = """
+import jax
+
+class Model:
+    @jax.jit
+    def _step(self, x):
+        return x * 2
+
+    def update(self, x):
+        self.state = self._step(x)   # store AFTER the jit boundary
+        return self.state
+"""
+
+
+def test_tracer_leak_fires_on_bad(tmp_path):
+    findings = _lint(tmp_path, _LEAK_BAD, TracerLeakRule)
+    assert {f.rule for f in findings} == {"tracer-leak"}
+    assert len(findings) == 2, [f.render() for f in findings]
+
+
+def test_tracer_leak_silent_on_good(tmp_path):
+    assert _lint(tmp_path, _LEAK_GOOD, TracerLeakRule) == []
+
+
+_UNSEEDED_BAD = """
+import numpy as np
+import jax
+import time
+
+def test_mean_is_small():
+    x = np.random.default_rng().normal(size=100)   # unseeded generator
+    assert abs(x.mean()) < 0.5
+
+def test_global_rng():
+    x = np.random.normal(size=100)                 # global numpy state
+    assert x.std() > 0
+
+def test_clock_key():
+    key = jax.random.key(int(time.time()))         # entropy-source key
+    assert jax.random.uniform(key) < 1.0
+"""
+
+_UNSEEDED_GOOD = """
+import numpy as np
+import jax
+
+def test_seeded():
+    x = np.random.default_rng(7).normal(size=100)  # pinned generator
+    key = jax.random.key(42)                       # pinned key
+    keys = [jax.random.key(7 + i) for i in range(3)]   # deterministic expr
+    assert abs(x.mean()) < 0.5 and len(keys) == 3 and key is not None
+
+def helper_without_asserts():
+    return np.random.normal(size=10)               # no assert in scope
+"""
+
+
+def test_unseeded_stochastic_fires_on_bad(tmp_path):
+    findings = _lint(tmp_path, _UNSEEDED_BAD, UnseededStochasticTestRule)
+    assert {f.rule for f in findings} == {"unseeded-stochastic-test"}
+    assert len(findings) == 3, [f.render() for f in findings]
+
+
+def test_unseeded_stochastic_silent_on_good(tmp_path):
+    assert _lint(tmp_path, _UNSEEDED_GOOD, UnseededStochasticTestRule) == []
+
+
+def test_every_rule_has_corpus_coverage():
+    """Each registered rule appears in this module's fixture corpus, so
+    adding a rule without tests fails loudly."""
+    covered = {"default-int64", "host-sync-in-fold", "recompile-hazard",
+               "tracer-leak", "unseeded-stochastic-test"}
+    assert {r.rule_id for r in ALL_RULES} == covered
+
+
+# ------------------------------------------------------- engine mechanics
+def test_markdown_fences_lint_with_real_line_numbers(tmp_path):
+    md = tmp_path / "tutorial.md"
+    md.write_text(
+        "# doc\n\nprose\n\n```python\nimport numpy as np\n"
+        "x = np.random.normal(size=5)\nassert x.std() > 0\n```\n")
+    findings = _lint(tmp_path, md.read_text(), UnseededStochasticTestRule,
+                     name="tutorial2.md")
+    assert len(findings) == 1
+    # the fence starts at line 5 of the md file; the draw is line 7
+    assert findings[0].line == 7
+    assert findings[0].path.endswith("tutorial2.md")
+
+
+def test_baseline_suppresses_and_goes_stale(tmp_path):
+    from avenir_tpu.analysis.engine import BaselineEntry
+
+    p = tmp_path / "mod.py"
+    p.write_text(_INT64_BAD)
+    key = "mod.py::default-int64::fold"
+    entry = BaselineEntry(key, "test justification", 1)
+    report = run_paths([str(p)], rules=[DefaultInt64Rule()],
+                       baseline=[entry], root=str(tmp_path))
+    assert not report.findings and len(report.suppressed) == 4
+
+    p.write_text(_INT64_GOOD)
+    report = run_paths([str(p)], rules=[DefaultInt64Rule()],
+                       baseline=[BaselineEntry(key, "test", 1)],
+                       root=str(tmp_path))
+    assert [e.key for e in report.stale] == [key]
+
+
+def test_baseline_file_requires_justifications(tmp_path):
+    from avenir_tpu.analysis.engine import load_baseline as load
+
+    bad = tmp_path / "baseline.txt"
+    bad.write_text("a.py::default-int64::f\n")
+    with pytest.raises(ValueError):
+        load(str(bad))
+    ok = tmp_path / "baseline2.txt"
+    ok.write_text("# comment\n\na.py::default-int64::f -- because\n")
+    entries = load(str(ok))
+    assert len(entries) == 1 and entries[0].justification == "because"
+
+
+# ------------------------------------------------------------------- CLI
+def _cli(args, cwd):
+    return subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "graftlint.py")] + args,
+        capture_output=True, text=True, cwd=cwd, timeout=120)
+
+
+def test_cli_exit_codes_and_json(tmp_path):
+    (tmp_path / "bad.py").write_text(_INT64_BAD)
+    proc = _cli(["bad.py", "--json"], str(tmp_path))
+    assert proc.returncode == 1, proc.stderr
+    rep = json.loads(proc.stdout)
+    assert rep["counts"] == {"default-int64": 4}
+    assert not rep["clean"]
+    assert all(k in rep["findings"][0]
+               for k in ("path", "line", "rule", "hint", "key"))
+
+    base = tmp_path / "allow.txt"
+    base.write_text("bad.py::default-int64::fold -- fixture\n")
+    proc = _cli(["bad.py", "--baseline", str(base), "--json"], str(tmp_path))
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert json.loads(proc.stdout)["suppressed"] == 4
+
+    (tmp_path / "good.py").write_text(_INT64_GOOD)
+    proc = _cli(["good.py", "--baseline", str(base)], str(tmp_path))
+    assert proc.returncode == 0   # entry targets an unscanned file: not stale
+    base.write_text("good.py::default-int64::fold -- now stale\n")
+    proc = _cli(["good.py", "--baseline", str(base)], str(tmp_path))
+    assert proc.returncode == 1 and "stale" in proc.stderr
+    proc = _cli(["good.py", "--baseline", str(base), "--allow-stale"],
+                str(tmp_path))
+    assert proc.returncode == 0
+
+
+def test_cli_rule_subset_and_unknown_rule(tmp_path):
+    (tmp_path / "bad.py").write_text(_SYNC_BAD)
+    proc = _cli(["bad.py", "--rules", "default-int64", "--no-baseline",
+                 "--json"], str(tmp_path))
+    assert proc.returncode == 0, proc.stdout   # sync findings filtered out
+    proc = _cli(["bad.py", "--rules", "nope"], str(tmp_path))
+    assert proc.returncode == 2
+
+
+def test_cli_rule_subset_does_not_stale_other_rules_entries():
+    """--rules tracer-leak must not report the default-int64/host-sync
+    baseline entries as stale (their rules didn't run)."""
+    proc = _cli(["avenir_tpu/", "--rules", "tracer-leak", "--json"], REPO)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert json.loads(proc.stdout)["stale_baseline_entries"] == []
+
+
+def test_cli_baseline_matches_from_any_cwd(tmp_path):
+    """Finding keys anchor to the repo root, not os.getcwd(): the gate
+    must pass no matter where the CLI is invoked from."""
+    proc = _cli([os.path.join(REPO, "avenir_tpu"), "--json"], str(tmp_path))
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    rep = json.loads(proc.stdout)
+    assert rep["clean"] and rep["suppressed"] >= 18
+
+
+def test_cli_package_gate_matches_inprocess_gate():
+    proc = _cli(["avenir_tpu/", "--json"], REPO)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    rep = json.loads(proc.stdout)
+    assert rep["clean"] and rep["findings"] == []

@@ -27,7 +27,7 @@ def _oracle_posteriors(ds):
     post = []
     prior = []
     for f in range(F):
-        pf = np.zeros((K, bins[f]))
+        pf = np.zeros((K, bins[f]), np.float64)
         for k in range(K):
             pf[k] = np.bincount(codes[y == k, f], minlength=bins[f])
         post.append(pf / np.maximum(pf.sum(1, keepdims=True), 1e-30))

@@ -337,7 +337,7 @@ def test_randomized_shape_sweep_vs_oracle():
             got_d[:, :kk], ref_d, rtol=3e-3, atol=1e-4,
             err_msg=f"trial {trial}: k={k} d={d} nq={nq} n_real={n_real}")
         # returned indices must point at rows whose true distance matches
-        rows = np.arange(nq)[:, None]
+        rows = np.arange(nq, dtype=np.int32)[:, None]
         np.testing.assert_allclose(
             full[rows, got_i[:, :kk]], got_d[:, :kk], rtol=3e-3, atol=1e-4)
         if kk < k:

@@ -1,0 +1,17 @@
+#!/usr/bin/env python
+"""graftlint launcher for source checkouts (no install needed):
+
+    python tools/graftlint.py avenir_tpu/ [--json] [--baseline FILE]
+
+Same entry point as the `graftlint` console script; see docs/graftlint.md
+for the rule catalog and allowlisting policy."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from avenir_tpu.analysis.cli import main  # noqa: E402
+
+if __name__ == "__main__":
+    sys.exit(main())
