@@ -8,12 +8,19 @@ worth rewriting as a statically recognizable class, not archaeology).
 Entry points:
   - ``python tools/graftlint.py <paths>`` / the ``graftlint`` console
     script (avenir_tpu.analysis.cli) — text or ``--json`` output;
-  - :func:`run_paths` — the in-process API (tests/test_graftlint.py runs
-    it over the whole package; bench_scaling.py tripwires on its counts);
+    ``graftlint --ir`` runs the IR layer instead of source paths;
+  - :func:`run_paths` — the in-process AST API (tests/test_graftlint.py
+    runs it over the whole package; bench_scaling.py tripwires on its
+    counts);
+  - ``avenir_tpu.analysis.ir.run_ir`` — the IR layer: jaxpr rules +
+    the distributed-family collective-payload audit over the kernel
+    manifest (``avenir_tpu.analysis.manifest``). Imported lazily, never
+    from this package root: AST mode must not pull in jax;
   - ``graftlint_baseline.txt`` — the allowlist: accepted findings keyed
-    by ``path::rule::scope`` with a one-line justification each.
+    by ``path::rule::scope`` with a one-line justification each, shared
+    by both modes.
 
-See docs/graftlint.md for the rule catalog and allowlisting policy.
+See docs/graftlint.md for the rule catalogs and allowlisting policy.
 """
 
 from avenir_tpu.analysis.engine import (Finding, Report, default_baseline_path,

@@ -1,9 +1,22 @@
 """graftlint CLI: `graftlint <paths>` (console script) or
 `python tools/graftlint.py <paths>`.
 
-Exit codes: 0 clean; 1 non-allowlisted findings, stale baseline entries,
-or parse errors; 2 usage/baseline-format errors. `--json` prints one
-machine-readable object (bench_scaling.py tripwires on its counts).
+Two modes sharing one report/baseline/exit contract:
+
+- AST (default): lint source paths with the rules.py catalog.
+- IR (``--ir``, no paths): trace the kernel manifest
+  (analysis/manifest.py), run the jaxpr rules and the collective-payload
+  audit (analysis/ir.py) on the virtual 8-device mesh.
+
+Exit-code contract (stable — bench_scaling.py and CI tripwire on it):
+  0  clean: no findings, no stale baseline entries, no parse errors
+  1  findings — non-allowlisted findings, stale baseline entries, or
+     parse errors in the linted sources
+  2  usage-or-trace-error — bad flags/baseline format/unreadable input,
+     or a manifest entry that failed to trace/lower (--ir)
+
+`--json` prints one machine-readable object either way (same schema:
+the `payload_audit` key is empty for AST runs).
 """
 
 from __future__ import annotations
@@ -22,10 +35,16 @@ from avenir_tpu.analysis.rules import ALL_RULES, rule_ids
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(
         prog="graftlint",
-        description="AST-based JAX/TPU hazard analyzer (rule catalog: "
+        description="AST + IR JAX/TPU hazard analyzer (rule catalog: "
                     "docs/graftlint.md)")
-    p.add_argument("paths", nargs="+",
-                   help=".py/.md files or directories to lint")
+    p.add_argument("paths", nargs="*",
+                   help=".py/.md files or directories to lint (omit with "
+                        "--ir)")
+    p.add_argument("--ir", action="store_true",
+                   help="lint the traceable-kernel manifest instead of "
+                        "source paths: jaxpr rules + the distributed-family "
+                        "collective-payload audit on the virtual 8-device "
+                        "mesh")
     p.add_argument("--baseline", default=None,
                    help="allowlist file (default: "
                         "avenir_tpu/analysis/graftlint_baseline.txt)")
@@ -34,7 +53,8 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--json", action="store_true", dest="as_json",
                    help="emit one JSON object instead of text")
     p.add_argument("--rules", default=None, metavar="ID[,ID...]",
-                   help=f"comma-separated subset of: {', '.join(rule_ids())}")
+                   help=f"comma-separated subset of: {', '.join(rule_ids())} "
+                        f"(or the ir-* ids with --ir)")
     p.add_argument("--no-md", action="store_true",
                    help="skip ```python fences in .md files")
     p.add_argument("--allow-stale", action="store_true",
@@ -43,18 +63,83 @@ def build_parser() -> argparse.ArgumentParser:
     return p
 
 
+def _bootstrap_ir_env() -> None:
+    """Pin a CPU platform with enough virtual devices for the audit mesh
+    BEFORE jax initializes (harmless no-op when the caller — e.g. the
+    tier-1 test process — already initialized a big-enough pool).
+
+    An inherited ``--xla_force_host_platform_device_count`` SMALLER than
+    the audit needs is raised, not honored: callers like bench_scaling
+    legitimately export a small pool for their own mesh, and inheriting
+    it would turn a clean audit into a spurious trace error.
+    ``GRAFTLINT_IR_DEVICES`` overrides the target pool size explicitly
+    (the too-small-pool CLI test uses it; a real run never should)."""
+    from avenir_tpu.analysis.manifest import AUDIT_DEVICES
+
+    if "jax" in sys.modules:
+        return                       # too late; run_ir checks the pool size
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    want = AUDIT_DEVICES
+    flag = "--xla_force_host_platform_device_count"
+    flags = []
+    for f in os.environ.get("XLA_FLAGS", "").split():
+        if f.startswith(flag):
+            try:
+                want = max(want, int(f.split("=", 1)[1]))
+            except (IndexError, ValueError):
+                pass
+        else:
+            flags.append(f)
+    override = os.environ.get("GRAFTLINT_IR_DEVICES")
+    if override is not None:
+        want = int(override)         # explicit override beats everything
+    flags.append(f"{flag}={want}")
+    os.environ["XLA_FLAGS"] = " ".join(flags)
+
+
+def _report_root(args) -> Optional[str]:
+    # finding keys must be cwd-independent so the baseline matches from
+    # anywhere: anchor them to the repo root (the default baseline sits at
+    # <root>/avenir_tpu/analysis/) or to an explicit baseline's directory
+    if args.baseline:
+        return os.path.dirname(os.path.abspath(args.baseline))
+    if args.no_baseline:
+        return None                  # cwd: keys are ephemeral anyway
+    return os.path.dirname(os.path.dirname(os.path.dirname(
+        default_baseline_path())))
+
+
 def main(argv: Optional[List[str]] = None) -> int:
-    args = build_parser().parse_args(argv)
+    parser = build_parser()
+    args = parser.parse_args(argv)
+    if args.ir and args.paths:
+        print("graftlint: --ir lints the kernel manifest; do not pass "
+              "paths (run the two modes as two invocations)",
+              file=sys.stderr)
+        return 2
+    if not args.ir and not args.paths:
+        print("graftlint: pass paths to lint, or --ir for the manifest "
+              "audit", file=sys.stderr)
+        return 2
+
+    if args.ir:
+        _bootstrap_ir_env()
+        from avenir_tpu.analysis.ir import (ALL_IR_RULES, IRTraceError,
+                                            ir_rule_ids, run_ir)
+        known = ir_rule_ids()
+    else:
+        known = rule_ids()
+
     if args.rules:
         wanted = [r.strip() for r in args.rules.split(",") if r.strip()]
-        unknown = set(wanted) - set(rule_ids())
+        unknown = set(wanted) - set(known)
         if unknown:
             print(f"graftlint: unknown rule(s): {', '.join(sorted(unknown))}",
                   file=sys.stderr)
             return 2
-        rules = [r() for r in ALL_RULES if r.rule_id in wanted]
     else:
-        rules = None
+        wanted = None
+
     try:
         baseline = ([] if args.no_baseline
                     else load_baseline(args.baseline or
@@ -63,23 +148,26 @@ def main(argv: Optional[List[str]] = None) -> int:
         print(f"graftlint: {e}", file=sys.stderr)
         return 2
 
-    # finding keys must be cwd-independent so the baseline matches from
-    # anywhere: anchor them to the repo root (the default baseline sits at
-    # <root>/avenir_tpu/analysis/) or to an explicit baseline's directory
-    if args.baseline:
-        root = os.path.dirname(os.path.abspath(args.baseline))
-    elif args.no_baseline:
-        root = None                      # cwd: keys are ephemeral anyway
+    if args.ir:
+        from avenir_tpu.analysis.ir import PAYLOAD_RULE
+        ir_rules = ([r() for r in ALL_IR_RULES] if wanted is None
+                    else [r() for r in ALL_IR_RULES if r.rule_id in wanted])
+        audit = wanted is None or PAYLOAD_RULE in wanted
+        try:
+            report = run_ir(rules=ir_rules, baseline=baseline, audit=audit)
+        except IRTraceError as e:
+            print(f"graftlint: trace error: {e}", file=sys.stderr)
+            return 2
     else:
-        root = os.path.dirname(os.path.dirname(os.path.dirname(
-            default_baseline_path())))
-
-    try:
-        report = run_paths(args.paths, rules=rules, baseline=baseline,
-                           root=root, include_md=not args.no_md)
-    except OSError as e:
-        print(f"graftlint: cannot read input: {e}", file=sys.stderr)
-        return 2
+        rules = (None if wanted is None
+                 else [r() for r in ALL_RULES if r.rule_id in wanted])
+        try:
+            report = run_paths(args.paths, rules=rules, baseline=baseline,
+                               root=_report_root(args),
+                               include_md=not args.no_md)
+        except OSError as e:
+            print(f"graftlint: cannot read input: {e}", file=sys.stderr)
+            return 2
 
     if args.as_json:
         print(json.dumps(report.to_json(), indent=1))
@@ -89,12 +177,19 @@ def main(argv: Optional[List[str]] = None) -> int:
         for e in report.stale:
             print(f"stale baseline entry (line {e.lineno}): {e.key} — the "
                   f"finding it excused is gone; delete it", file=sys.stderr)
-        print(f"graftlint: {len(report.scanned)} files, "
+        unit = "kernel modules" if args.ir else "files"
+        tail = ""
+        if report.payload_audit:
+            ok = sum(1 for a in report.payload_audit
+                     if a["payload_model_validated"])
+            tail = (f", payload audit {ok}/{len(report.payload_audit)} "
+                    f"families validated")
+        print(f"graftlint: {len(report.scanned)} {unit}, "
               f"{len(report.findings)} finding(s), "
               f"{len(report.suppressed)} allowlisted, "
               f"{len(report.stale)} stale baseline entr(y/ies)"
               + (f", {len(report.errors)} parse error(s)"
-                 if report.errors else ""))
+                 if report.errors else "") + tail)
 
     if report.findings or report.errors:
         return 1

@@ -67,13 +67,19 @@ class BaselineEntry:
 
 @dataclass
 class Report:
-    """One analyzer run: surviving findings + what the baseline absorbed."""
+    """One analyzer run: surviving findings + what the baseline absorbed.
+
+    `payload_audit` is filled only by IR runs (analysis/ir.py): one entry
+    per distributed family with its HLO-vs-analytic collective payload
+    verdict. AST runs leave it empty — the key is always present in the
+    JSON so downstream tripwires can parse one schema."""
 
     findings: List[Finding] = field(default_factory=list)
     suppressed: List[Finding] = field(default_factory=list)
     stale: List[BaselineEntry] = field(default_factory=list)
     scanned: List[str] = field(default_factory=list)
     errors: List[Finding] = field(default_factory=list)
+    payload_audit: List[dict] = field(default_factory=list)
 
     def counts(self) -> Dict[str, int]:
         out: Dict[str, int] = {}
@@ -93,6 +99,7 @@ class Report:
             "stale_baseline_entries": [e.key for e in self.stale],
             "errors": [f.to_json() for f in self.errors],
             "files_scanned": len(self.scanned),
+            "payload_audit": self.payload_audit,
             "clean": self.clean,
         }
 
@@ -408,8 +415,22 @@ def run_paths(paths: Sequence[str], rules: Optional[Sequence] = None,
         for rule in active:
             raw.extend(rule.check(ctx))
 
+    apply_baseline(report, raw, baseline, {r.rule_id for r in active})
+    return report
+
+
+def apply_baseline(report: Report, raw: Sequence[Finding],
+                   baseline: Optional[Sequence[BaselineEntry]],
+                   active_ids: Set[str]) -> Report:
+    """Split `raw` into surviving vs baseline-suppressed findings on
+    `report` (which already carries `scanned` and any errors), and flag
+    stale allowlist entries. Shared by the AST runner above and the IR
+    runner (analysis/ir.py) so both honor one baseline contract:
+    an entry is stale only when its file was scanned AND its rule was
+    active this run — a --rules subset must not condemn the rest of the
+    allowlist."""
     entries = list(baseline) if baseline is not None else []
-    by_key = {}
+    by_key: Dict[str, BaselineEntry] = {}
     for e in entries:
         by_key.setdefault(e.key, e)
     for f in sorted(raw, key=lambda f: (f.path, f.line, f.rule)):
@@ -419,11 +440,7 @@ def run_paths(paths: Sequence[str], rules: Optional[Sequence] = None,
             report.suppressed.append(f)
         else:
             report.findings.append(f)
-    # an entry is stale only when its file was scanned AND its rule was
-    # active this run — a --rules subset must not condemn the rest of the
-    # allowlist
     scanned = set(report.scanned)
-    active_ids = {r.rule_id for r in active}
     report.stale = [e for e in entries
                     if not e.used
                     and e.key.split("::")[0] in scanned

@@ -1,0 +1,290 @@
+"""graftlint-ir: jaxpr/HLO-level hazard analysis over the kernel manifest.
+
+The AST rules (rules.py) stop at what the source *says*; the regressions
+that cost this repo real scale-run hours live one layer down, in what
+tracing *produced*: dtype widenings the source never spelled, callbacks
+smuggled into scan bodies by a helper, host transfers inside fold loops,
+and collectives whose payloads drift from the analytic traffic model in
+`parallel/scaling.py`. This module walks the traced jaxpr of every
+manifest entry (analysis/manifest.py) for the first three, and — the
+headline — lowers every distributed family on the virtual 8-device mesh,
+parses the compiled HLO's collective instructions
+(`scaling.hlo_collective_payloads`) and asserts the summed payload bytes
+equal `scaling.collective_payload_model` per family. The same move XLA's
+own HLO verifier makes: pin the invariant at the IR, where no amount of
+source-level cleverness can hide a violation.
+
+Findings flow through the shared engine: keyed
+``path::rule::kernel-name`` against the same allowlist baseline, merged
+into a :class:`~avenir_tpu.analysis.engine.Report` whose
+``payload_audit`` lists each family's verdict. Entry point:
+``graftlint --ir`` (analysis/cli.py) or :func:`run_ir` in-process.
+
+A manifest entry that fails to trace/lower raises :class:`IRTraceError`
+— the CLI maps that to exit code 2 (usage-or-trace-error), distinct from
+exit 1 (findings): a broken trace means the *auditor* is broken, not
+that a hazard was found.
+"""
+
+from __future__ import annotations
+
+from typing import Iterator, List, Optional, Sequence, Set, Tuple
+
+from avenir_tpu.analysis.engine import (BaselineEntry, Finding, Report,
+                                        apply_baseline)
+from avenir_tpu.analysis.manifest import (AUDIT_DEVICES, KernelSpec,
+                                          manifest_entries)
+
+#: the audit's pseudo-rule id: payload mismatches surface as findings
+#: under it (allowlistable like any other, though the right fix is to
+#: correct the model or the kernel, never to excuse the drift)
+PAYLOAD_RULE = "ir-collective-payload"
+
+_CALLBACK_PRIMS = ("pure_callback", "io_callback", "debug_callback")
+_LOOP_PRIMS = ("scan", "while")
+
+
+class IRTraceError(RuntimeError):
+    """A manifest entry could not be traced or lowered."""
+
+
+# ----------------------------------------------------------- jaxpr walking
+def _jaxprs_in(value) -> Iterator:
+    """Jaxprs reachable from one eqn param value (ClosedJaxpr, raw Jaxpr,
+    or containers of either — scan's `jaxpr`, cond's `branches`, ...)."""
+    if hasattr(value, "eqns"):
+        yield value
+    elif hasattr(value, "jaxpr") and hasattr(value.jaxpr, "eqns"):
+        yield value.jaxpr
+    elif isinstance(value, (tuple, list)):
+        for v in value:
+            yield from _jaxprs_in(v)
+
+
+def iter_eqns(jaxpr, in_loop: bool = False) -> Iterator[Tuple[object, bool]]:
+    """Yield (eqn, in_loop) over `jaxpr` and every sub-jaxpr. `in_loop`
+    is True for eqns whose enclosing sub-jaxpr executes per-iteration of
+    a lax.scan / lax.while_loop (body AND cond: a cond-side callback
+    fires every trip too)."""
+    for eqn in jaxpr.eqns:
+        yield eqn, in_loop
+        loop = in_loop or eqn.primitive.name in _LOOP_PRIMS
+        for v in eqn.params.values():
+            for sub in _jaxprs_in(v):
+                yield from iter_eqns(sub, loop)
+
+
+# ------------------------------------------------------------------ rules
+class IRRule:
+    rule_id: str = ""
+    description: str = ""
+    hint: str = ""
+
+    def check(self, spec: KernelSpec, jaxpr) -> Iterator[Finding]:
+        raise NotImplementedError
+
+    def finding(self, spec: KernelSpec, message: str) -> Finding:
+        return Finding(spec.path, spec.line, self.rule_id, message,
+                       self.hint, spec.name)
+
+
+class Widen64BitRule(IRRule):
+    """64-bit element types anywhere in the traced program. The source
+    rules catch *lexical* int64 producers; this catches the ones tracing
+    introduces — x64 mode flipped on, a weak-typed Python scalar
+    promoting an op, a library helper converting under the covers. With
+    jax_enable_x64 off this should be structurally impossible, which is
+    exactly why it's worth pinning: a hit means the config or an
+    extension leaked wide dtypes into a hot kernel."""
+
+    rule_id = "ir-widen-64bit"
+    description = "64-bit dtype in a traced kernel (absent from the source)"
+    hint = ("trace with jax_enable_x64 off; narrow the producing operand "
+            "(int32/float32) or cast at the host boundary, not in-kernel")
+
+    def check(self, spec: KernelSpec, jaxpr) -> Iterator[Finding]:
+        seen: Set[Tuple[str, str]] = set()
+        for eqn, _ in iter_eqns(jaxpr):
+            wide = []
+            if eqn.primitive.name == "convert_element_type":
+                dt = eqn.params.get("new_dtype")
+                if dt is not None and getattr(dt, "itemsize", 0) == 8:
+                    wide.append(str(dt))
+            for o in eqn.outvars:
+                dt = getattr(getattr(o, "aval", None), "dtype", None)
+                if dt is not None and dt.itemsize == 8:
+                    wide.append(str(dt))
+            for dt in wide:
+                key = (eqn.primitive.name, dt)
+                if key in seen:
+                    continue
+                seen.add(key)
+                yield self.finding(
+                    spec,
+                    f"traced `{spec.name}` materializes {dt} through "
+                    f"`{eqn.primitive.name}` — a 64-bit temporary the "
+                    f"source never spelled")
+
+
+class CallbackInLoopRule(IRRule):
+    """pure_callback / io_callback / debug_callback inside a scan or
+    while body. Each fires a host round-trip per iteration — inside the
+    miners' folds that is the double-buffered overlap silently gone, and
+    on TPU a per-step infeed/outfeed stall."""
+
+    rule_id = "ir-callback-in-loop"
+    description = "host callback inside a scan/while body"
+    hint = ("hoist the callback out of the loop (accumulate on device, "
+            "call once after), or make it a post-hoc pass over the "
+            "stacked scan outputs")
+
+    def check(self, spec: KernelSpec, jaxpr) -> Iterator[Finding]:
+        for eqn, in_loop in iter_eqns(jaxpr):
+            if in_loop and eqn.primitive.name in _CALLBACK_PRIMS:
+                yield self.finding(
+                    spec,
+                    f"`{eqn.primitive.name}` inside a scan/while body of "
+                    f"traced `{spec.name}`: one host round-trip per "
+                    f"iteration")
+
+
+class HostTransferInLoopRule(IRRule):
+    """device_put inside a scan/while body: a per-iteration placement/
+    transfer op in the fold path (jax.device_get cannot appear in a
+    jaxpr — it forces concretization at trace time and the tracer-leak
+    AST rule owns that shape)."""
+
+    rule_id = "ir-host-transfer-in-loop"
+    description = "device_put inside a scan/while body"
+    hint = ("place operands before the loop (device_put once, scan over "
+            "device-resident arrays); inside the trace jnp.asarray is "
+            "free and sufficient")
+
+    def check(self, spec: KernelSpec, jaxpr) -> Iterator[Finding]:
+        for eqn, in_loop in iter_eqns(jaxpr):
+            if in_loop and eqn.primitive.name == "device_put":
+                yield self.finding(
+                    spec,
+                    f"`device_put` inside a scan/while body of traced "
+                    f"`{spec.name}`: per-iteration transfer in a fold path")
+
+
+ALL_IR_RULES = [Widen64BitRule, CallbackInLoopRule, HostTransferInLoopRule]
+
+
+def ir_rule_ids() -> List[str]:
+    return [r.rule_id for r in ALL_IR_RULES] + [PAYLOAD_RULE]
+
+
+# -------------------------------------------------------------- execution
+def check_jaxpr(spec: KernelSpec, jaxpr,
+                rules: Optional[Sequence[IRRule]] = None) -> List[Finding]:
+    """Run the jaxpr rules over one traced kernel (fixture corpus entry
+    point: tests hand-trace bad/good snippets and feed them here)."""
+    active = list(rules) if rules is not None else [r() for r in ALL_IR_RULES]
+    out: List[Finding] = []
+    for rule in active:
+        out.extend(rule.check(spec, jaxpr))
+    return out
+
+
+def _audit_mesh(spec: KernelSpec, devices):
+    from avenir_tpu.parallel.mesh import data_mesh
+
+    return data_mesh(devices[:AUDIT_DEVICES],
+                     model_parallel=spec.model_parallel)
+
+
+def audit_family(spec: KernelSpec, devices) -> Tuple[dict, Optional[Finding]]:
+    """Lower one distributed family on the audit mesh, extract its
+    collective payload bytes from compiled HLO, and compare against the
+    analytic model. Returns (audit row, mismatch finding or None)."""
+    mesh = _audit_mesh(spec, devices)
+    fn, args = spec.build(mesh)
+    return _audit_built(spec, mesh, fn, args)
+
+
+def _audit_built(spec: KernelSpec, mesh, fn, args
+                 ) -> Tuple[dict, Optional[Finding]]:
+    """Audit body over an already-built (fn, args) — run_ir reuses the
+    pair it traced so each family is constructed exactly once."""
+    from avenir_tpu.parallel.scaling import hlo_collective_payloads
+
+    try:
+        compiled = fn.lower(*args).compile()
+    except Exception as e:
+        raise IRTraceError(
+            f"{spec.name}: could not lower on the "
+            f"{dict(mesh.shape)} mesh: {e!r}") from e
+    ops = hlo_collective_payloads(compiled.as_text())
+    got = sum(o["payload_bytes"] for o in ops)
+    want = int(spec.payload_model(mesh))
+    audit = {
+        "family": spec.name,
+        "mesh": {k: int(v) for k, v in mesh.shape.items()},
+        "collectives": ops,
+        "hlo_payload_bytes": got,
+        "analytic_payload_bytes": want,
+        "payload_model_validated": got == want,
+    }
+    finding = None
+    if got != want:
+        finding = Finding(
+            spec.path, spec.line, PAYLOAD_RULE,
+            f"family `{spec.name}` ships {got} collective bytes on the "
+            f"{dict(mesh.shape)} mesh; the scaling.py model says {want} — "
+            f"the traffic model (and every projection built on it) is "
+            f"stale",
+            "re-derive scaling.collective_payload_model for this family "
+            "(or fix the kernel if XLA is reducing more than intended)",
+            spec.name)
+    return audit, finding
+
+
+def run_ir(rules: Optional[Sequence[IRRule]] = None,
+           baseline: Optional[Sequence[BaselineEntry]] = None,
+           entries: Optional[Sequence[KernelSpec]] = None,
+           audit: bool = True) -> Report:
+    """Trace every manifest entry, run the jaxpr rules, audit every
+    family's collective payload, and apply the allowlist baseline.
+
+    Needs >= AUDIT_DEVICES jax devices (the test harness and the CLI both
+    pin an 8-device virtual CPU pool); raises IRTraceError otherwise so
+    the CLI can exit 2 instead of reporting a half-audited manifest as
+    clean."""
+    import jax
+
+    devices = jax.devices()
+    if len(devices) < AUDIT_DEVICES:
+        raise IRTraceError(
+            f"the collective-payload audit needs {AUDIT_DEVICES} devices, "
+            f"found {len(devices)}; run under JAX_PLATFORMS=cpu with "
+            f"XLA_FLAGS=--xla_force_host_platform_device_count="
+            f"{AUDIT_DEVICES} (the graftlint CLI sets this up when jax "
+            f"is not yet initialized)")
+    specs = list(entries) if entries is not None else manifest_entries()
+    active = list(rules) if rules is not None else [r() for r in ALL_IR_RULES]
+    report = Report()
+    raw: List[Finding] = []
+    for spec in specs:
+        if spec.path not in report.scanned:
+            report.scanned.append(spec.path)
+        mesh = _audit_mesh(spec, devices) if spec.is_family else None
+        try:
+            fn, args = spec.build(mesh)
+            jaxpr = jax.make_jaxpr(fn)(*args)
+        except IRTraceError:
+            raise
+        except Exception as e:
+            raise IRTraceError(f"{spec.name}: could not trace: {e!r}") from e
+        raw.extend(check_jaxpr(spec, jaxpr, active))
+        if audit and spec.is_family:
+            row, finding = _audit_built(spec, mesh, fn, args)
+            report.payload_audit.append(row)
+            if finding is not None:
+                raw.append(finding)
+    active_ids = {r.rule_id for r in active}
+    if audit:
+        active_ids.add(PAYLOAD_RULE)
+    apply_baseline(report, raw, baseline, active_ids)
+    return report

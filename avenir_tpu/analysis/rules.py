@@ -346,8 +346,173 @@ class UnseededStochasticTestRule(Rule):
                     f"asserts on the result")
 
 
+class ShardedHostMaterializeRule(Rule):
+    """np.asarray / np.array / np.copy applied to a device-placed array
+    (a direct jax.device_put(...) result, or a name bound from
+    jax.device_put / mesh.shard_rows / mesh.replicated in the same
+    module). Materializing a sharded array on the host gathers EVERY
+    shard through one process — the all-to-one transfer the mesh layer
+    exists to avoid — and on multi-host meshes it deadlocks outright
+    (non-addressable shards). Lexical, like every rule here: values that
+    become sharded through a mesh kernel's return slip past, but the
+    placement-then-materialize shape is the one that has actually
+    appeared in review."""
+
+    rule_id = "sharded-host-materialize"
+    description = "np.asarray/np.array of a device-placed (sharded) array"
+    hint = ("keep the consumer on device (jnp ops see sharded arrays "
+            "natively), or jax.device_get once after the last device step "
+            "— never re-wrap a device_put result with host numpy")
+
+    WRAPPERS = {"numpy.asarray", "numpy.array", "numpy.copy"}
+    PLACERS_DOTTED = {"jax.device_put"}
+    # mesh-layer placement helpers, recognized by tail name so both
+    # `from ..mesh import shard_rows` and `mesh.shard_rows(...)` match
+    PLACER_TAILS = {"device_put", "shard_rows", "replicated"}
+
+    def _is_placer(self, ctx: ModuleContext, call: ast.Call) -> bool:
+        name = ctx.dotted(call.func)
+        if name in self.PLACERS_DOTTED:
+            return True
+        return (name is not None
+                and name.rpartition(".")[2] in self.PLACER_TAILS)
+
+    def _placed_names(self, ctx: ModuleContext) -> Set[str]:
+        """Names bound (anywhere in the module) from a placement call —
+        including tuple-to-tuple unpacks like `a, b = put(x), put(y)`."""
+        out: Set[str] = set()
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Assign) or len(node.targets) != 1:
+                continue
+            pairs = []
+            tgt, val = node.targets[0], node.value
+            if isinstance(tgt, ast.Name):
+                pairs.append((tgt, val))
+            elif isinstance(tgt, ast.Tuple) and isinstance(val, ast.Tuple) \
+                    and len(tgt.elts) == len(val.elts):
+                pairs.extend(zip(tgt.elts, val.elts))
+            for t, v in pairs:
+                if isinstance(t, ast.Name) and isinstance(v, ast.Call) \
+                        and self._is_placer(ctx, v):
+                    out.add(t.id)
+        return out
+
+    def _feeds_placement(self, ctx: ModuleContext, node: ast.AST) -> bool:
+        """True when `node` sits inside a placer call's arguments — e.g.
+        ``shard_rows(mesh, np.asarray(x))``: that asarray PREPARES the
+        placement (flow runs host->device), it doesn't materialize a
+        placed value."""
+        cur = ctx.parent(node)
+        while cur is not None:
+            if isinstance(cur, ast.Call) and self._is_placer(ctx, cur):
+                return True
+            cur = ctx.parent(cur)
+        return False
+
+    def check(self, ctx: ModuleContext) -> Iterator[Finding]:
+        placed = self._placed_names(ctx)
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call) or not node.args:
+                continue
+            if ctx.dotted(node.func) not in self.WRAPPERS:
+                continue
+            if self._feeds_placement(ctx, node):
+                continue
+            arg = node.args[0]
+            if isinstance(arg, ast.Call) and self._is_placer(ctx, arg):
+                yield self.finding(
+                    ctx, node,
+                    "host materialization of a jax.device_put result: "
+                    "every shard transfers back through this process")
+            elif isinstance(arg, ast.Name) and arg.id in placed:
+                yield self.finding(
+                    ctx, node,
+                    f"np wrapper over `{arg.id}` (device-placed above) "
+                    f"gathers all shards to host")
+
+
+class Int64LiteralInJnpRule(Rule):
+    """A Python int literal outside int32 range flowing into a jax.numpy
+    call. With jax_enable_x64 off (this repo never sets it) such a
+    literal either raises OverflowError at runtime or silently truncates
+    through a weak-typed promotion — both discovered at the worst time,
+    on device, mid-stream. Folds constant int arithmetic (<<, **, *, +,
+    -, |) so `1 << 40` and `2**40` are caught, not just spelled-out
+    literals."""
+
+    rule_id = "int64-literal-in-jnp"
+    description = "int literal beyond int32 range in a jnp call"
+    hint = ("keep 64-bit id/hash math in host numpy (np.int64 arrays) and "
+            "hand the device narrow codes, or split the constant into "
+            "32-bit halves before it reaches jnp")
+
+    _INT32_MAX = 2 ** 31 - 1
+    _OPS = {ast.LShift: lambda a, b: a << b, ast.Pow: lambda a, b: a ** b,
+            ast.Mult: lambda a, b: a * b, ast.Add: lambda a, b: a + b,
+            ast.Sub: lambda a, b: a - b, ast.BitOr: lambda a, b: a | b}
+
+    def _fold(self, node: ast.AST) -> Optional[int]:
+        """Constant-fold small int expressions; None when not constant."""
+        if isinstance(node, ast.Constant):
+            return node.value if type(node.value) is int else None
+        if isinstance(node, ast.UnaryOp) and isinstance(node.op, ast.USub):
+            v = self._fold(node.operand)
+            return -v if v is not None else None
+        if isinstance(node, ast.BinOp):
+            fn = self._OPS.get(type(node.op))
+            if fn is None:
+                return None
+            a, b = self._fold(node.left), self._fold(node.right)
+            if a is None or b is None:
+                return None
+            if isinstance(node.op, ast.Pow) and (abs(a) > 64 or b > 64):
+                return None          # keep folding cheap and bounded
+            try:
+                return fn(a, b)
+            except (OverflowError, ValueError):
+                return None
+        return None
+
+    @staticmethod
+    def _walk_pruning_calls(root: ast.AST) -> Iterator[ast.AST]:
+        """Walk `root` WITHOUT descending into nested calls — a literal
+        inside `np.asarray(1 << 40)` belongs to that (host) call, which
+        is judged on its own if it's a jnp one."""
+        stack = [root]
+        while stack:
+            node = stack.pop()
+            yield node
+            stack.extend(c for c in ast.iter_child_nodes(node)
+                         if not isinstance(c, ast.Call))
+
+    def check(self, ctx: ModuleContext) -> Iterator[Finding]:
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = ctx.dotted(node.func)
+            if name is None or not name.startswith("jax.numpy."):
+                continue
+            exprs = list(node.args) + [kw.value for kw in node.keywords]
+            for e in exprs:
+                for sub in self._walk_pruning_calls(e):
+                    v = self._fold(sub)
+                    # only report the outermost folded expression: a
+                    # parent BinOp that folded already covers its leaves
+                    parent = ctx.parent(sub)
+                    if v is not None and abs(v) > self._INT32_MAX \
+                            and (not isinstance(parent, (ast.BinOp,
+                                                         ast.UnaryOp))
+                                 or self._fold(parent) is None):
+                        yield self.finding(
+                            ctx, sub if hasattr(sub, "lineno") else node,
+                            f"int constant {v} exceeds int32 range inside "
+                            f"`{name}`: with x64 disabled this overflows "
+                            f"or silently truncates on device")
+
+
 ALL_RULES = [DefaultInt64Rule, HostSyncInFoldRule, RecompileHazardRule,
-             TracerLeakRule, UnseededStochasticTestRule]
+             TracerLeakRule, UnseededStochasticTestRule,
+             ShardedHostMaterializeRule, Int64LiteralInJnpRule]
 
 
 def rule_ids() -> List[str]:

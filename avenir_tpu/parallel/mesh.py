@@ -24,6 +24,22 @@ DATA_AXIS = "data"
 MODEL_AXIS = "model"
 
 
+def shard_map(f, mesh: Mesh, in_specs, out_specs):
+    """Version-portable shard_map with replication checking off.
+
+    ``jax.shard_map(..., check_vma=False)`` only exists on newer JAX; on
+    0.4.x the same program spells ``jax.experimental.shard_map.shard_map
+    (..., check_rep=False)``. Every mesh kernel in this package routes
+    through here so the sharding programs build identically on both."""
+    if hasattr(jax, "shard_map"):
+        return jax.shard_map(f, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs, check_vma=False)
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    return _shard_map(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                      check_rep=False)
+
+
 def data_mesh(devices: Optional[Sequence] = None,
               model_parallel: int = 1) -> Mesh:
     """A (data[, model]) mesh over the given (default: all) devices.
@@ -87,5 +103,5 @@ def sharded_keyed_count(
         local = count_fn(*args)
         return jax.tree.map(lambda t: jax.lax.psum(t, DATA_AXIS), local)
 
-    fn = jax.shard_map(wrapped, mesh=mesh, in_specs=P(DATA_AXIS), out_specs=P())
+    fn = shard_map(wrapped, mesh=mesh, in_specs=P(DATA_AXIS), out_specs=P())
     return jax.jit(fn)

@@ -31,12 +31,65 @@ from avenir_tpu.parallel.mesh import DATA_AXIS, MODEL_AXIS, data_mesh
 _NB_CLASSES, _NB_FEAT, _NB_BMAX = 2, 8, 10
 
 
+def collective_payload_model(family: str, mesh_shape: Dict[str, int],
+                             **dims: int) -> int:
+    """Analytic collective payload (bytes) of ONE step of a distributed
+    family from `parallel/distributed.py` on a mesh of shape `mesh_shape`.
+
+    This is the single source of truth the IR-level auditor
+    (`analysis/ir.py`) asserts compiled HLO against, per family. "Payload"
+    means the summed byte size of every collective instruction's result
+    shapes — exactly what :func:`hlo_collective_payloads` extracts — so
+    model and measurement count the same thing regardless of how XLA's
+    combiner fuses or splits the ops.
+
+    Family keys match ``distributed.FAMILIES``; `dims` are the family's
+    workload dimensions (the manifest pins concrete values):
+
+    - ``nb_train``:     psum of [F, K, B] f32 counts + [K] f32 class counts
+    - ``knn_topk``:     two tiled all-gathers over 'model' of the per-query
+                        candidate merge: [nq/data, model*k] f32 + i32
+                        (0 when the mesh has no model axis — no collective)
+    - ``tree_level``:   psum of the [L, NS, S, K] f32 level histogram
+    - ``lr_step``:      psum of the [D] f32 gradient + f32 weight total
+    - ``markov_counts``: psum of [C, S, S] f32 bigram counts
+    - ``apriori_support``: psum of [C] s32 candidate supports
+    - ``bandit_select``: 0 — the map-only per-group job has no collective
+    - ``crosscount``:   psum of the [A, B] f32 contingency table
+    """
+    data_n = mesh_shape.get(DATA_AXIS, 1)
+    model_n = mesh_shape.get(MODEL_AXIS, 1)
+    if family == "nb_train":
+        return (dims["n_feat"] * dims["num_classes"] * dims["bmax"]
+                + dims["num_classes"]) * 4
+    if family == "knn_topk":
+        if model_n <= 1:
+            return 0
+        return (dims["nq"] // data_n) * model_n * dims["k"] * (4 + 4)
+    if family == "tree_level":
+        return (dims["n_leaves"] * dims["n_splits"] * dims["smax"]
+                * dims["num_classes"]) * 4
+    if family == "lr_step":
+        return (dims["d"] + 1) * 4
+    if family == "markov_counts":
+        return dims["n_classes"] * dims["n_states"] * dims["n_states"] * 4
+    if family == "apriori_support":
+        return dims["n_cand"] * 4
+    if family == "bandit_select":
+        return 0
+    if family == "crosscount":
+        return dims["bins_a"] * dims["bins_b"] * 4
+    raise KeyError(f"no analytic payload model for family {family!r}")
+
+
 def nb_payload_bytes() -> int:
     """All-reduce payload of the weak-scaling NB step: the [F, K, B] count
     tensor + [K] class counts in f32. The single source of the number the
     compiled-HLO check validates and the projections consume (bench.py,
     tests)."""
-    return (_NB_FEAT * _NB_CLASSES * _NB_BMAX + _NB_CLASSES) * 4
+    return collective_payload_model(
+        "nb_train", {}, n_feat=_NB_FEAT, num_classes=_NB_CLASSES,
+        bmax=_NB_BMAX)
 
 
 def _timed_scalar(many_fn, *args) -> float:
@@ -226,7 +279,8 @@ def _knn_compiled_collectives(mesh, k: int = 5) -> Tuple[List[Dict], int]:
                        NamedSharding(mesh, P(MODEL_AXIS))),
     ]
     compiled = step.lower(*args).compile()
-    analytic = (nq // data_n) * model_n * k * (4 + 4)
+    analytic = collective_payload_model(
+        "knn_topk", dict(mesh.shape), nq=nq, k=k)
     return hlo_collective_payloads(compiled.as_text()), analytic
 
 

@@ -561,10 +561,16 @@ def random_forest(cfg: JobConfig, inputs: List[str], output: str) -> JobResult:
 
 
 @job("classPartitionGenerator", "cpg",
-     "org.avenir.explore.ClassPartitionGenerator")
+     "org.avenir.explore.ClassPartitionGenerator",
+     "splitGenerator", "org.avenir.tree.SplitGenerator")
 def class_partition_job(cfg: JobConfig, inputs: List[str], output: str) -> JobResult:
     """Candidate-split class-histogram stats (cpg.* keys; the reference's
-    two-job tree flow stage, ClassPartitionGenerator.java:61)."""
+    two-job tree flow stage, ClassPartitionGenerator.java:61).
+
+    Also answers to org.avenir.tree.SplitGenerator — the tree package's
+    candidate-split stats base job (DecisionTreeBuilder extends it, which
+    is how it slipped the original implements-Tool addressability scan:
+    the Tool surface is inherited, not spelled in the subclass source)."""
     from avenir_tpu.models.explore import ClassPartitionGenerator
 
     ds = _dataset(inputs[0], cfg)

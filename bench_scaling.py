@@ -26,29 +26,47 @@ import tempfile
 
 
 def graftlint_tripwire() -> dict:
-    """Run the graftlint CLI (--json) over the package and fail the bench
-    on any non-allowlisted finding or stale baseline entry — hazard-count
-    regressions surface here every round, not at the next 100M-row run."""
+    """Run the graftlint CLI (--json) over the package AND the --ir
+    manifest audit, failing the bench on any non-allowlisted finding,
+    stale baseline entry, trace error, or a distributed family whose
+    collective payload drifted off the scaling.py analytic model —
+    hazard/traffic regressions surface here every round, not at the next
+    100M-row run."""
     import os
     import subprocess
 
     root = os.path.dirname(os.path.abspath(__file__))
-    proc = subprocess.run(
-        [sys.executable, os.path.join(root, "tools", "graftlint.py"),
-         os.path.join(root, "avenir_tpu"), "--json"],
-        capture_output=True, text=True, cwd=root, timeout=300)
-    try:
-        rep = json.loads(proc.stdout)
-    except ValueError:
+
+    def run(extra, what):
+        proc = subprocess.run(
+            [sys.executable, os.path.join(root, "tools", "graftlint.py")]
+            + extra + ["--json"],
+            capture_output=True, text=True, cwd=root, timeout=600)
+        try:
+            rep = json.loads(proc.stdout)
+        except ValueError:
+            raise RuntimeError(
+                f"graftlint {what} emitted no JSON "
+                f"(rc={proc.returncode}): {proc.stderr[-400:]}")
+        if proc.returncode != 0 or not rep.get("clean"):
+            raise RuntimeError(
+                f"graftlint {what} regression: counts={rep.get('counts')} "
+                f"stale={rep.get('stale_baseline_entries')} "
+                f"errors={len(rep.get('errors', []))}")
+        return rep
+
+    ast_rep = run([os.path.join(root, "avenir_tpu")], "AST")
+    ir_rep = run(["--ir"], "--ir")
+    audit = ir_rep["payload_audit"]
+    bad = [a["family"] for a in audit if not a["payload_model_validated"]]
+    if bad or len(audit) < 8:
         raise RuntimeError(
-            f"graftlint --json emitted no JSON: {proc.stderr[-400:]}")
-    if proc.returncode != 0 or not rep.get("clean"):
-        raise RuntimeError(
-            f"graftlint regression: counts={rep.get('counts')} "
-            f"stale={rep.get('stale_baseline_entries')} "
-            f"errors={len(rep.get('errors', []))}")
-    return {"files": rep["files_scanned"], "findings": 0,
-            "allowlisted": rep["suppressed"]}
+            f"collective payload audit regression: "
+            f"{len(audit)} families audited, drifted={bad}")
+    return {"files": ast_rep["files_scanned"], "findings": 0,
+            "allowlisted": ast_rep["suppressed"],
+            "ir_findings": 0,
+            "payload_families_validated": len(audit)}
 
 
 def miner_tripwire(rows: int = 20_000) -> dict:

@@ -19,7 +19,10 @@ import pytest
 from avenir_tpu.analysis import load_baseline, run_paths
 from avenir_tpu.analysis.rules import (ALL_RULES, DefaultInt64Rule,
                                        HostSyncInFoldRule,
-                                       RecompileHazardRule, TracerLeakRule,
+                                       Int64LiteralInJnpRule,
+                                       RecompileHazardRule,
+                                       ShardedHostMaterializeRule,
+                                       TracerLeakRule,
                                        UnseededStochasticTestRule)
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -291,11 +294,83 @@ def test_unseeded_stochastic_silent_on_good(tmp_path):
     assert _lint(tmp_path, _UNSEEDED_GOOD, UnseededStochasticTestRule) == []
 
 
+_SHARDED_BAD = """
+import jax
+import numpy as np
+from avenir_tpu.parallel.mesh import shard_rows
+
+def gather(mesh, arr, spec):
+    xs = shard_rows(mesh, arr)
+    host = np.asarray(xs)                          # gathers every shard
+    direct = np.array(jax.device_put(arr, spec))   # direct wrap
+    return host.sum() + direct.sum()
+"""
+
+_SHARDED_GOOD = """
+import jax
+import jax.numpy as jnp
+import numpy as np
+from avenir_tpu.parallel.mesh import shard_rows
+
+def fine(mesh, arr, spec):
+    xs = shard_rows(mesh, np.asarray(arr))   # prepares placement: host->dev
+    on_dev = jnp.asarray(xs)                 # jnp view of a device array
+    host = jax.device_get(xs)                # the sanctioned transfer
+    plain = np.array(arr)                    # plain host array
+    return on_dev.sum() + host.sum() + plain.sum()
+"""
+
+
+def test_sharded_host_materialize_fires_on_bad(tmp_path):
+    findings = _lint(tmp_path, _SHARDED_BAD, ShardedHostMaterializeRule)
+    assert {f.rule for f in findings} == {"sharded-host-materialize"}
+    assert len(findings) == 2, [f.render() for f in findings]
+    assert all(f.scope == "gather" for f in findings)
+
+
+def test_sharded_host_materialize_silent_on_good(tmp_path):
+    assert _lint(tmp_path, _SHARDED_GOOD, ShardedHostMaterializeRule) == []
+
+
+_BIGLIT_BAD = """
+import jax.numpy as jnp
+
+def encode(ids):
+    base = jnp.full((4,), 10_000_000_000)    # spelled-out wide literal
+    mask = jnp.asarray(1 << 40)              # folded shift
+    scale = jnp.array([2 ** 40])             # folded power inside a list
+    return base + mask + scale
+"""
+
+_BIGLIT_GOOD = """
+import jax.numpy as jnp
+import numpy as np
+
+def fine(ids):
+    small = jnp.full((4,), 1 << 20)          # fits int32
+    host = np.asarray([1 << 40])             # host numpy is 64-bit land
+    f = jnp.asarray(2.5e12)                  # float literal, not an int
+    nested = jnp.asarray(np.asarray([1 << 40]) & 0xFF)   # literal lives in
+    return small.sum() + host.sum() + f + nested.sum()   # the host call
+"""
+
+
+def test_int64_literal_fires_on_bad(tmp_path):
+    findings = _lint(tmp_path, _BIGLIT_BAD, Int64LiteralInJnpRule)
+    assert {f.rule for f in findings} == {"int64-literal-in-jnp"}
+    assert len(findings) == 3, [f.render() for f in findings]
+
+
+def test_int64_literal_silent_on_good(tmp_path):
+    assert _lint(tmp_path, _BIGLIT_GOOD, Int64LiteralInJnpRule) == []
+
+
 def test_every_rule_has_corpus_coverage():
     """Each registered rule appears in this module's fixture corpus, so
     adding a rule without tests fails loudly."""
     covered = {"default-int64", "host-sync-in-fold", "recompile-hazard",
-               "tracer-leak", "unseeded-stochastic-test"}
+               "tracer-leak", "unseeded-stochastic-test",
+               "sharded-host-materialize", "int64-literal-in-jnp"}
     assert {r.rule_id for r in ALL_RULES} == covered
 
 
@@ -409,3 +484,59 @@ def test_cli_package_gate_matches_inprocess_gate():
     assert proc.returncode == 0, proc.stdout + proc.stderr
     rep = json.loads(proc.stdout)
     assert rep["clean"] and rep["findings"] == []
+
+
+def test_json_output_matches_golden(tmp_path):
+    """Golden-file check of the --json schema: downstream tripwires
+    (bench_scaling.graftlint_tripwire, CI) parse these exact keys, so a
+    schema drift must fail a test, not a bench run three rounds later.
+    The golden file is the FULL object for a fixed fixture — keys, value
+    types, and stable values."""
+    (tmp_path / "bad.py").write_text(_INT64_BAD)
+    proc = _cli(["bad.py", "--no-baseline", "--json"], str(tmp_path))
+    assert proc.returncode == 1, proc.stderr
+    got = json.loads(proc.stdout)
+    golden_path = os.path.join(REPO, "tests", "data",
+                               "graftlint_json_golden.json")
+    golden = json.load(open(golden_path))
+    assert got == golden, (
+        f"--json schema drifted from {golden_path}; if the change is "
+        f"intentional, update the golden file AND every consumer "
+        f"(bench_scaling.graftlint_tripwire)")
+
+
+def test_baseline_stale_roundtrip_cli(tmp_path):
+    """The full allowlist lifecycle through the CLI: finding (exit 1) ->
+    baselined (exit 0) -> code fixed, entry stale (exit 1) -> entry
+    deleted (exit 0). Each transition is the exit-code contract's '1'
+    meaning something different, so pin all four."""
+    src = tmp_path / "mod.py"
+    base = tmp_path / "allow.txt"
+    src.write_text(_INT64_BAD)
+    base.write_text("")
+    assert _cli(["mod.py", "--baseline", str(base)],
+                str(tmp_path)).returncode == 1
+    base.write_text("mod.py::default-int64::fold -- accepted for the test\n")
+    assert _cli(["mod.py", "--baseline", str(base)],
+                str(tmp_path)).returncode == 0
+    src.write_text(_INT64_GOOD)                     # hazard fixed
+    proc = _cli(["mod.py", "--baseline", str(base)], str(tmp_path))
+    assert proc.returncode == 1 and "stale" in proc.stderr
+    base.write_text("")                             # entry deleted
+    assert _cli(["mod.py", "--baseline", str(base)],
+                str(tmp_path)).returncode == 0
+
+
+def test_cli_exit_code_contract(tmp_path):
+    """0 clean / 1 findings / 2 usage-or-trace-error — stable for CI."""
+    (tmp_path / "good.py").write_text(_INT64_GOOD)
+    (tmp_path / "bad.py").write_text(_INT64_BAD)
+    assert _cli(["good.py", "--no-baseline"], str(tmp_path)).returncode == 0
+    assert _cli(["bad.py", "--no-baseline"], str(tmp_path)).returncode == 1
+    # usage errors: no paths / unknown rule / malformed baseline
+    assert _cli([], str(tmp_path)).returncode == 2
+    assert _cli(["good.py", "--rules", "nope"], str(tmp_path)).returncode == 2
+    bad_base = tmp_path / "broken.txt"
+    bad_base.write_text("no-justification-here\n")
+    assert _cli(["good.py", "--baseline", str(bad_base)],
+                str(tmp_path)).returncode == 2

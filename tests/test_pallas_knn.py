@@ -299,8 +299,12 @@ def test_mixed_expansion_matches_jnp_mixed_distance():
             jnp.asarray(np.ascontiguousarray(qe[:64])), jnp.asarray(t_pad),
             k=4, block_q=64, block_t=256, metric=metric, n_valid=n_valid,
             n_attrs=n_attrs, interpret=True)
+        # atol floor: the packed kernel quantizes distances to
+        # 2^-(23-_PACK_BITS)=2^-11 relative (pallas_knn docstring), which
+        # at these O(0.25) magnitudes is ~1.2e-4 per distance — 1e-4 was
+        # asserting below the kernel's own documented precision
         np.testing.assert_allclose(np.asarray(got_d), np.asarray(ref_d),
-                                   rtol=3e-3, atol=1e-4)
+                                   rtol=3e-3, atol=5e-4)
 
 
 def test_randomized_shape_sweep_vs_oracle():

@@ -70,6 +70,8 @@ def test_job_registry_has_reference_names():
         # reference Tool class names resolve too
         "org.avenir.bayesian.BayesianDistribution",
         "org.avenir.knn.NearestNeighbor",
+        # subclass Tool: inherits the Tool surface from its base class
+        "splitGenerator", "org.avenir.tree.SplitGenerator",
     ]:
         assert expected in names, expected
 
@@ -560,22 +562,52 @@ def test_every_reference_tool_class_is_addressable():
     ref_root = "/root/reference"
     if not os.path.isdir(ref_root):
         pytest.skip("reference tree not mounted")
+
+    # pass 1: gather every source with its package/class name so the java
+    # heuristic can follow inheritance — a class `extends SplitGenerator`
+    # is a Tool when SplitGenerator implements Tool, even though the
+    # subclass source never says so (VERDICT missing #4: subclass Tools
+    # slipped the direct-text scan)
+    java: dict = {}          # class name -> (fqcn, src)
     jobs = set()
-    for base, pat, needs in (
-        ("src/main/java/org/avenir", r"\.java$",
-         ("implements Tool", "extends Configured")),
-        ("spark/src/main/scala/org/avenir", r"\.scala$", ("def main",)),
-    ):
-        for root, _, files in os.walk(os.path.join(ref_root, base)):
-            for f in files:
-                if not re.search(pat, f):
-                    continue
-                src = open(os.path.join(root, f), errors="ignore").read()
-                if not any(n in src for n in needs):
-                    continue
-                pkg = re.search(r"package\s+([\w.]+)", src)
-                if pkg:
-                    jobs.add(f"{pkg.group(1)}.{f.rsplit('.', 1)[0]}")
+    for root, _, files in os.walk(
+            os.path.join(ref_root, "src/main/java/org/avenir")):
+        for f in files:
+            if not f.endswith(".java"):
+                continue
+            src = open(os.path.join(root, f), errors="ignore").read()
+            pkg = re.search(r"package\s+([\w.]+)", src)
+            if pkg:
+                cls = f.rsplit(".", 1)[0]
+                java[cls] = (f"{pkg.group(1)}.{cls}", src)
+    tool_classes = {c for c, (_, src) in java.items()
+                    if "implements Tool" in src or "extends Configured" in src}
+    # fixpoint over `extends <tool class>` chains (depth > 1 included)
+    grew = True
+    while grew:
+        grew = False
+        for cls, (_, src) in java.items():
+            if cls in tool_classes:
+                continue
+            # anchor to the class DECLARATION: a bare `extends` search
+            # would match Javadoc prose and shadow the real superclass
+            m = re.search(
+                r"class\s+" + re.escape(cls) + r"\b[^{]*?"
+                r"\bextends\s+(\w+)", src)
+            if m and m.group(1) in tool_classes:
+                tool_classes.add(cls)
+                grew = True
+    jobs.update(java[c][0] for c in tool_classes)
+
+    for root, _, files in os.walk(
+            os.path.join(ref_root, "spark/src/main/scala/org/avenir")):
+        for f in files:
+            if not f.endswith(".scala"):
+                continue
+            src = open(os.path.join(root, f), errors="ignore").read()
+            pkg = re.search(r"package\s+([\w.]+)", src)
+            if pkg and "def main" in src:
+                jobs.add(f"{pkg.group(1)}.{f.rsplit('.', 1)[0]}")
     missing = sorted(j for j in jobs if j not in _REGISTRY)
     assert not missing, f"unaddressable reference job classes: {missing}"
 

@@ -57,8 +57,11 @@ def test_hlo_collective_payload_matches_analytic_model():
     mesh = data_mesh(jax.devices()[:4], model_parallel=1)
     ops = _nb_compiled_collectives(mesh)
     ars = [o for o in ops if o["op"] == "all-reduce"]
-    assert len(ars) == 1, ops
-    assert ars[0]["payload_bytes"] == nb_payload_bytes() == 648
+    # XLA may emit the two psums as one tuple all-reduce or as two ops
+    # (version-dependent combiner pass); the traffic model is about BYTES,
+    # so the invariant is the summed payload
+    assert 1 <= len(ars) <= 2, ops
+    assert sum(o["payload_bytes"] for o in ars) == nb_payload_bytes() == 648
 
 
 def test_projection_math_and_report_fields():

@@ -2,9 +2,11 @@
 """graftlint launcher for source checkouts (no install needed):
 
     python tools/graftlint.py avenir_tpu/ [--json] [--baseline FILE]
+    python tools/graftlint.py --ir [--json]     # kernel-manifest IR audit
 
-Same entry point as the `graftlint` console script; see docs/graftlint.md
-for the rule catalog and allowlisting policy."""
+Same entry point as the `graftlint` console script. Exit codes: 0 clean,
+1 findings/stale/parse errors, 2 usage-or-trace errors. See
+docs/graftlint.md for the rule catalog and allowlisting policy."""
 
 import os
 import sys
