@@ -16,6 +16,11 @@ Entry points:
     the distributed-family collective-payload audit over the kernel
     manifest (``avenir_tpu.analysis.manifest``). Imported lazily, never
     from this package root: AST mode must not pull in jax;
+  - ``avenir_tpu.analysis.flow.run_flow`` — the flow layer
+    (``graftlint --flow``): interprocedural concurrency/determinism
+    rules over the host streaming surface + the chunk-invariance audit
+    of the manifest's streamed fold kernels (jax pulled in only when
+    the audit actually runs);
   - ``graftlint_baseline.txt`` — the allowlist: accepted findings keyed
     by ``path::rule::scope`` with a one-line justification each, shared
     by both modes.

@@ -1,22 +1,28 @@
 """graftlint CLI: `graftlint <paths>` (console script) or
 `python tools/graftlint.py <paths>`.
 
-Two modes sharing one report/baseline/exit contract:
+Three modes sharing one report/baseline/exit contract:
 
 - AST (default): lint source paths with the rules.py catalog.
 - IR (``--ir``, no paths): trace the kernel manifest
   (analysis/manifest.py), run the jaxpr rules and the collective-payload
   audit (analysis/ir.py) on the virtual 8-device mesh.
+- Flow (``--flow``, paths optional — defaults to the gated repo
+  surface): the host concurrency/determinism rules (analysis/flow.py)
+  plus the chunk-invariance audit of the streamed fold kernels
+  (manifest ``stream_entries()``).
 
 Exit-code contract (stable — bench_scaling.py and CI tripwire on it):
   0  clean: no findings, no stale baseline entries, no parse errors
   1  findings — non-allowlisted findings, stale baseline entries, or
      parse errors in the linted sources
   2  usage-or-trace-error — bad flags/baseline format/unreadable input,
-     or a manifest entry that failed to trace/lower (--ir)
+     a manifest entry that failed to trace/lower (--ir), or a stream
+     kernel that failed to run (--flow)
 
-`--json` prints one machine-readable object either way (same schema:
-the `payload_audit` key is empty for AST runs).
+`--json` prints one machine-readable object in every mode (same schema:
+`payload_audit` is empty outside --ir, `invariance_audit` outside
+--flow).
 """
 
 from __future__ import annotations
@@ -45,6 +51,11 @@ def build_parser() -> argparse.ArgumentParser:
                         "source paths: jaxpr rules + the distributed-family "
                         "collective-payload audit on the virtual 8-device "
                         "mesh")
+    p.add_argument("--flow", action="store_true",
+                   help="host concurrency/determinism analysis: the flow-* "
+                        "rules over the paths (default: the gated repo "
+                        "surface) + the chunk-invariance audit of the "
+                        "streamed fold kernels")
     p.add_argument("--baseline", default=None,
                    help="allowlist file (default: "
                         "avenir_tpu/analysis/graftlint_baseline.txt)")
@@ -54,7 +65,8 @@ def build_parser() -> argparse.ArgumentParser:
                    help="emit one JSON object instead of text")
     p.add_argument("--rules", default=None, metavar="ID[,ID...]",
                    help=f"comma-separated subset of: {', '.join(rule_ids())} "
-                        f"(or the ir-* ids with --ir)")
+                        f"(or the ir-* ids with --ir, the flow-* ids with "
+                        f"--flow)")
     p.add_argument("--no-md", action="store_true",
                    help="skip ```python fences in .md files")
     p.add_argument("--allow-stale", action="store_true",
@@ -112,14 +124,18 @@ def _report_root(args) -> Optional[str]:
 def main(argv: Optional[List[str]] = None) -> int:
     parser = build_parser()
     args = parser.parse_args(argv)
+    if args.ir and args.flow:
+        print("graftlint: --ir and --flow are separate analysis tiers; "
+              "run them as two invocations", file=sys.stderr)
+        return 2
     if args.ir and args.paths:
         print("graftlint: --ir lints the kernel manifest; do not pass "
               "paths (run the two modes as two invocations)",
               file=sys.stderr)
         return 2
-    if not args.ir and not args.paths:
-        print("graftlint: pass paths to lint, or --ir for the manifest "
-              "audit", file=sys.stderr)
+    if not args.ir and not args.flow and not args.paths:
+        print("graftlint: pass paths to lint, or --ir / --flow for the "
+              "manifest audits", file=sys.stderr)
         return 2
 
     if args.ir:
@@ -127,6 +143,14 @@ def main(argv: Optional[List[str]] = None) -> int:
         from avenir_tpu.analysis.ir import (ALL_IR_RULES, IRTraceError,
                                             ir_rule_ids, run_ir)
         known = ir_rule_ids()
+    elif args.flow:
+        # the invariance audit runs real jobs: pin the CPU platform the
+        # way every other analysis consumer does
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        from avenir_tpu.analysis.flow import (ALL_FLOW_RULES, FLOW_AUDIT_RULE,
+                                              FlowAuditError, flow_rule_ids,
+                                              run_flow)
+        known = flow_rule_ids()
     else:
         known = rule_ids()
 
@@ -158,6 +182,21 @@ def main(argv: Optional[List[str]] = None) -> int:
         except IRTraceError as e:
             print(f"graftlint: trace error: {e}", file=sys.stderr)
             return 2
+    elif args.flow:
+        flow_rules = ([r() for r in ALL_FLOW_RULES] if wanted is None
+                      else [r() for r in ALL_FLOW_RULES
+                            if r.rule_id in wanted])
+        audit = wanted is None or FLOW_AUDIT_RULE in wanted
+        try:
+            report = run_flow(paths=args.paths or None, rules=flow_rules,
+                              baseline=baseline, root=_report_root(args),
+                              include_md=not args.no_md, audit=audit)
+        except FlowAuditError as e:
+            print(f"graftlint: stream audit error: {e}", file=sys.stderr)
+            return 2
+        except OSError as e:
+            print(f"graftlint: cannot read input: {e}", file=sys.stderr)
+            return 2
     else:
         rules = (None if wanted is None
                  else [r() for r in ALL_RULES if r.rule_id in wanted])
@@ -184,6 +223,12 @@ def main(argv: Optional[List[str]] = None) -> int:
                      if a["payload_model_validated"])
             tail = (f", payload audit {ok}/{len(report.payload_audit)} "
                     f"families validated")
+        if report.invariance_audit:
+            ok = sum(1 for a in report.invariance_audit
+                     if a["invariance_validated"])
+            tail += (f", chunk-invariance audit {ok}/"
+                     f"{len(report.invariance_audit)} stream kernels "
+                     f"validated")
         print(f"graftlint: {len(report.scanned)} {unit}, "
               f"{len(report.findings)} finding(s), "
               f"{len(report.suppressed)} allowlisted, "

@@ -71,8 +71,11 @@ class Report:
 
     `payload_audit` is filled only by IR runs (analysis/ir.py): one entry
     per distributed family with its HLO-vs-analytic collective payload
-    verdict. AST runs leave it empty — the key is always present in the
-    JSON so downstream tripwires can parse one schema."""
+    verdict. `invariance_audit` is filled only by flow runs
+    (analysis/flow.py): one entry per streamed fold kernel with its
+    chunk-layout/scheduler byte-identity verdict. Other modes leave them
+    empty — the keys are always present in the JSON so downstream
+    tripwires can parse one schema."""
 
     findings: List[Finding] = field(default_factory=list)
     suppressed: List[Finding] = field(default_factory=list)
@@ -80,6 +83,7 @@ class Report:
     scanned: List[str] = field(default_factory=list)
     errors: List[Finding] = field(default_factory=list)
     payload_audit: List[dict] = field(default_factory=list)
+    invariance_audit: List[dict] = field(default_factory=list)
 
     def counts(self) -> Dict[str, int]:
         out: Dict[str, int] = {}
@@ -100,6 +104,7 @@ class Report:
             "errors": [f.to_json() for f in self.errors],
             "files_scanned": len(self.scanned),
             "payload_audit": self.payload_audit,
+            "invariance_audit": self.invariance_audit,
             "clean": self.clean,
         }
 
@@ -381,16 +386,14 @@ def load_baseline(path: Optional[str] = None) -> List[BaselineEntry]:
 
 
 # -------------------------------------------------------------------- run
-def run_paths(paths: Sequence[str], rules: Optional[Sequence] = None,
-              baseline: Optional[Sequence[BaselineEntry]] = None,
-              root: Optional[str] = None, include_md: bool = True) -> Report:
-    """Lint `paths` with `rules` (default: all), splitting findings into
-    surviving vs baseline-suppressed; baseline entries pointing at scanned
-    files that no longer fire are reported stale (the allowlist must
-    shrink with the code it excuses)."""
-    from avenir_tpu.analysis.rules import ALL_RULES
-
-    active = list(rules) if rules is not None else [r() for r in ALL_RULES]
+def collect_findings(paths: Sequence[str], rules: Sequence,
+                     root: Optional[str] = None, include_md: bool = True
+                     ) -> Tuple[Report, List[Finding]]:
+    """Parse and lint `paths` with `rules`, returning the partial report
+    (scanned files + parse errors) and the RAW findings, before any
+    baseline split. Shared by run_paths and the flow runner
+    (analysis/flow.py), which appends its audit findings to the raw list
+    so one apply_baseline pass governs both."""
     root = os.path.abspath(root or os.getcwd())
     report = Report()
     raw: List[Finding] = []
@@ -412,9 +415,22 @@ def run_paths(paths: Sequence[str], rules: Optional[Sequence] = None,
         if offset:
             ast.increment_lineno(tree, offset)
         ctx = ModuleContext(rel, tree)
-        for rule in active:
+        for rule in rules:
             raw.extend(rule.check(ctx))
+    return report, raw
 
+
+def run_paths(paths: Sequence[str], rules: Optional[Sequence] = None,
+              baseline: Optional[Sequence[BaselineEntry]] = None,
+              root: Optional[str] = None, include_md: bool = True) -> Report:
+    """Lint `paths` with `rules` (default: all), splitting findings into
+    surviving vs baseline-suppressed; baseline entries pointing at scanned
+    files that no longer fire are reported stale (the allowlist must
+    shrink with the code it excuses)."""
+    from avenir_tpu.analysis.rules import ALL_RULES
+
+    active = list(rules) if rules is not None else [r() for r in ALL_RULES]
+    report, raw = collect_findings(paths, active, root, include_md)
     apply_baseline(report, raw, baseline, {r.rule_id for r in active})
     return report
 

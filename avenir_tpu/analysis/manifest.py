@@ -293,3 +293,144 @@ def manifest_entries() -> List[KernelSpec]:
 
 def family_names() -> List[str]:
     return [s.name for s in _family_entries()]
+
+
+# ------------------------------------------------------ streamed fold kernels
+@dataclass(frozen=True)
+class StreamKernelSpec:
+    """One streamed fold kernel for the chunk-invariance auditor
+    (analysis/flow.py).
+
+    ``prepare(workdir)`` writes the kernel's corpus (deterministic,
+    seeded) and returns a context dict; ``run(ctx, block_mb)`` executes
+    the REAL streamed job over that corpus with the given stream block
+    size and returns the output artifact's bytes. `layouts` holds >= 3
+    block sizes chosen so the corpus chunks into visibly different
+    layouts (single block / a dozen / dozens) — the auditor verifies the
+    chunk counts actually differ, then asserts the bytes don't."""
+
+    name: str
+    path: str                     # repo-relative module of the fold kernel
+    line: int
+    prepare: Callable             # workdir -> ctx dict
+    run: Callable                 # (ctx, block_mb) -> bytes
+    layouts: Tuple[float, ...] = (64.0, 0.002, 0.0005)
+
+
+def _job_runner(job: str, prefix: str, conf: dict, inputs_key: str = "csv"):
+    """run(ctx, block_mb) driving a registered runner job with the
+    kernel's corpus and `<prefix>.stream.block.size.mb` pinned to the
+    layout under test — the full streamed path (prefetched block reads,
+    shared-schema chunk parses, double-buffered device folds, output
+    writer), not a unit-sized re-implementation of it."""
+
+    def run(ctx: dict, block_mb: float) -> bytes:
+        from avenir_tpu.runner import run_job
+
+        ctx["runs"] = ctx.get("runs", 0) + 1
+        out = os.path.join(ctx["dir"], f"out_{ctx['runs']}.txt")
+        props = dict(conf)
+        for key, val in list(props.items()):
+            props[key] = val.format(**ctx) if isinstance(val, str) else val
+        props[f"{prefix}.stream.block.size.mb"] = repr(float(block_mb))
+        res = run_job(job, props, [ctx[inputs_key]], out)
+        # the artifact is every output file the job wrote (the miners
+        # emit one per itemset length), name-tagged so a missing per-k
+        # file can't alias a reordered one
+        blobs = []
+        for p in sorted(res.outputs):
+            rel = os.path.relpath(p, out)   # run-invariant name ('.'
+            with open(p, "rb") as fh:       # for single-file outputs)
+                blobs.append(rel.encode() + b"\0" + fh.read())
+        return b"\n".join(blobs)
+
+    return run
+
+
+def _churn_corpus(workdir: str) -> dict:
+    from avenir_tpu.data import churn_schema, generate_churn
+
+    csv = os.path.join(workdir, "churn.csv")
+    with open(csv, "w") as fh:
+        fh.write(generate_churn(600, seed=11, as_csv=True))
+    schema = os.path.join(workdir, "churn.json")
+    churn_schema().save(schema)
+    return {"dir": workdir, "csv": csv, "schema": schema}
+
+
+def _seq_corpus(workdir: str) -> dict:
+    """Markov/miner corpus: 3-state token sequences with a class column,
+    the bench_scaling.miner_tripwire shape at auditor size."""
+    rng = np.random.default_rng(12)
+    states = ["L", "M", "H"]
+    csv = os.path.join(workdir, "seq.csv")
+    with open(csv, "w") as fh:
+        for i in range(400):
+            up = i % 2 == 0
+            s, toks = 1, []
+            for _ in range(6):
+                p = [0.1, 0.3, 0.6] if up else [0.6, 0.3, 0.1]
+                s = int(np.clip(s + rng.choice([-1, 0, 1], p=p), 0, 2))
+                toks.append(states[s])
+            fh.write(f"c{i},{'T' if up else 'F'}," + ",".join(toks) + "\n")
+    return {"dir": workdir, "csv": csv}
+
+
+def stream_entries() -> List[StreamKernelSpec]:
+    """The streamed fold kernels the chunk-invariance auditor proves
+    deterministic every run: NB, MI, Markov, Apriori, GSP, discriminant
+    — every additive-count fold the 1B-row path is built on. Each
+    `path:line` points at the fold kernel itself (the accumulate /
+    mine_stream the job drives), so findings land on the code that owns
+    the invariant."""
+    from avenir_tpu.models.association import FrequentItemsApriori
+    from avenir_tpu.models.discriminant import FisherDiscriminant
+    from avenir_tpu.models.explore import MutualInformationAnalyzer
+    from avenir_tpu.models.markov import MarkovStateTransitionModel
+    from avenir_tpu.models.naive_bayes import NaiveBayesModel
+    from avenir_tpu.models.sequence import GSPMiner
+
+    def spec(name, ref, prepare, run):
+        path, line = _loc(ref)
+        return StreamKernelSpec(name, path, line, prepare, run)
+
+    schema_conf = lambda prefix: {
+        f"{prefix}.feature.schema.file.path": "{schema}"}
+    return [
+        spec("nb_stream", NaiveBayesModel.accumulate, _churn_corpus,
+             _job_runner("bayesianDistr", "bad", schema_conf("bad"))),
+        spec("mi_stream", MutualInformationAnalyzer.add, _churn_corpus,
+             _job_runner("mutualInformation", "mut", {
+                 **schema_conf("mut"),
+                 "mut.mutual.info.score.algorithms":
+                     "mutual.info.maximization,min.redundancy.max.relevance",
+             })),
+        spec("discriminant_stream", FisherDiscriminant.accumulate,
+             _churn_corpus,
+             _job_runner("fisherDiscriminant", "fid", schema_conf("fid"))),
+        spec("markov_stream", MarkovStateTransitionModel.fit_csr,
+             _seq_corpus,
+             _job_runner("markovStateTransitionModel", "mst", {
+                 "mst.model.states": "L,M,H",
+                 "mst.class.label.field.ord": "1",
+                 "mst.skip.field.count": "2",
+                 "mst.class.labels": "T,F",
+             })),
+        spec("apriori_stream", FrequentItemsApriori.mine_stream,
+             _seq_corpus,
+             _job_runner("frequentItemsApriori", "fia", {
+                 "fia.support.threshold": "0.3",
+                 "fia.item.set.length": "2",
+                 "fia.skip.field.count": "2",
+             })),
+        spec("gsp_stream", GSPMiner.mine_stream, _seq_corpus,
+             _job_runner("candidateGenerationWithSelfJoin", "cgs", {
+                 "cgs.support.threshold": "0.3",
+                 "cgs.item.set.length": "2",
+                 "cgs.skip.field.count": "2",
+             })),
+    ]
+
+
+def stream_kernel_names() -> List[str]:
+    return [s.name for s in stream_entries()]

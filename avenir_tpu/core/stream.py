@@ -101,17 +101,32 @@ def iter_csv_chunks(path: str, schema: FeatureSchema, delim: str = ",",
 
 _DONE = object()
 
+#: Audit/test hook: when set, called with no arguments once per item a
+#: prefetched() worker produces (before the queue put). The chunk-
+#: invariance auditor (analysis/flow.py) installs a deterministic-jitter
+#: scheduler here to prove streamed folds don't depend on producer
+#: timing, and a counting hook to prove chunk layouts actually differ.
+#: Production leaves it None; the check is one load per block.
+_produce_hook = None
 
-def prefetched(items: Iterable[T], depth: int = 2) -> Iterator[T]:
-    """Run `items` in a background daemon thread, keeping up to `depth`
-    results queued ahead of the consumer. Exceptions re-raise at the
-    consumer's next pull; order is preserved. Abandoning the generator
-    (consumer exception / close) cancels the worker, so its thread and any
-    file handle inside `items` don't outlive the consumer."""
-    q: "queue.Queue" = queue.Queue(maxsize=max(depth, 1))
-    cancel = threading.Event()
+#: consumer-side poll granularity: bounds how long a pull can block
+#: before re-checking that the worker is still alive (a dead worker with
+#: an empty queue would otherwise hang the consumer forever)
+_GET_POLL_SECS = 0.5
+#: close() bound on joining the worker; a worker alive past this is
+#: wedged in `items` (e.g. blocking IO) and is reported, not ignored
+_JOIN_SECS = 10.0
 
-    def _put(item) -> bool:
+
+def _prefetch_worker(items: Iterable, q: "queue.Queue",
+                     cancel: threading.Event, error_cell: list) -> None:
+    """Producer body. Deliberately a MODULE function taking its state as
+    arguments: a bound-method target would make the worker thread keep
+    its own _Prefetcher alive, so an abandoned iterator could never be
+    garbage-collected (and its worker never cancelled) while the worker
+    ran — the leak the join contract exists to prevent."""
+
+    def put(item) -> bool:
         while not cancel.is_set():
             try:
                 q.put(item, timeout=0.1)
@@ -120,32 +135,111 @@ def prefetched(items: Iterable[T], depth: int = 2) -> Iterator[T]:
                 continue
         return False
 
-    def worker() -> None:
-        it = iter(items)
-        try:
-            for item in it:
-                if not _put(item):
-                    break
-            else:
-                _put(_DONE)
-        except BaseException as exc:  # re-raised on the consumer side
-            _put(exc)
-        finally:
-            close = getattr(it, "close", None)
-            if close is not None:
-                close()
-
-    threading.Thread(target=worker, daemon=True).start()
+    it = iter(items)
     try:
-        while True:
-            item = q.get()
-            if item is _DONE:
-                return
-            if isinstance(item, BaseException):
-                raise item
-            yield item
+        for item in it:
+            hook = _produce_hook
+            if hook is not None:
+                hook()
+            if not put(item):
+                break
+        else:
+            put(_DONE)
+    except BaseException as exc:  # re-raised on the consumer side
+        error_cell[0] = exc       # kept even if the queue put loses a
+        put(exc)                  # race with close(): never dropped
     finally:
-        cancel.set()
+        close = getattr(it, "close", None)
+        if close is not None:
+            close()
+
+
+class _Prefetcher(Iterator[T]):
+    """Iterator over `items` produced by a background worker thread.
+
+    The consumer contract prefetched() documents lives here: order
+    preserved, worker exceptions re-raise at the consumer's next pull,
+    and close() — called explicitly, by `yield from` delegation, on
+    exhaustion, or at GC — cancels AND JOINS the worker so its thread
+    and any file handle inside `items` never outlive the consumer. A
+    worker exception that the consumer has not yet pulled re-raises from
+    an explicit close() instead of being dropped."""
+
+    def __init__(self, items: Iterable[T], depth: int):
+        self._q: "queue.Queue" = queue.Queue(maxsize=max(depth, 1))
+        self._cancel = threading.Event()
+        self._error_cell: list = [None]
+        self._thread: Optional[threading.Thread] = threading.Thread(
+            target=_prefetch_worker,
+            args=(items, self._q, self._cancel, self._error_cell),
+            daemon=True)
+        self._thread.start()
+
+    def __iter__(self) -> "_Prefetcher":
+        return self
+
+    def __next__(self) -> T:
+        if self._thread is None:
+            raise StopIteration
+        while True:
+            try:
+                item = self._q.get(timeout=_GET_POLL_SECS)
+            except queue.Empty:
+                if not self._thread.is_alive() and self._q.empty():
+                    # every worker exit path posts _DONE or an exception;
+                    # an empty queue with a dead worker means the process
+                    # is tearing down — fail crisply instead of hanging
+                    self.close()
+                    raise RuntimeError(
+                        "prefetch worker exited without a result")
+                continue
+            if item is _DONE:
+                self.close()
+                raise StopIteration
+            if isinstance(item, BaseException):
+                self._error_cell[0] = None   # delivered: close() must
+                self.close(_suppress=True)   # not re-raise it
+                raise item
+            return item
+
+    def close(self, _suppress: bool = False) -> None:
+        """Cancel the worker, join it, and re-raise any worker exception
+        the consumer never pulled (unless `_suppress`, used on the paths
+        where the exception is already propagating)."""
+        thread, self._thread = self._thread, None
+        if thread is None:
+            return
+        self._cancel.set()
+        # drain so a worker blocked on a full queue sees the cancel fast
+        try:
+            while True:
+                self._q.get_nowait()
+        except queue.Empty:
+            pass
+        thread.join(_JOIN_SECS)
+        if thread.is_alive():
+            raise RuntimeError(
+                f"prefetch worker failed to stop within {_JOIN_SECS}s "
+                f"(wedged inside its source iterable?)")
+        pending, self._error_cell[0] = self._error_cell[0], None
+        if pending is not None and not _suppress:
+            raise pending
+
+    def __del__(self):
+        try:
+            self.close(_suppress=True)   # GC close never raises
+        except Exception:
+            pass
+
+
+def prefetched(items: Iterable[T], depth: int = 2) -> Iterator[T]:
+    """Run `items` in a background worker thread, keeping up to `depth`
+    results queued ahead of the consumer. Exceptions re-raise at the
+    consumer's next pull; order is preserved. The returned iterator's
+    close() (also invoked by abandonment/GC) cancels AND joins the worker
+    — so its thread and any file handle inside `items` don't outlive the
+    consumer — and propagates a worker exception the consumer never saw."""
+    return _Prefetcher(items, depth)
 
 
 def double_buffered(items: Iterable[T]) -> Iterator[T]:

@@ -115,6 +115,11 @@ class LearnerStream:
     spawns the loop thread consuming the event queue (the RedisSpout role),
     submit_event() enqueues, stop() joins."""
 
+    #: loop poll granularity: bounds how long the worker blocks on the
+    #: event queue before re-checking the shutdown flag, so a lost
+    #: sentinel (e.g. consumed by a replay race) can't wedge the thread
+    POLL_SECS = 0.2
+
     def __init__(self, learner_type: str, action_ids: Sequence[str],
                  config: Dict,
                  reward_reader: Optional[RewardReader] = None,
@@ -132,6 +137,11 @@ class LearnerStream:
         self.max_replays = max_replays
         self.replays: Dict[str, int] = {}
         self.failed: List[Tuple[str, str]] = []   # (event_id, error)
+        # guards the caller-visible state the loop thread mutates
+        # (processed/replays/failed); the event queue itself is the
+        # sanctioned handoff for the tuples
+        self._lock = threading.Lock()
+        self._stop_requested = threading.Event()
 
     # ------------------------------------------------------ bolt semantics
     def process_event(self, event_id: str, round_num: int = 0) -> List[Action]:
@@ -139,7 +149,8 @@ class LearnerStream:
             self.learner.set_reward(action_id, reward)
         actions = self.learner.next_actions()
         self.action_writer.write(event_id, actions)
-        self.processed += 1
+        with self._lock:
+            self.processed += 1
         return actions
 
     def process_reward(self, action_id: str, reward: int) -> None:
@@ -150,36 +161,63 @@ class LearnerStream:
         self.events.put((event_id, round_num))
 
     def start(self) -> "LearnerStream":
+        self._stop_requested = threading.Event()
+
         def loop():
             while True:
-                item = self.events.get()
+                try:
+                    # timeout, not a bare get(): a worker blocked forever
+                    # on an empty queue is indistinguishable from a hang,
+                    # and a sentinel lost to a replay race would wedge it
+                    item = self.events.get(timeout=self.POLL_SECS)
+                except queue.Empty:
+                    if self._stop_requested.is_set():
+                        return
+                    continue
                 if item is None:
-                    # a replayed tuple may have been re-enqueued behind the
-                    # stop sentinel; keep draining until the queue is quiet
+                    # a replayed tuple may have been re-enqueued behind
+                    # the stop sentinel: drop the sentinel and keep
+                    # draining (NEVER re-enqueue it — two stop() calls
+                    # would leave two sentinels ping-ponging forever);
+                    # once the queue is quiet the poll timeout sees the
+                    # stop flag and exits
                     if self.events.empty():
                         return
-                    self.events.put(None)
                     continue
                 try:
                     self.process_event(*item)
-                    self.replays.pop(item[0], None)    # acked
+                    with self._lock:
+                        self.replays.pop(item[0], None)    # acked
                 except Exception as exc:
-                    n = self.replays.get(item[0], 0) + 1
-                    self.replays[item[0]] = n
+                    with self._lock:
+                        n = self.replays.get(item[0], 0) + 1
+                        self.replays[item[0]] = n
                     if n <= self.max_replays:
                         self.events.put(item)          # Storm tuple replay
                     else:
                         # clear the counter: a future submission of the same
                         # event id starts with a fresh replay budget
-                        self.replays.pop(item[0], None)
-                        self.failed.append((item[0], repr(exc)))
+                        with self._lock:
+                            self.replays.pop(item[0], None)
+                            self.failed.append((item[0], repr(exc)))
 
         self.thread = threading.Thread(target=loop, daemon=True)
         self.thread.start()
         return self
 
     def stop(self, timeout: float = 10.0) -> None:
-        if self.thread is not None:
-            self.events.put(None)
-            self.thread.join(timeout)
-            self.thread = None
+        """Signal shutdown (flag + sentinel), join the loop thread, and
+        VERIFY it exited: a worker still alive after `timeout` is wedged
+        (e.g. inside a learner call) and raises instead of silently
+        truncating the stream on return."""
+        if self.thread is None:
+            return
+        self._stop_requested.set()
+        self.events.put(None)
+        self.thread.join(timeout)
+        if self.thread.is_alive():
+            raise RuntimeError(
+                f"LearnerStream worker failed to stop within {timeout}s "
+                f"(wedged inside process_event?); events pending: "
+                f"~{self.events.qsize()}")
+        self.thread = None

@@ -26,11 +26,13 @@ import tempfile
 
 
 def graftlint_tripwire() -> dict:
-    """Run the graftlint CLI (--json) over the package AND the --ir
-    manifest audit, failing the bench on any non-allowlisted finding,
-    stale baseline entry, trace error, or a distributed family whose
-    collective payload drifted off the scaling.py analytic model —
-    hazard/traffic regressions surface here every round, not at the next
+    """Run the graftlint CLI (--json) over the package, the --ir
+    manifest audit AND the --flow concurrency/invariance audit, failing
+    the bench on any non-allowlisted finding, stale baseline entry,
+    trace error, a distributed family whose collective payload drifted
+    off the scaling.py analytic model, or a streamed fold kernel whose
+    output bytes moved with the chunk layout — hazard/traffic/
+    determinism regressions surface here every round, not at the next
     100M-row run."""
     import os
     import subprocess
@@ -63,10 +65,20 @@ def graftlint_tripwire() -> dict:
         raise RuntimeError(
             f"collective payload audit regression: "
             f"{len(audit)} families audited, drifted={bad}")
+    flow_rep = run(["--flow"], "--flow")
+    inv = flow_rep["invariance_audit"]
+    drifted = [r["kernel"] for r in inv if not r["invariance_validated"]]
+    if drifted or len(inv) < 6:
+        raise RuntimeError(
+            f"chunk-invariance audit regression: {len(inv)} stream "
+            f"kernels audited, drifted={drifted}")
     return {"files": ast_rep["files_scanned"], "findings": 0,
             "allowlisted": ast_rep["suppressed"],
             "ir_findings": 0,
-            "payload_families_validated": len(audit)}
+            "payload_families_validated": len(audit),
+            "flow_findings": 0,
+            "flow_allowlisted": flow_rep["suppressed"],
+            "stream_kernels_validated": len(inv)}
 
 
 def miner_tripwire(rows: int = 20_000) -> dict:

@@ -329,6 +329,45 @@ class TestLearnerStream:
         stream.process_reward("b", 70)
         assert stream.learner.reward_stats["b"].count == 1
 
+    def test_stop_raises_on_wedged_worker(self):
+        """The shutdown contract: stop() verifies the loop thread
+        actually exited — a worker wedged inside process_event raises
+        instead of returning as if the stream had drained (the silent
+        truncation the flow-unjoined-thread/unbounded-get rules exist
+        to prevent)."""
+        import threading
+        import time
+
+        stream = LearnerStream("randomGreedy", ACTIONS, BASE_CONFIG)
+        stream.process_event("warm", 0)     # pre-compile the learner so
+        release = threading.Event()         # the unwedged exit is fast
+        orig = stream.learner.next_actions
+
+        def wedge():
+            release.wait(30)
+            return orig()
+
+        stream.learner.next_actions = wedge
+        stream.start()
+        stream.submit_event("e1", 1)
+        deadline = time.time() + 5          # wait until the worker is
+        while stream.events.qsize() and time.time() < deadline:
+            time.sleep(0.01)                # actually inside the wedge
+        with pytest.raises(RuntimeError, match="failed to stop"):
+            stream.stop(timeout=0.3)
+        release.set()                       # unwedge; stop now succeeds
+        stream.stop(timeout=20.0)
+        assert stream.thread is None
+        assert stream.processed == 2        # warm-up + the wedged event
+
+    def test_stop_verifies_thread_exit_cleanly(self):
+        stream = LearnerStream("randomGreedy", ACTIONS, BASE_CONFIG).start()
+        stream.submit_event("e1", 1)
+        assert stream.action_writer.pop(timeout=5) is not None
+        stream.stop()                       # clean drain: no raise
+        assert stream.thread is None
+        stream.stop()                       # idempotent on a stopped stream
+
     def test_ranked_batch_small_group_cycles(self):
         """A group with fewer items than batch_size must still get
         batch_size valid picks (cyclic), never padded slots."""

@@ -112,6 +112,37 @@ def test_bayesian_distr_job_streams_block_size_invariant(churn_csv, tmp_path):
     assert outs[0] == outs[1]
 
 
+def test_prefetched_close_joins_worker_and_propagates_error():
+    """The iterator contract: close() JOINS the worker (not just cancels
+    it), and a worker exception the consumer never pulled re-raises from
+    the explicit close instead of being dropped — the silent-truncation
+    path a daemon-thread pipeline used to have at shutdown."""
+    from avenir_tpu.core.stream import prefetched
+
+    def boom():
+        raise RuntimeError("producer died before the first block")
+        yield 1                             # pragma: no cover
+
+    it = prefetched(boom(), depth=1)
+    with pytest.raises(RuntimeError, match="producer died"):
+        it.close()
+    assert it._thread is None               # joined and released
+
+    # a clean close after normal mid-stream abandonment stays silent,
+    # and close() is idempotent
+    it = prefetched(iter(range(1000)), depth=1)
+    assert next(it) == 0
+    it.close()
+    it.close()
+
+    # an error the consumer DID pull must not re-raise at close
+    it = prefetched(boom(), depth=1)
+    with pytest.raises(RuntimeError, match="producer died"):
+        for _ in it:
+            pass
+    it.close()
+
+
 def test_prefetched_abandonment_cancels_worker(churn_csv):
     """Abandoning the consumer (exception mid-stream) must cancel the
     worker thread and close the underlying file — the leak path a job
