@@ -123,6 +123,7 @@ class ModuleContext:
         self.aliases = self._collect_aliases(tree)
         self.module_names = self._module_level_names(tree)
         self.jitted_names = self._collect_jitted_names(tree)
+        self.jitted_donating = self._collect_donating_names(tree)
 
     # ------------------------------------------------------------ imports
     @staticmethod
@@ -249,6 +250,49 @@ class ModuleContext:
                     if isinstance(v, ast.Constant) and isinstance(v.value, str):
                         static.add(v.value)
         return static
+
+    @staticmethod
+    def _jit_donates(call: ast.Call) -> bool:
+        """True when a jit(...) call donates at least one argument. An
+        explicitly EMPTY donate_argnums=() donates nothing (the repo uses
+        it to DOCUMENT a non-donating kernel) and counts as False."""
+        for kw in call.keywords:
+            if kw.arg in ("donate_argnums", "donate_argnames"):
+                if isinstance(kw.value, (ast.Tuple, ast.List)) \
+                        and not kw.value.elts:
+                    continue
+                return True
+        return False
+
+    def _jit_call_donates(self, expr: ast.AST) -> Optional[bool]:
+        """None when `expr` is not a jit wrapper expression; else whether
+        that wrapper donates any argument."""
+        if self.dotted(expr) in ("jax.jit", "jit"):
+            return False                       # bare @jax.jit: no donation
+        if not isinstance(expr, ast.Call):
+            return None
+        callee = self.dotted(expr.func)
+        if callee in ("jax.jit", "jit"):
+            return self._jit_donates(expr)
+        if callee in ("functools.partial", "partial") and expr.args:
+            if self.dotted(expr.args[0]) in ("jax.jit", "jit"):
+                return self._jit_donates(expr)
+        return None
+
+    def _collect_donating_names(self, tree: ast.Module) -> Set[str]:
+        """The subset of jitted names whose jit wrapper donates at least
+        one argument — the fold-undonated-carry rule's pass list."""
+        names: Set[str] = set()
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                if any(self._jit_call_donates(dec)
+                       for dec in node.decorator_list):
+                    names.add(node.name)
+            elif isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name) \
+                    and self._jit_call_donates(node.value):
+                names.add(node.targets[0].id)
+        return names
 
     def _collect_jitted_names(self, tree: ast.Module) -> Set[str]:
         """Names bound (at any nesting level) to jit-compiled callables:

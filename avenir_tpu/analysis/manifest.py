@@ -347,6 +347,40 @@ def _job_runner(job: str, prefix: str, conf: dict, inputs_key: str = "csv"):
     return run
 
 
+def _shared_runner(specs):
+    """run(ctx, block_mb) driving N registered jobs through runner.
+    run_shared — the REAL scan-sharing executor (one SharedScan read +
+    parse, N fold sinks) — with every job's stream block size pinned to
+    the layout under test. The artifact is every output file of every
+    fused job, name-tagged, so a drift in ANY sink's fold fails the
+    byte-identity assertion. `specs` is [(job, prefix, conf)]."""
+
+    def run(ctx: dict, block_mb: float) -> bytes:
+        from avenir_tpu.runner import run_shared
+
+        ctx["runs"] = ctx.get("runs", 0) + 1
+        blobs = []
+        shared_specs = []
+        outs = []
+        for job, prefix, conf in specs:
+            out = os.path.join(ctx["dir"], f"out_{ctx['runs']}_{job}")
+            props = {k: (v.format(**ctx) if isinstance(v, str) else v)
+                     for k, v in conf.items()}
+            props[f"{prefix}.stream.block.size.mb"] = repr(float(block_mb))
+            shared_specs.append((job, props, out))
+            outs.append(out)
+        results = run_shared(shared_specs, [ctx["csv"]])
+        for (job, _prefix, _conf), out in zip(specs, outs):
+            res = results[job]
+            for p in sorted(res.outputs):
+                rel = os.path.relpath(p, out)
+                with open(p, "rb") as fh:
+                    blobs.append(f"{job}:{rel}".encode() + b"\0" + fh.read())
+        return b"\n".join(blobs)
+
+    return run
+
+
 def _churn_corpus(workdir: str) -> dict:
     from avenir_tpu.data import churn_schema, generate_churn
 
@@ -383,6 +417,7 @@ def stream_entries() -> List[StreamKernelSpec]:
     `path:line` points at the fold kernel itself (the accumulate /
     mine_stream the job drives), so findings land on the code that owns
     the invariant."""
+    from avenir_tpu.core.stream import SharedScan
     from avenir_tpu.models.association import FrequentItemsApriori
     from avenir_tpu.models.discriminant import FisherDiscriminant
     from avenir_tpu.models.explore import MutualInformationAnalyzer
@@ -429,6 +464,37 @@ def stream_entries() -> List[StreamKernelSpec]:
                  "cgs.item.set.length": "2",
                  "cgs.skip.field.count": "2",
              })),
+        # fused shared-scan entries: the SAME jobs through the
+        # scan-sharing executor (ONE read + parse, N fold sinks). The
+        # auditor re-proves every round that fan-out changes nothing —
+        # fused outputs must be byte-identical under all chunk layouts
+        # and the adversarial prefetch scheduler, exactly like the
+        # one-job-one-scan entries above.
+        spec("shared_churn_stream", SharedScan.run, _churn_corpus,
+             _shared_runner([
+                 ("bayesianDistr", "bad", schema_conf("bad")),
+                 ("mutualInformation", "mut", {
+                     **schema_conf("mut"),
+                     "mut.mutual.info.score.algorithms":
+                         "mutual.info.maximization,"
+                         "min.redundancy.max.relevance",
+                 }),
+                 ("fisherDiscriminant", "fid", schema_conf("fid")),
+             ])),
+        spec("shared_seq_stream", SharedScan.run, _seq_corpus,
+             _shared_runner([
+                 ("markovStateTransitionModel", "mst", {
+                     "mst.model.states": "L,M,H",
+                     "mst.class.label.field.ord": "1",
+                     "mst.skip.field.count": "2",
+                     "mst.class.labels": "T,F",
+                 }),
+                 ("frequentItemsApriori", "fia", {
+                     "fia.support.threshold": "0.3",
+                     "fia.item.set.length": "2",
+                     "fia.skip.field.count": "2",
+                 }),
+             ])),
     ]
 
 

@@ -510,9 +510,60 @@ class Int64LiteralInJnpRule(Rule):
                             f"or silently truncates on device")
 
 
+class FoldUndonatedCarryRule(Rule):
+    """A jitted fold carry re-dispatched per chunk without a donated
+    accumulator: ``acc = fold(acc, chunk)`` inside a lexical loop, where
+    `fold` is a module-local jitted callable whose jit wrapper has no
+    (non-empty) donate_argnums/donate_argnames. Every iteration then
+    allocates a fresh device accumulator and keeps the previous one
+    alive until the add completes — on a fan-out shared scan the per-
+    chunk allocation multiplies by the sink count. The NB deferred fold
+    (models/naive_bayes.py `_fold_batch_kernel`) and the miners' device
+    count folds (ops/bitset.bitset_fold_counts, models/sequence.py
+    `_subseq_fold_kernel`) are the donated pattern this rule enforces.
+    Module-local like every rule here: an imported jitted fold is judged
+    in its defining module."""
+
+    rule_id = "fold-undonated-carry"
+    description = ("jitted fold carry re-dispatched per chunk without a "
+                   "donated accumulator")
+    hint = ("donate the carry: @partial(jax.jit, donate_argnums=(0,)) on "
+            "the fold kernel so the chunk loop reuses ONE device buffer "
+            "(the models/naive_bayes.py _fold_batch_kernel pattern), or "
+            "allowlist if the loop is few-iteration host-driven control")
+
+    def check(self, ctx: ModuleContext) -> Iterator[Finding]:
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, (ast.Assign, ast.AugAssign)) \
+                    or not ctx.in_loop(node):
+                continue
+            value = node.value
+            if not isinstance(value, ast.Call):
+                continue
+            fname = ctx.dotted(value.func)
+            tail = fname.rpartition(".")[2] if fname else None
+            if tail not in ctx.jitted_names or tail in ctx.jitted_donating:
+                continue
+            targets = (node.targets if isinstance(node, ast.Assign)
+                       else [node.target])
+            tnames = {ctx.dotted(t) for t in targets} - {None}
+            if not tnames:
+                continue
+            args = list(value.args) + [kw.value for kw in value.keywords]
+            carry = next((ctx.dotted(a) for a in args
+                          if ctx.dotted(a) in tnames), None)
+            if carry is not None:
+                yield self.finding(
+                    ctx, node,
+                    f"`{carry} = {tail}({carry}, ...)` in a loop: the "
+                    f"jitted fold's carry is not donated, so every chunk "
+                    f"allocates a fresh device accumulator")
+
+
 ALL_RULES = [DefaultInt64Rule, HostSyncInFoldRule, RecompileHazardRule,
              TracerLeakRule, UnseededStochasticTestRule,
-             ShardedHostMaterializeRule, Int64LiteralInJnpRule]
+             ShardedHostMaterializeRule, Int64LiteralInJnpRule,
+             FoldUndonatedCarryRule]
 
 
 def rule_ids() -> List[str]:

@@ -252,6 +252,61 @@ def double_buffered(items: Iterable[T]) -> Iterator[T]:
     return prefetched(items, depth=1)
 
 
+class SharedScan:
+    """ONE disk read + ONE parse per chunk, fanned out to N fold sinks.
+
+    The scan-sharing executor: every streamed job used to make its own
+    full pass over the same corpus (nb + mi + discriminant each re-read
+    and re-parsed the multi-GB churn CSV), so ingest cost — the measured
+    limiter once folds are vectorized — multiplied with the job count.
+    Here the chunk iterator (typically a prefetched() CSV/byte-block
+    reader) runs ONCE and each produced chunk is handed to every
+    registered sink in registration order, sequentially — fold order per
+    sink is exactly the order the one-job-one-scan path would see, which
+    is what makes shared-scan outputs byte-identical to per-job scans
+    (asserted by the chunk-invariance auditor's fused entries).
+
+    Error contract: a sink raising mid-scan closes the underlying
+    iterator before the exception propagates — for a prefetched() feed
+    that cancels AND joins the worker thread (the PR-4 _Prefetcher join
+    guarantee), so a failing consumer never wedges or leaks the
+    producer. Generator feeds built on ``yield from prefetched(...)``
+    (stream_job_inputs and friends) delegate close() the same way."""
+
+    def __init__(self, chunks: Iterable):
+        self._chunks = chunks
+        self._sinks: list = []
+
+    def add_sink(self, sink) -> None:
+        """Register a per-chunk consumer: any callable taking one chunk
+        (or an object with a ``consume`` method)."""
+        self._sinks.append(getattr(sink, "consume", sink))
+
+    def run(self) -> int:
+        """Drive the scan: one pull per chunk, every sink sees it.
+        Returns the number of chunks scanned."""
+        n = 0
+        it = iter(self._chunks)
+        try:
+            for chunk in it:
+                for sink in self._sinks:
+                    sink(chunk)
+                n += 1
+        except BaseException:
+            close = getattr(it, "close", None)
+            if close is not None:
+                try:
+                    close()          # join the worker; the sink's (or
+                except Exception:    # producer's) exception is already
+                    pass             # propagating — don't mask it
+            raise
+        else:
+            close = getattr(it, "close", None)
+            if close is not None:
+                close()
+        return n
+
+
 def stream_job_inputs(cfg, inputs: Iterable[str], schema: FeatureSchema,
                       keep_raw: bool = False) -> Iterator[Dataset]:
     """Per-job streaming input helper: prefetched block chunks of every

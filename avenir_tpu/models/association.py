@@ -38,6 +38,8 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from avenir_tpu.native.ingest import SpillScanMixin
+
 
 # --------------------------------------------------------------------------
 # Transaction ingest
@@ -109,7 +111,7 @@ class TransactionSet:
         return self.multihot.shape[0]
 
 
-class StreamingTransactionSource:
+class StreamingTransactionSource(SpillScanMixin):
     """Re-iterable chunked transaction reader for unbounded-size mining.
 
     Apriori is inherently multi-pass — the reference runs one MR job per
@@ -128,19 +130,24 @@ class StreamingTransactionSource:
     def __init__(self, paths: Sequence[str], delim: str = ",",
                  trans_id_ord: int = 0, skip_field_count: int = 1,
                  marker: Optional[str] = None,
-                 block_bytes: int = 64 << 20):
+                 block_bytes: int = 64 << 20,
+                 spill_cache: bool = True):
         self.paths = list(paths)
         self.delim = delim
         self.trans_id_ord = trans_id_ord
         self.skip = skip_field_count
         self.marker = marker
         self.block_bytes = block_bytes
+        self.spill_cache = spill_cache
         self.vocab: List[str] = []
         self.index: Dict[str, int] = {}
         self.n_trans = 0
         self._item_counts: Optional[np.ndarray] = None
         self._kept_ids: Optional[np.ndarray] = None   # orig ids, ascending
         self._remap: Optional[np.ndarray] = None      # orig id -> masked|-1
+        self._cache = None            # EncodedBlockCache once pass 1 ran
+        self._scan_counts: Optional[np.ndarray] = None
+        self._scan_encoder = None
 
     def _row_blocks(self):
         from avenir_tpu.core.stream import iter_line_blocks, prefetched
@@ -155,57 +162,78 @@ class StreamingTransactionSource:
                        for ln in lines]
 
     # ------------------------------------------------------------ pass 1
-    def scan_items(self) -> Tuple[List[str], np.ndarray, int]:
-        """Pass 1: (vocab, per-item transaction counts, n_trans). An item
-        repeated within one transaction counts once (multi-hot algebra)."""
-        if self._item_counts is not None:
-            return self.vocab, self._item_counts, self.n_trans
-        from avenir_tpu.native.ingest import native_seq_ready
+    # (scan lifecycle, SharedScan sink adapter and cache ownership live
+    # in native.ingest.SpillScanMixin — one copy for both miner sources)
+    @property
+    def _scan_marker(self) -> Optional[str]:
+        return self.marker
 
-        counts: List[int] = []
-        if native_seq_ready(self.delim):
-            self._item_counts = self._scan_items_native()
-        else:
-            for rows in self._row_blocks():
-                for row in rows:
-                    self.n_trans += 1
-                    seen = set()
-                    for tok in row[self.skip:]:
-                        if tok == "" or tok == self.marker:
-                            continue
-                        i = self.index.get(tok)
-                        if i is None:
-                            i = len(self.vocab)
-                            self.index[tok] = i
-                            self.vocab.append(tok)
-                            counts.append(0)
-                        seen.add(i)
-                    for i in seen:
-                        counts[i] += 1
-            self._item_counts = np.asarray(counts, np.int64)
+    def _reset_scan_state(self) -> None:
+        self.n_trans = 0
+
+    def _scan_result(self) -> Tuple[List[str], np.ndarray, int]:
         return self.vocab, self._item_counts, self.n_trans
 
-    def _scan_items_native(self) -> np.ndarray:
-        """Vocabulary discovery + k=1 support counts at native speed:
-        the shared scan_encode_blocks engine (vocabulary-stable blocks
-        never touch per-row Python) + deduped (transaction, item) counts
-        in numpy."""
-        from avenir_tpu.native.ingest import (csr_rows,
-                                              distinct_row_code_counts,
-                                              scan_encode_blocks)
+    def scan_items(self) -> Tuple[List[str], np.ndarray, int]:
+        """Pass 1: (vocab, per-item transaction counts, n_trans). An item
+        repeated within one transaction counts once (multi-hot algebra).
+        The pass also spills each block's region-compacted codes to the
+        encoded-block cache (when enabled), so every later per-k scan
+        replays encoded blocks instead of re-parsing CSV."""
+        if self._item_counts is not None:
+            return self.vocab, self._item_counts, self.n_trans
+        return self._scan_all()
 
-        counts = np.zeros(0, np.int64)
-        for codes, offsets, region, n in scan_encode_blocks(
-                self.paths, self.delim, self.skip, self.vocab, self.index,
-                self.block_bytes, marker=self.marker):
-            v = len(self.vocab)
-            if counts.shape[0] < v:
-                counts = np.concatenate(
-                    [counts, np.zeros(v - counts.shape[0], np.int64)])
+    def _scan_block(self, data: bytes) -> None:
+        """Fold one raw byte block into the pass-1 state (native encoder
+        when built, python tokenizer otherwise) and spill its encoded
+        form to the cache."""
+        from avenir_tpu.native.ingest import (csr_rows,
+                                              distinct_row_code_counts)
+
+        if self._scan_encoder is not None:
+            out = self._scan_encoder.encode(data)
+            if out is None:
+                return
+            codes, offsets, region, n = out
+            self._grow_counts()
             row_of, _ = csr_rows(offsets)
-            counts += distinct_row_code_counts(row_of, codes, region, v)
+            self._scan_counts += distinct_row_code_counts(
+                row_of, codes, region, len(self.vocab))
+            if self._cache is not None:
+                blk_counts = np.bincount(row_of[region].astype(np.intp),
+                                         minlength=n)
+                self._cache.add_block(blk_counts, codes[region])
             self.n_trans += n
-        return counts
+            return
+        rows = [[t.strip(" \t\r") for t in ln.split(self.delim)]
+                for ln in data.decode("utf-8", "replace").split("\n")
+                if ln.strip()]
+        if not rows:
+            return
+        blk_counts = np.zeros(len(rows), np.int64)
+        blk_codes: List[int] = []
+        for r, row in enumerate(rows):
+            k0 = len(blk_codes)
+            for tok in row[self.skip:]:
+                if tok == "" or tok == self.marker:
+                    continue
+                i = self.index.get(tok)
+                if i is None:
+                    i = len(self.vocab)
+                    self.index[tok] = i
+                    self.vocab.append(tok)
+                blk_codes.append(i)
+            blk_counts[r] = len(blk_codes) - k0
+        codes = np.asarray(blk_codes, np.int32)
+        self._grow_counts()
+        row_of = np.repeat(np.arange(len(rows), dtype=np.int32), blk_counts)
+        region = np.ones(codes.shape[0], bool)
+        self._scan_counts += distinct_row_code_counts(
+            row_of, codes, region, len(self.vocab))
+        if self._cache is not None:
+            self._cache.add_block(blk_counts, codes)
+        self.n_trans += len(rows)
 
     # ----------------------------------------------------- frequent mask
     def mask_items(self, keep_ids: Sequence[int]) -> int:
@@ -252,12 +280,32 @@ class StreamingTransactionSource:
             yield pack_rows_u32(mh)
 
     def _dense_chunks(self, block_rows: int):
-        """uint8 [block_rows, V_masked] multi-hot blocks (mask applied)."""
+        """uint8 [block_rows, V_masked] multi-hot blocks (mask applied).
+        Replays the encoded-block cache when pass 1 spilled one and the
+        sources are unchanged — no CSV read, no re-tokenize; otherwise
+        the native (or python) re-parse path runs as before."""
+        from avenir_tpu.core.stream import prefetched
         from avenir_tpu.native.ingest import (csr_region_mask, csr_rows,
                                               native_seq_ready,
                                               seq_encode_native)
 
         vm = max(self.masked_width, 1)
+        if self._cache is not None and self._cache.valid:
+            for counts, codes in prefetched(self._cache.blocks(), depth=1):
+                n = counts.shape[0]
+                if n <= 0:
+                    continue
+                row_of = np.repeat(np.arange(n, dtype=np.int32), counts)
+                r, c = self._apply_mask(row_of, codes)
+                bounds = np.searchsorted(
+                    r, np.arange(0, n + block_rows, block_rows,
+                                 dtype=np.int32))
+                for page, (lo, hi) in enumerate(
+                        zip(bounds[:-1], bounds[1:])):
+                    mh = np.zeros((block_rows, vm), np.uint8)
+                    mh[r[lo:hi] - page * block_rows, c[lo:hi]] = 1
+                    yield mh
+            return
         if native_seq_ready(self.delim):
             from avenir_tpu.core.stream import iter_byte_blocks, prefetched
 
@@ -529,9 +577,13 @@ class FrequentItemsApriori:
         executable serves every round, and the exact-transaction-id pass
         runs ONCE over the kept sets of ALL lengths fused into a single
         candidate matrix instead of one streamed scan per k. Chunk
-        encode/pack double-buffers against the device fold."""
+        encode/pack double-buffers against the device fold, whose int32
+        carry is DONATED (ops.bitset.bitset_fold_counts) — per-k rounds
+        dispatch asynchronously with one host pull at the end. Per-k
+        re-scans replay the pass-1 encoded-block cache when the sources
+        are unchanged (see EncodedBlockCache) instead of re-parsing."""
         from avenir_tpu.core.stream import double_buffered
-        from avenir_tpu.ops.bitset import (bitset_contain_counts,
+        from avenir_tpu.ops.bitset import (bitset_fold_counts,
                                            pack_index_rows_u32)
 
         vocab, col_counts, n = src.scan_items()
@@ -556,11 +608,11 @@ class FrequentItemsApriori:
             # reuse the compiled executable; zero candidate rows count 0
             c_pad = max(64, 1 << (len(cands) - 1).bit_length())
             cand_d = jnp.asarray(pack_index_rows_u32(cands, vm, c_pad))
-            counts = np.zeros(c_pad, np.int64)
+            counts_d = jnp.zeros(c_pad, jnp.int32)
             for packed in double_buffered(src.packed_chunks(self.block)):
-                counts += np.asarray(
-                    bitset_contain_counts(jnp.asarray(packed), cand_d),
-                    np.int64)
+                counts_d = bitset_fold_counts(
+                    counts_d, jnp.asarray(packed), cand_d)
+            counts = np.asarray(counts_d, np.int64)
             kept = [(c, int(cnt)) for c, cnt in zip(cands, counts[:len(cands)])
                     if cnt > min_count]
             if not kept:

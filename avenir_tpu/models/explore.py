@@ -86,6 +86,41 @@ def _mi_chunk_counts(codes, y, bmax: int, k: int, nf: int):
     return fc, pair, pairc
 
 
+def _mi_chunk_counts_host(codes, y, bmax: int, k: int, nf: int):
+    """_mi_chunk_counts in host numpy. XLA:CPU lowers segment_sum to a
+    SERIAL per-element scatter — ~3s per 1.2M-row chunk on a laptop-class
+    core, 50x the parse cost, which made MI the limiter of the CPU
+    streaming proxies (and of the shared-scan fan-out, where its fold
+    shares the scan with NB + discriminant). Here each table is one
+    np.bincount over a small per-table int32 keyspace: per-PAIR keys (no
+    fused [n, P] key tensor — the giant temporaries, not the counting,
+    dominate host time), vectorized and exact. The device kernel fuses
+    pairs because a dispatch costs ~fixed latency; a numpy call doesn't.
+    Counts are integers, so both paths produce bit-identical tables and
+    chunk-layout invariance is unaffected."""
+    codes = np.ascontiguousarray(codes, np.int32)
+    y = np.asarray(y, np.int32)
+    fc = np.empty((nf, bmax, k), np.int64)
+    for f in range(nf):
+        fc[f] = np.bincount(codes[:, f] * np.int32(k) + y,
+                            minlength=bmax * k).reshape(bmax, k)
+    npair = nf * (nf - 1) // 2
+    pair = np.empty((npair, bmax, bmax), np.int64)
+    pairc = np.empty((npair, bmax, bmax, k), np.int64)
+    p = 0
+    for i in range(nf):
+        ci_b = codes[:, i] * np.int32(bmax)
+        for j in range(i + 1, nf):
+            key = ci_b + codes[:, j]
+            pair[p] = np.bincount(
+                key, minlength=bmax * bmax).reshape(bmax, bmax)
+            pairc[p] = np.bincount(
+                key * np.int32(k) + y,
+                minlength=bmax * bmax * k).reshape(bmax, bmax, k)
+            p += 1
+    return fc, pair, pairc
+
+
 class MutualInformationAnalyzer:
     """MutualInformation MR job equivalent (MutualInformation.java:62).
 
@@ -150,10 +185,17 @@ class MutualInformationAnalyzer:
         bmax = max(bins) if bins else 1
         fused_keys = (F * (F - 1) // 2) * bmax * bmax * self.k
         if fused_keys < _FUSED_KEYSPACE_LIMIT:
+            # device segment_sums on accelerators; vectorized bincount on
+            # CPU hosts (XLA:CPU scatter is serial — see the host fn).
+            # Integer counts: both produce bit-identical tables.
+            if jax.default_backend() == "cpu":
+                kernel, codes_a, y_a = (_mi_chunk_counts_host, codes,
+                                        ds.labels())
+            else:
+                kernel = _mi_chunk_counts
+                codes_a, y_a = jnp.asarray(codes), jnp.asarray(ds.labels())
             fc, pair, pairc = (np.asarray(a, np.float64) for a in
-                               _mi_chunk_counts(jnp.asarray(codes),
-                                                jnp.asarray(ds.labels()),
-                                                bmax, self.k, F))
+                               kernel(codes_a, y_a, bmax, self.k, F))
             p = 0
             for i in range(F):
                 self._fc[i] = _padded_add(self._fc[i], fc[i, :bins[i]])

@@ -23,11 +23,14 @@ compiled pass with [N, C] state.
 from __future__ import annotations
 
 from dataclasses import dataclass
+from functools import partial
 from typing import Dict, Iterable, List, Optional, Sequence, Tuple
 
 import numpy as np
 import jax
 import jax.numpy as jnp
+
+from avenir_tpu.native.ingest import SpillScanMixin
 
 
 # ---------------------------------------------------------------------------
@@ -105,6 +108,17 @@ def _subseq_support_kernel(rows: jnp.ndarray, cands: jnp.ndarray,
                    axis=0, dtype=jnp.int32)
 
 
+@partial(jax.jit, donate_argnums=(0,))
+def _subseq_fold_kernel(acc: jnp.ndarray, rows: jnp.ndarray,
+                        cands: jnp.ndarray, k_vec: jnp.ndarray):
+    """acc + _subseq_support_kernel(rows, cands, k_vec) with the
+    accumulator DONATED — the streamed GSP per-chunk fold carry. One [C]
+    int32 buffer lives on device across the whole per-k pass (no
+    per-chunk allocation, no host round trip); int32 support counts are
+    exact, so the fold is chunk-layout-invariant by associativity."""
+    return acc + _subseq_support_kernel(rows, cands, k_vec)
+
+
 @dataclass
 class SequenceSet:
     """Dictionary-encoded, padded sequences (pad token -1)."""
@@ -141,7 +155,7 @@ class SequenceSet:
         return self.rows.shape[0]
 
 
-class StreamingSequenceSource:
+class StreamingSequenceSource(SpillScanMixin):
     """Re-iterable chunked sequence reader for unbounded-size GSP mining.
 
     GSP is inherently multi-pass (the reference runs one MR job per
@@ -152,11 +166,13 @@ class StreamingSequenceSource:
     vocabulary (native seq_encode when built, python split otherwise)."""
 
     def __init__(self, paths: Sequence[str], delim: str = ",",
-                 skip_field_count: int = 1, block_bytes: int = 64 << 20):
+                 skip_field_count: int = 1, block_bytes: int = 64 << 20,
+                 spill_cache: bool = True):
         self.paths = list(paths)
         self.delim = delim
         self.skip = skip_field_count
         self.block_bytes = block_bytes
+        self.spill_cache = spill_cache
         self.vocab: List[str] = []
         self.index: Dict[str, int] = {}
         self.n_rows = 0
@@ -164,6 +180,9 @@ class StreamingSequenceSource:
         self._item_counts: Optional[np.ndarray] = None
         self._kept_ids: Optional[np.ndarray] = None   # orig ids, ascending
         self._remap: Optional[np.ndarray] = None      # orig id -> masked|-1
+        self._cache = None            # EncodedBlockCache once pass 1 ran
+        self._scan_counts: Optional[np.ndarray] = None
+        self._scan_encoder = None
 
     def _line_blocks(self):
         from avenir_tpu.core.stream import iter_line_blocks, prefetched
@@ -199,65 +218,78 @@ class StreamingSequenceSource:
                 return -2
         return i
 
+    # (scan lifecycle, SharedScan sink adapter and cache ownership live
+    # in native.ingest.SpillScanMixin — one copy for both miner sources)
+    def _reset_scan_state(self) -> None:
+        self.n_rows = 0
+        self.t_max = 1
+
+    def _scan_result(self) -> Tuple[List[str], np.ndarray, int]:
+        return self.vocab, self._item_counts, self.n_rows
+
     def scan(self) -> Tuple[List[str], np.ndarray, int]:
         """Pass 1: (vocab, per-token row-presence counts, n_rows) — the
         k=1 support counts; also records t_max for fixed-shape chunks.
         Rides the native encoder when built (vocabulary-stable blocks
         never touch per-row Python, same discovery scheme as the
-        association source)."""
-        from avenir_tpu.native.ingest import native_seq_ready
-
+        association source), and spills each block's region-compacted
+        codes to the encoded-block cache so later per-k support scans
+        replay encoded blocks instead of re-parsing CSV."""
         if self._item_counts is not None:
             return self.vocab, self._item_counts, self.n_rows
-        if native_seq_ready(self.delim):
-            self._item_counts = self._scan_native()
-            return self.vocab, self._item_counts, self.n_rows
-        counts: List[int] = []
-        for lines in self._line_blocks():
-            for ln in lines:
-                toks = [t.strip(" \t\r")
-                        for t in ln.split(self.delim)][self.skip:]
-                toks = [t for t in toks if t != ""]
-                self.n_rows += 1
-                self.t_max = max(self.t_max, len(toks))
-                seen = set()
-                for tok in toks:
-                    i = self.index.get(tok)
-                    if i is None:
-                        i = len(self.vocab)
-                        self.index[tok] = i
-                        self.vocab.append(tok)
-                        counts.append(0)
-                    seen.add(i)
-                for i in seen:
-                    counts[i] += 1
-        self._item_counts = np.asarray(counts, np.int64)
-        return self.vocab, self._item_counts, self.n_rows
+        return self._scan_all()
 
-    def _scan_native(self) -> np.ndarray:
-        """Vocabulary discovery + k=1 row-presence counts + t_max at
-        native speed: the shared scan_encode_blocks engine + deduped
-        (row, token) counts, plus the per-row valid-token maximum for
-        t_max (fixed-shape chunk sizing)."""
+    def _scan_block(self, data: bytes) -> None:
         from avenir_tpu.native.ingest import (csr_rows,
-                                              distinct_row_code_counts,
-                                              scan_encode_blocks)
+                                              distinct_row_code_counts)
 
-        counts = np.zeros(0, np.int64)
-        for codes, offsets, region, n in scan_encode_blocks(
-                self.paths, self.delim, self.skip, self.vocab, self.index,
-                self.block_bytes):
-            v = len(self.vocab)
-            if counts.shape[0] < v:
-                counts = np.concatenate(
-                    [counts, np.zeros(v - counts.shape[0], np.int64)])
+        if self._scan_encoder is not None:
+            out = self._scan_encoder.encode(data)
+            if out is None:
+                return
+            codes, offsets, region, n = out
+            self._grow_counts()
             row_of, _ = csr_rows(offsets)
             per_row = np.bincount(row_of[region].astype(np.intp),
                                   minlength=n)
             self.t_max = max(self.t_max, int(per_row.max(initial=0)))
-            counts += distinct_row_code_counts(row_of, codes, region, v)
+            self._scan_counts += distinct_row_code_counts(
+                row_of, codes, region, len(self.vocab))
+            if self._cache is not None:
+                self._cache.add_block(per_row, codes[region])
             self.n_rows += n
-        return counts
+            return
+        lines = [ln for ln in data.decode("utf-8", "replace").split("\n")
+                 if ln.strip()]
+        if not lines:
+            return
+        blk_counts = np.zeros(len(lines), np.int64)
+        blk_codes: List[int] = []
+        for r, ln in enumerate(lines):
+            toks = [t.strip(" \t\r")
+                    for t in ln.split(self.delim)][self.skip:]
+            k0 = len(blk_codes)
+            for tok in toks:
+                if tok == "":
+                    continue
+                i = self.index.get(tok)
+                if i is None:
+                    i = len(self.vocab)
+                    self.index[tok] = i
+                    self.vocab.append(tok)
+                blk_codes.append(i)
+            blk_counts[r] = len(blk_codes) - k0
+            self.t_max = max(self.t_max, int(blk_counts[r]))
+        codes = np.asarray(blk_codes, np.int32)
+        self._grow_counts()
+        row_of = np.repeat(np.arange(len(lines), dtype=np.int32),
+                           blk_counts)
+        region = np.ones(codes.shape[0], bool)
+        self._scan_counts += distinct_row_code_counts(
+            row_of, codes, region, len(self.vocab))
+        if self._cache is not None:
+            self._cache.add_block(blk_counts, codes)
+        self.n_rows += len(lines)
 
     def chunks(self, block_rows: int = 65536):
         """Yield padded int32 [rows_bucket, t_bucket] blocks (pad -1;
@@ -273,6 +305,51 @@ class StreamingSequenceSource:
 
         def bucket(x: int, lo: int) -> int:
             return max(lo, 1 << (max(x, 1) - 1).bit_length())
+
+        def pages(rows_v, pos, enc, n):
+            """Fixed-shape padded pages of one block's surviving tokens —
+            shared by the re-parse and cache-replay paths so both yield
+            bit-identical blocks."""
+            bounds = np.searchsorted(
+                rows_v, np.arange(0, n + block_rows, block_rows,
+                                  dtype=np.int32))
+            for page, (lo, hi) in enumerate(zip(bounds[:-1], bounds[1:])):
+                rows_here = min(block_rows, n - page * block_rows)
+                t_here = int(pos[lo:hi].max(initial=0)) + 1
+                blk = np.full((bucket(rows_here, 1024),
+                               bucket(t_here, 16)), -1, np.int32)
+                blk[rows_v[lo:hi] - page * block_rows,
+                    pos[lo:hi]] = enc[lo:hi]
+                yield blk
+
+        if self._cache is not None and self._cache.valid:
+            # encoded-block replay: the pass-1 cache holds each block's
+            # region tokens (counts per row + codes) — apply the
+            # frequent-token mask, recompute compacted positions, page.
+            # No CSV read, no tokenizer, either engine.
+            from avenir_tpu.core.stream import prefetched
+
+            for counts, codes in prefetched(self._cache.blocks(), depth=1):
+                n = counts.shape[0]
+                if n <= 0:
+                    continue
+                starts = np.zeros(n, np.int64)
+                np.cumsum(counts[:-1], out=starts[1:])
+                row_of = np.repeat(np.arange(n, dtype=np.int32), counts)
+                if self._remap is not None:
+                    enc_all = self._remap[codes]
+                    valid = enc_all >= 0
+                else:
+                    enc_all = codes
+                    valid = np.ones(codes.shape[0], bool)
+                cs = np.cumsum(valid, dtype=np.int32)
+                base = np.zeros(n, np.int32)
+                nz = starts > 0
+                base[nz] = cs[starts[nz] - 1]
+                rows_v = row_of[valid]
+                pos = cs[valid] - 1 - base[rows_v]
+                yield from pages(rows_v, pos, enc_all[valid], n)
+            return
 
         if native_seq_ready(self.delim):
             from avenir_tpu.core.stream import iter_byte_blocks, prefetched
@@ -309,19 +386,7 @@ class StreamingSequenceSource:
                     base[nz] = cs[starts[nz] - 1]
                     rows_v = row_of[valid]
                     pos = cs[valid] - 1 - base[rows_v]
-                    enc = codes[valid]
-                    bounds = np.searchsorted(
-                        rows_v, np.arange(0, n + block_rows, block_rows,
-                                          dtype=np.int32))
-                    for page, (lo, hi) in enumerate(
-                            zip(bounds[:-1], bounds[1:])):
-                        rows_here = min(block_rows, n - page * block_rows)
-                        t_here = int(pos[lo:hi].max(initial=0)) + 1
-                        blk = np.full((bucket(rows_here, 1024),
-                                       bucket(t_here, 16)), -1, np.int32)
-                        blk[rows_v[lo:hi] - page * block_rows,
-                            pos[lo:hi]] = enc[lo:hi]
-                        yield blk
+                    yield from pages(rows_v, pos, codes[valid], n)
             return
 
         buf: List[List[int]] = []
@@ -445,10 +510,11 @@ class GSPMiner:
             # multiplies real work (unlike the bitset matmul's free lanes)
             c_pad = max(16, 1 << (len(cands) - 1).bit_length())
             cand_d, kv = self._cand_arrays(cands, src.token_code, c_pad)
-            counts = np.zeros(c_pad, np.int64)
+            counts_d = jnp.zeros(c_pad, jnp.int32)
             for blk in double_buffered(src.chunks(self.block)):
-                counts += np.asarray(_subseq_support_kernel(
-                    jnp.asarray(blk), cand_d, kv), dtype=np.int64)
+                counts_d = _subseq_fold_kernel(
+                    counts_d, jnp.asarray(blk), cand_d, kv)
+            counts = np.asarray(counts_d, np.int64)
             freq = {c: cnt / n
                     for c, cnt in zip(cands, counts[: len(cands)])
                     if cnt > min_count}

@@ -12,7 +12,7 @@ import ctypes
 import os
 import subprocess
 import threading
-from typing import Dict, List, Optional, Tuple
+from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -255,12 +255,12 @@ def csr_region_mask(offsets: np.ndarray, skip: int, n_tokens: int
     return region
 
 
-def scan_encode_blocks(paths, delim: str, skip: int, vocab: List[str],
-                       index: Dict[str, int], block_bytes: int,
-                       marker: Optional[str] = None):
-    """Vocabulary-DISCOVERING native scan: yield (codes, offsets, region,
-    n_rows) per byte block — the shared pass-1 engine of the streaming
-    miners (association scan_items, sequence scan).
+class BlockScanEncoder:
+    """Per-block body of the vocabulary-DISCOVERING native scan — the
+    shared pass-1 engine of the streaming miners (association
+    scan_items, sequence scan), factored so an external SharedScan can
+    drive it one byte block at a time (core.stream.SharedScan fans one
+    disk read out to N sinks; this is the miner-side sink body).
 
     Each block encodes against the CURRENT vocab plus two drop
     sentinels (the infrequent-item marker and the empty token, which
@@ -272,37 +272,227 @@ def scan_encode_blocks(paths, delim: str, skip: int, vocab: List[str],
     per-row Python. `region` is True exactly at item positions holding
     a REAL vocab code (sentinels, ids and short rows excluded), so
     callers can fold counts straight off (codes[region], row_of[region]).
-    """
+    Vocab codes are append-only, so codes encoded against an EARLIER
+    vocab prefix stay valid against the final vocabulary — the property
+    the encoded-block spill cache (EncodedBlockCache) is built on."""
+
+    def __init__(self, delim: str, skip: int, vocab: List[str],
+                 index: Dict[str, int], marker: Optional[str] = None):
+        self.delim = delim
+        self.skip = skip
+        self.vocab = vocab
+        self.index = index
+        self.marker = marker
+        self._sentinels = ([marker] if marker is not None else []) + [""]
+
+    def encode(self, data: bytes):
+        """(codes, offsets, region, n_rows) for one raw byte block, or
+        None for a block with no rows."""
+        codes, offsets = seq_encode_native(data, self.delim,
+                                           self.vocab + self._sentinels)
+        n = offsets.shape[0] - 1
+        if n <= 0:
+            return None
+        region = csr_region_mask(offsets, self.skip, codes.shape[0])
+        if (codes[region] < 0).any():
+            added = False
+            for ln in data.decode("utf-8", "replace").split("\n"):
+                if not ln.strip():
+                    continue
+                for tok in [t.strip(" \t\r")
+                            for t in ln.split(self.delim)][self.skip:]:
+                    if tok and tok != self.marker and tok not in self.index:
+                        self.index[tok] = len(self.vocab)
+                        self.vocab.append(tok)
+                        added = True
+            if added:
+                codes, offsets = seq_encode_native(
+                    data, self.delim, self.vocab + self._sentinels)
+        v = len(self.vocab)
+        np.logical_and(region, codes >= 0, out=region)
+        np.logical_and(region, codes < v, out=region)     # sentinels drop
+        return codes, offsets, region, n
+
+
+def scan_encode_blocks(paths, delim: str, skip: int, vocab: List[str],
+                       index: Dict[str, int], block_bytes: int,
+                       marker: Optional[str] = None):
+    """Vocabulary-DISCOVERING native scan: yield (codes, offsets, region,
+    n_rows) per byte block (see BlockScanEncoder for the per-block
+    contract; this generator owns the prefetched disk read)."""
     from avenir_tpu.core.stream import iter_byte_blocks, prefetched
 
-    sentinels = ([marker] if marker is not None else []) + [""]
+    enc = BlockScanEncoder(delim, skip, vocab, index, marker)
     for path in paths:
         for data in prefetched(iter_byte_blocks(path, block_bytes),
                                depth=1):
-            codes, offsets = seq_encode_native(data, delim,
-                                               vocab + sentinels)
-            n = offsets.shape[0] - 1
-            if n <= 0:
-                continue
-            region = csr_region_mask(offsets, skip, codes.shape[0])
-            if (codes[region] < 0).any():
-                added = False
-                for ln in data.decode("utf-8", "replace").split("\n"):
-                    if not ln.strip():
-                        continue
-                    for tok in [t.strip(" \t\r")
-                                for t in ln.split(delim)][skip:]:
-                        if tok and tok != marker and tok not in index:
-                            index[tok] = len(vocab)
-                            vocab.append(tok)
-                            added = True
-                if added:
-                    codes, offsets = seq_encode_native(data, delim,
-                                                       vocab + sentinels)
-            v = len(vocab)
-            np.logical_and(region, codes >= 0, out=region)
-            np.logical_and(region, codes < v, out=region)   # sentinels drop
-            yield codes, offsets, region, n
+            out = enc.encode(data)
+            if out is not None:
+                yield out
+
+
+# --------------------------------------------------------------------------
+# Encoded-block spill cache
+# --------------------------------------------------------------------------
+_ENC_MAGIC = b"AVNRENC1"
+_ENC_DTYPES = {0: np.uint8, 1: np.uint16, 2: np.uint32}
+
+
+def _enc_dtype_code(max_value: int) -> int:
+    if max_value < (1 << 8):
+        return 0
+    if max_value < (1 << 16):
+        return 1
+    return 2
+
+
+class EncodedBlockCache:
+    """Compact on-disk spill cache of region-compacted encoded blocks.
+
+    The multi-pass miners (Apriori / GSP) re-scan their CSV once per
+    itemset length k; after PR 1 the scan cost — disk read + native
+    tokenize/encode — dominates each pass, not the device fold. The
+    discovery scan (pass 1) already produces every later pass's inputs:
+    the region-masked vocab codes of each block, in row order. This
+    cache spills exactly that, per block:
+
+        header  <q n_rows> <q n_tokens> <B counts_dtype> <B codes_dtype>
+        counts  n_rows  elements — region token count per row
+        codes   n_tokens elements — vocab codes of region tokens, row-major
+
+    with the narrowest dtype that fits (1-byte codes for vocabularies
+    under 256 items), so the cache is a fraction of the raw CSV bytes —
+    replay passes read it instead of re-parsing CSV, and the raw-block /
+    full-codes transients of the scan never materialize again (this is
+    also what buys back Apriori's thin RSS headroom at 100M rows).
+
+    Invalidation contract: the cache fingerprints its source files
+    (path, size, mtime_ns) at begin() and re-verifies at commit() and
+    before every replay — a source that changed invalidates the cache
+    and consumers fall back to the re-parse path. The cache directory is
+    owned by this object (a tempdir unless `cache_dir` is given) and is
+    removed on close()/GC; it is a within-job spill, not a cross-run
+    artifact store."""
+
+    def __init__(self, sources: Sequence[str],
+                 cache_dir: Optional[str] = None):
+        import tempfile
+
+        self.sources = list(sources)
+        self._own_dir = cache_dir is None
+        self._dir = cache_dir or tempfile.mkdtemp(prefix="avenir_encblk_")
+        os.makedirs(self._dir, exist_ok=True)
+        self._path = os.path.join(self._dir, "encoded_blocks.bin")
+        self._fh = None
+        self._fingerprint = None
+        self._committed = False
+        self.n_blocks = 0
+        self.replays = 0          # completed replay passes (bench tripwire)
+
+    # ------------------------------------------------------------- write
+    def _current_fingerprint(self):
+        out = []
+        for p in self.sources:
+            try:
+                st = os.stat(p)
+                out.append((p, st.st_size, st.st_mtime_ns))
+            except OSError:
+                out.append((p, -1, -1))
+        return tuple(out)
+
+    def begin(self) -> None:
+        """Start (or restart) a write pass; any prior content is gone."""
+        self.abort()
+        self._fingerprint = self._current_fingerprint()
+        self._fh = open(self._path, "wb")
+        self._fh.write(_ENC_MAGIC)
+        self.n_blocks = 0
+
+    def add_block(self, counts: np.ndarray, codes: np.ndarray) -> None:
+        """Append one block: per-row region token counts + the region
+        token codes (row-major). Narrowest-dtype encoding per block."""
+        import struct
+
+        if self._fh is None:
+            raise RuntimeError("add_block() before begin()")
+        counts = np.ascontiguousarray(counts)
+        codes = np.ascontiguousarray(codes)
+        cd = _enc_dtype_code(int(counts.max(initial=0)))
+        kd = _enc_dtype_code(int(codes.max(initial=0)))
+        self._fh.write(struct.pack("<qqBB", counts.shape[0],
+                                   codes.shape[0], cd, kd))
+        counts.astype(_ENC_DTYPES[cd]).tofile(self._fh)
+        codes.astype(_ENC_DTYPES[kd]).tofile(self._fh)
+        self.n_blocks += 1
+
+    def commit(self) -> bool:
+        """Seal the write pass. Returns False (and stays invalid) when a
+        source changed while the scan ran — a torn cache must never be
+        replayed."""
+        if self._fh is None:
+            return False
+        self._fh.close()
+        self._fh = None
+        self._committed = self._fingerprint == self._current_fingerprint()
+        return self._committed
+
+    def abort(self) -> None:
+        if self._fh is not None:
+            self._fh.close()
+            self._fh = None
+        self._committed = False
+
+    # ------------------------------------------------------------ replay
+    @property
+    def valid(self) -> bool:
+        """True when a committed cache exists AND the sources are
+        byte-for-byte the ones it encoded (size+mtime fingerprint)."""
+        return (self._committed
+                and self._fingerprint == self._current_fingerprint()
+                and os.path.exists(self._path))
+
+    def blocks(self):
+        """Yield (counts int64 [n_rows], codes int32 [n_tokens]) per
+        cached block. Raises RuntimeError when the cache is not valid —
+        callers check `valid` and fall back to the re-parse path."""
+        import struct
+
+        if not self.valid:
+            raise RuntimeError("encoded-block cache is stale or absent")
+        with open(self._path, "rb") as fh:
+            if fh.read(len(_ENC_MAGIC)) != _ENC_MAGIC:
+                raise RuntimeError("encoded-block cache is corrupt")
+            while True:
+                head = fh.read(18)
+                if not head:
+                    break
+                n_rows, n_tok, cd, kd = struct.unpack("<qqBB", head)
+                counts = np.fromfile(fh, _ENC_DTYPES[cd], n_rows)
+                codes = np.fromfile(fh, _ENC_DTYPES[kd], n_tok)
+                if counts.shape[0] != n_rows or codes.shape[0] != n_tok:
+                    raise RuntimeError("encoded-block cache is truncated")
+                yield counts.astype(np.int64), codes.astype(np.int32)
+        self.replays += 1
+
+    def nbytes(self) -> int:
+        try:
+            return os.path.getsize(self._path)
+        except OSError:
+            return 0
+
+    # ----------------------------------------------------------- cleanup
+    def close(self) -> None:
+        import shutil
+
+        self.abort()
+        if self._own_dir:
+            shutil.rmtree(self._dir, ignore_errors=True)
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
 
 
 def distinct_row_code_counts(row_of: np.ndarray, codes: np.ndarray,
@@ -319,6 +509,106 @@ def distinct_row_code_counts(row_of: np.ndarray, codes: np.ndarray,
     uniq[0] = True
     np.not_equal(keys[1:], keys[:-1], out=uniq[1:])
     return np.bincount((keys[uniq] % v).astype(np.intp), minlength=v)
+
+
+class SpillScanMixin:
+    """Shared pass-1 machinery of the streaming miner sources
+    (association.StreamingTransactionSource, sequence.
+    StreamingSequenceSource): the scan lifecycle (begin -> per-block
+    -> finish/commit), the SharedScan sink adapter, and the encoded-
+    block cache's ownership. ONE copy, so a cache-lifecycle fix can
+    never land in one miner and silently miss the other.
+
+    Subclass contract — attributes: ``paths``, ``delim``, ``skip``,
+    ``block_bytes``, ``spill_cache``, ``vocab``, ``index``, ``_cache``,
+    ``_item_counts``, ``_scan_counts``, ``_scan_encoder``; methods:
+    ``_scan_block(data)`` (fold one raw byte block, updating
+    ``_scan_counts`` via ``_grow_counts`` and spilling to ``_cache``),
+    ``_reset_scan_state()`` (zero the per-scan row counters) and
+    ``_scan_result()`` (the (vocab, counts, n) tuple scan()/scan_items()
+    return). ``_scan_marker`` is the infrequent-item sentinel forwarded
+    to the encoder (None when the format has none)."""
+
+    _scan_marker: Optional[str] = None
+
+    def _scan_begin(self) -> None:
+        self._reset_scan_state()
+        self._scan_counts = np.zeros(0, np.int64)
+        self._scan_encoder = (
+            BlockScanEncoder(self.delim, self.skip, self.vocab, self.index,
+                             marker=self._scan_marker)
+            if native_seq_ready(self.delim) else None)
+        if self.spill_cache:
+            if self._cache is not None:
+                self._cache.close()
+            self._cache = EncodedBlockCache(self.paths)
+            self._cache.begin()
+
+    def _grow_counts(self) -> None:
+        v = len(self.vocab)
+        if self._scan_counts.shape[0] < v:
+            self._scan_counts = np.concatenate(
+                [self._scan_counts,
+                 np.zeros(v - self._scan_counts.shape[0], np.int64)])
+
+    def _scan_all(self):
+        """Own-read scan driver: prefetched byte blocks of every path
+        through _scan_block, then seal."""
+        from avenir_tpu.core.stream import iter_byte_blocks, prefetched
+
+        self._scan_begin()
+        for path in self.paths:
+            for data in prefetched(iter_byte_blocks(path, self.block_bytes),
+                                   depth=1):
+                self._scan_block(data)
+        return self._scan_finish()
+
+    def scan_consumer(self):
+        """Shared-scan sink: pass 1 driven by EXTERNAL raw byte blocks
+        (core.stream.SharedScan fans one disk read to N such sinks).
+        consume() per block; finish() seals the scan and returns what
+        the source's own scan entry point would."""
+        self._scan_begin()
+        src = self
+
+        class _ScanSink:
+            def consume(self, data: bytes) -> None:
+                src._scan_block(data)
+
+            def finish(self):
+                return src._scan_finish()
+
+        return _ScanSink()
+
+    def _scan_finish(self):
+        self._item_counts = self._scan_counts
+        self._scan_encoder = None
+        if self._cache is not None and not self._cache.commit():
+            # a source changed under the scan: never replay a torn cache
+            self._cache.close()
+            self._cache = None
+        return self._scan_result()
+
+    @property
+    def cache_replays(self) -> int:
+        """Completed encoded-block replay passes (bench tripwire hook)."""
+        return self._cache.replays if self._cache is not None else 0
+
+    @property
+    def cache_nbytes(self) -> int:
+        """On-disk size of the encoded-block spill cache (0 when off)."""
+        return self._cache.nbytes() if self._cache is not None else 0
+
+    def close(self) -> None:
+        if self._cache is not None:
+            self._cache.close()
+            self._cache = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
 
 
 def extract_column_native(data: bytes, delim: str, ordinal: int

@@ -24,6 +24,7 @@ lowers to the VPU on TPU and to vectorized code on CPU.
 
 from __future__ import annotations
 
+from functools import partial
 from typing import Sequence, Tuple
 
 import numpy as np
@@ -98,6 +99,19 @@ def bitset_contain_counts(trans: jnp.ndarray, cand: jnp.ndarray
     contained = _overlap_fold(trans, cand) == weight[None, :]       # [B, C]
     return jnp.sum(contained & (weight > 0)[None, :], axis=0,
                    dtype=jnp.int32)
+
+
+@partial(jax.jit, donate_argnums=(0,))
+def bitset_fold_counts(acc: jnp.ndarray, trans: jnp.ndarray,
+                       cand: jnp.ndarray) -> jnp.ndarray:
+    """acc + bitset_contain_counts(trans, cand) with the accumulator
+    DONATED: the per-chunk fold carry of the streamed miners. A chunk
+    loop re-dispatching this keeps exactly one [C] int32 buffer alive on
+    device (the donated input aliases the output) and never round-trips
+    the host — counts are exact int32 (bounded by the transaction count,
+    < 2^31 at any measured scale), so the fold is chunk-layout-invariant
+    by integer associativity."""
+    return acc + bitset_contain_counts(trans, cand)
 
 
 @jax.jit

@@ -110,6 +110,31 @@ def association_pipeline(conf, trans_csv: str, work_dir: str) -> Pipeline:
     return pipe
 
 
+def profile_pipeline(conf, train_csv: str, work_dir: str,
+                     schema_path: Optional[str] = None) -> Pipeline:
+    """The corpus-profiling flow: NB distributions + mutual information
+    + Fisher discriminant over ONE labeled corpus — the three jobs every
+    modeling run-book starts with, each of which used to make its own
+    full pass over the same multi-GB CSV. All three are shared-scan
+    folds, so ``run(fuse=True)`` executes them as ONE SharedScan pass
+    (one disk read + one parse per chunk, three fold sinks); plain
+    ``run()`` keeps the one-job-one-scan path, byte-identical outputs
+    either way."""
+    os.makedirs(work_dir, exist_ok=True)
+    overrides: Dict[str, str] = {}
+    if schema_path:
+        for p in ("bad", "mut", "fid"):
+            overrides[f"{p}.feature.schema.file.path"] = schema_path
+    return Pipeline(_props(conf), [
+        Stage("bayesianDistr", "bayesianDistr", [train_csv],
+              os.path.join(work_dir, "distr.csv"), dict(overrides)),
+        Stage("mutualInformation", "mutualInformation", [train_csv],
+              os.path.join(work_dir, "mi.txt"), dict(overrides)),
+        Stage("fisherDiscriminant", "fisherDiscriminant", [train_csv],
+              os.path.join(work_dir, "fisher.txt"), dict(overrides)),
+    ])
+
+
 def bandit_round(conf, stats_csv: str, out_path: str, round_num: int,
                  job: str = "greedyRandomBandit") -> JobResult:
     """One decision round of the price-optimization loop

@@ -83,14 +83,14 @@ def job_prefix(name: str) -> str:
     return _REGISTRY[name][1]
 
 
-def run_job(name: str, conf, inputs: Sequence[str], output: str = "") -> JobResult:
-    """Run a registered job. `conf` is a properties file path, a dict, or a
-    JobConfig; the job sees it scoped under its reference prefix."""
+def _job_cfg(name: str, conf) -> Tuple[str, str, JobConfig]:
+    """(canonical name, prefix, scoped JobConfig) for a registered job.
+    `conf` is a properties file path, a dict, or a JobConfig."""
     if name not in _REGISTRY:
         raise KeyError(
             f"unknown job {name!r}; known: {', '.join(job_names())}"
         )
-    canonical, prefix, fn = _REGISTRY[name]
+    canonical, prefix, _fn = _REGISTRY[name]
     if isinstance(conf, str):
         if conf.endswith(".conf"):
             # Spark-surface HOCON config: one block per job name
@@ -103,6 +103,14 @@ def run_job(name: str, conf, inputs: Sequence[str], output: str = "") -> JobResu
     else:
         cfg = conf.scoped(prefix)
     cfg.props["__job_name__"] = canonical
+    return canonical, prefix, cfg
+
+
+def run_job(name: str, conf, inputs: Sequence[str], output: str = "") -> JobResult:
+    """Run a registered job. `conf` is a properties file path, a dict, or a
+    JobConfig; the job sees it scoped under its reference prefix."""
+    canonical, _prefix, cfg = _job_cfg(name, conf)
+    fn = _REGISTRY[canonical][2]
     if output:
         parent = os.path.dirname(os.path.abspath(output))
         os.makedirs(parent, exist_ok=True)
@@ -165,6 +173,366 @@ def _validate(class_values: Sequence[str], actual: np.ndarray,
     return cm.counters()
 
 
+# ============================================================ scan sharing
+# One disk read + one parse per chunk, fanned out to N registered fold
+# sinks (core.stream.SharedScan). Every fold below is ALSO the body of its
+# single-job streaming path, so the fused and one-job-one-scan executions
+# share one implementation — which is what makes their outputs
+# byte-identical (asserted by the chunk-invariance auditor's fused
+# entries and tests/test_shared_scan.py).
+
+class _NBDistrFold:
+    """bayesianDistr (tabular) as a shared-scan sink: the donated-carry
+    deferred NB fold (models/naive_bayes.py:_fold_batch_kernel) per
+    Dataset chunk."""
+
+    def __init__(self, cfg: JobConfig, inputs: Sequence[str], schema):
+        self.cfg = cfg
+        self.schema = schema
+        self.model = None
+        self.rows = 0
+
+    def consume(self, ds: Dataset) -> None:
+        from avenir_tpu.models.naive_bayes import NaiveBayesModel
+
+        if self.model is None:
+            # after the first parse, so data-discovered categorical
+            # vocabularies are sized into the count tensors
+            self.model = NaiveBayesModel.empty(self.schema)
+        codes, bins = ds.feature_codes(self.model.binned_fields)
+        if bins != self.model.bins:
+            raise ValueError(
+                "categorical vocabulary grew mid-stream (a chunk saw a "
+                "value absent from the first chunk / declared "
+                "cardinality); declare full cardinalities in the schema "
+                "to stream")
+        x_cont = ds.feature_matrix(self.model.cont_fields)
+        self.model.accumulate(codes, ds.labels(), x_cont, defer=True)
+        self.rows += len(ds)
+
+    def finish(self, output: str) -> JobResult:
+        from avenir_tpu.models.naive_bayes import NaiveBayesModel
+
+        out = _out_file(output)
+        model = self.model
+        if model is None:
+            model = NaiveBayesModel.empty(self.schema)
+        model.flush()
+        model.save(out, delim=self.cfg.field_delim)
+        return JobResult("bayesianDistr",
+                         {"Distribution Data:Records": self.rows},
+                         [out], model)
+
+
+class _MutualInfoFold:
+    """mutualInformation as a shared-scan sink: additive contingency
+    tables folded per Dataset chunk (MutualInformationAnalyzer.add)."""
+
+    def __init__(self, cfg: JobConfig, inputs: Sequence[str], schema):
+        from avenir_tpu.models.explore import MutualInformationAnalyzer
+
+        self.cfg = cfg
+        self.inputs = list(inputs)
+        self.mi = MutualInformationAnalyzer()
+
+    def consume(self, ds: Dataset) -> None:
+        self.mi.add(ds)
+
+    def finish(self, output: str) -> JobResult:
+        cfg, mi = self.cfg, self.mi
+        if mi.fields is None:
+            raise ValueError(f"mutualInformation: empty input "
+                             f"(no records in {self.inputs})")
+        mi.finalize()
+        algos = cfg.get_list("mutual.info.score.algorithms", [])
+        out = _out_file(output)
+        delim = cfg.field_delim
+        with open(out, "w") as fh:
+            if cfg.get_bool("output.mutual.info", True):
+                for f, fld in enumerate(mi.fields):
+                    fh.write(f"featureClassMI{delim}{fld.ordinal}{delim}"
+                             f"{mi.feature_class_mi[f]:.6f}\n")
+            for algo in algos:
+                scores = mi.score(algo,
+                                  cfg.get_float("redundancy.factor", 1.0))
+                for ordinal, s in scores:
+                    fh.write(f"{algo}{delim}{ordinal}{delim}{s:.6f}\n")
+        return JobResult("mutualInformation",
+                         {"Basic:Records": mi.n}, [out], mi)
+
+
+class _FisherFold:
+    """fisherDiscriminant as a shared-scan sink: per-class moment fold
+    per Dataset chunk (FisherDiscriminant.accumulate)."""
+
+    def __init__(self, cfg: JobConfig, inputs: Sequence[str], schema):
+        from avenir_tpu.models.discriminant import FisherDiscriminant
+
+        self.cfg = cfg
+        self.inputs = list(inputs)
+        self.fd = FisherDiscriminant()
+        self.rows = 0
+
+    def consume(self, ds: Dataset) -> None:
+        self.fd.accumulate(ds)
+        self.rows += len(ds)
+
+    def finish(self, output: str) -> JobResult:
+        if self.rows == 0:
+            raise ValueError(f"fisherDiscriminant: empty input "
+                             f"(no records in {self.inputs})")
+        self.fd.finalize()
+        out = _out_file(output)
+        self.fd.save(out, delim=self.cfg.field_delim)
+        return JobResult("fisherDiscriminant", {}, [out], self.fd)
+
+
+class _MarkovPerClassFold:
+    """markovStateTransitionModel (per-class mode) as a shared-scan sink
+    over RAW BYTE BLOCKS: native CSR encode + fit_csr per block when the
+    C encoder is built, line decode + fit otherwise. The per-entity mode
+    (mst.id.field.ordinals) keeps its own scan — its open-vocabulary key
+    extraction is not a fan-out fold."""
+
+    def __init__(self, cfg: JobConfig, inputs: Sequence[str], schema=None):
+        from avenir_tpu.models.markov import MarkovStateTransitionModel
+        from avenir_tpu.native.ingest import native_seq_ready
+
+        if cfg.get_int_list("id.field.ordinals") is not None:
+            raise ValueError(
+                "markovStateTransitionModel per-entity mode "
+                "(id.field.ordinals) is not shared-scan fusable")
+        self.cfg = cfg
+        self.inputs = list(inputs)
+        states = cfg.get_list("model.states") or cfg.assert_list("state.list")
+        scale = cfg.get_int("trans.prob.scale", 1000)
+        self.class_ord = cfg.get_int("class.label.field.ord")
+        self.skip = cfg.get_int("skip.field.count", 1)
+        self.class_labels = cfg.get_list("class.labels")
+        self.model = MarkovStateTransitionModel(
+            states, scale=scale, class_labels=self.class_labels)
+        self.delim = cfg.field_delim_regex
+        # one shared vocabulary: states first (codes 0..S-1), then any
+        # class labels that are not themselves state names
+        vocab = list(states)
+        for lab in self.class_labels or []:
+            if lab not in vocab:
+                vocab.append(lab)
+        self.vocab = vocab
+        self.label_codes = np.asarray([vocab.index(lab)
+                                       for lab in self.class_labels or []])
+        self.native = native_seq_ready(self.delim)
+        self.rows = 0
+
+    def consume(self, data: bytes) -> None:
+        if self.native:
+            from avenir_tpu.native.ingest import seq_encode_native
+
+            # cannot be None: availability + 1-byte delim pre-checked
+            enc = seq_encode_native(data, self.delim, self.vocab)
+            self.model.fit_csr(
+                *enc, skip=self.skip,
+                class_ord=self.class_ord if self.class_labels else None,
+                label_codes=self.label_codes)
+            self.rows += enc[1].shape[0] - 1
+        else:
+            lines = [ln.rstrip("\r")
+                     for ln in data.decode("utf-8", "replace").split("\n")
+                     if ln.strip()]
+            _, seqs, labels = _parse_sequences(lines, self.delim, self.skip,
+                                               self.class_ord)
+            self.model.fit(seqs, labels if self.class_labels else None)
+            self.rows += len(seqs)
+
+    def finish(self, output: str) -> JobResult:
+        out = _out_file(output)
+        self.model.save(out, delim=self.cfg.field_delim)
+        return JobResult("markovStateTransitionModel",
+                         {"Basic:Records": self.rows}, [out], self.model)
+
+
+def _write_apriori_outputs(cfg: JobConfig, output: str, levels) -> List[str]:
+    outs = []
+    os.makedirs(output or ".", exist_ok=True)
+    for k, isl in enumerate(levels, start=1):
+        p = os.path.join(output, f"itemsets-{k}.txt")
+        isl.save(p, delim=cfg.field_delim)
+        outs.append(p)
+    return outs
+
+
+def _write_gsp_outputs(cfg: JobConfig, output: str, levels) -> List[str]:
+    os.makedirs(output or ".", exist_ok=True)
+    outs = []
+    delim = cfg.field_delim
+    for k, seqs in sorted(levels.items()):
+        p = os.path.join(output, f"sequences-{k}.txt")
+        with open(p, "w") as fh:
+            for cand, support in sorted(seqs.items()):
+                fh.write(delim.join([*cand, f"{support:.6f}"]) + "\n")
+        outs.append(p)
+    return outs
+
+
+class _MinerScanFold:
+    """A multi-pass miner's DISCOVERY pass as a shared-scan sink over raw
+    byte blocks: pass 1 (vocabulary + k=1 supports) folds from the shared
+    read — and spills the encoded-block cache — then finish() runs the
+    remaining per-k rounds, which replay the cache instead of re-reading
+    the corpus. Fusing markov + a miner's k=1 scan makes the whole
+    multi-job, multi-pass flow cost ONE CSV read of the corpus."""
+
+    def __init__(self, cfg: JobConfig, inputs: Sequence[str], job: str):
+        self.cfg = cfg
+        self.job = job
+        self.t0 = time.perf_counter()
+        block = int(cfg.get_float("stream.block.size.mb", 64.0) * (1 << 20))
+        spill = cfg.get_bool("stream.encoded.cache", True)
+        skip = cfg.get_int("skip.field.count", 1)
+        if job == "frequentItemsApriori":
+            from avenir_tpu.models.association import (
+                FrequentItemsApriori, StreamingTransactionSource)
+
+            self.miner = FrequentItemsApriori(
+                support_threshold=cfg.assert_float("support.threshold"),
+                max_length=cfg.get_int("item.set.length", 3),
+                emit_trans_id=cfg.get_bool("emit.trans.id", False))
+            self.src = StreamingTransactionSource(
+                list(inputs), delim=cfg.field_delim_regex,
+                trans_id_ord=cfg.get_int("tans.id.ord", 0),
+                skip_field_count=skip, marker=cfg.get("infreq.item.marker"),
+                block_bytes=block, spill_cache=spill)
+        else:
+            from avenir_tpu.models.sequence import (GSPMiner,
+                                                    StreamingSequenceSource)
+
+            self.miner = GSPMiner(
+                support_threshold=cfg.assert_float("support.threshold"),
+                max_length=cfg.get_int("item.set.length", 3))
+            self.src = StreamingSequenceSource(
+                list(inputs), delim=cfg.field_delim_regex,
+                skip_field_count=skip, block_bytes=block,
+                spill_cache=spill)
+        self._sink = self.src.scan_consumer()
+
+    def consume(self, data: bytes) -> None:
+        self._sink.consume(data)
+
+    def finish(self, output: str) -> JobResult:
+        self._sink.finish()
+        levels = self.miner.mine_stream(self.src)
+        if self.job == "frequentItemsApriori":
+            n_rows = self.src.n_trans
+            counters = {"Apriori:MaxLength": len(levels),
+                        **throughput_counters(
+                            n_rows, time.perf_counter() - self.t0)}
+            outs = _write_apriori_outputs(self.cfg, output, levels)
+        else:
+            n_rows = self.src.n_rows
+            counters = {"GSP:MaxLength": max(levels) if levels else 0,
+                        **throughput_counters(
+                            n_rows, time.perf_counter() - self.t0)}
+            outs = _write_gsp_outputs(self.cfg, output, levels)
+        self.src.close()
+        return JobResult(self.job, counters, outs, levels)
+
+
+def _apriori_fold(cfg, inputs, schema=None):
+    return _MinerScanFold(cfg, inputs, "frequentItemsApriori")
+
+
+def _gsp_fold(cfg, inputs, schema=None):
+    return _MinerScanFold(cfg, inputs, "candidateGenerationWithSelfJoin")
+
+
+#: canonical job name -> (scan kind, fold factory(cfg, inputs, schema)).
+#: "dataset" folds consume schema-parsed Dataset chunks; "bytes" folds
+#: consume raw byte blocks (sequence-shaped corpora).
+_STREAM_FOLDS: Dict[str, Tuple[str, Callable]] = {
+    "bayesianDistr": ("dataset", _NBDistrFold),
+    "mutualInformation": ("dataset", _MutualInfoFold),
+    "fisherDiscriminant": ("dataset", _FisherFold),
+    "markovStateTransitionModel": ("bytes", _MarkovPerClassFold),
+    "frequentItemsApriori": ("bytes", _apriori_fold),
+    "candidateGenerationWithSelfJoin": ("bytes", _gsp_fold),
+}
+
+
+def stream_fold_names() -> List[str]:
+    """Jobs the scan-sharing executor can fuse."""
+    return sorted(_STREAM_FOLDS)
+
+
+def run_shared(specs: Sequence[Tuple[str, object, str]],
+               inputs: Sequence[str]) -> Dict[str, JobResult]:
+    """Run N registered jobs over the SAME inputs with ONE scan.
+
+    `specs` is a sequence of (job name, conf, output path); every job
+    must be shared-scan capable (stream_fold_names()) and they must
+    agree on scan kind, stream block size and (for Dataset folds) the
+    schema file + delimiter — one read, one parse, N folds. Each job
+    still reads its own prefixed config and writes its own outputs;
+    results come back keyed by canonical job name, byte-identical to
+    running the jobs one scan each (the existing run_job path stays as
+    the fallback and as the equivalence oracle)."""
+    from avenir_tpu.core.schema import FeatureSchema as _FS
+    from avenir_tpu.core.stream import (SharedScan, stream_job_byte_blocks,
+                                        stream_job_inputs)
+
+    if not specs:
+        return {}
+    built = []
+    for name, conf, output in specs:
+        canonical, _prefix, cfg = _job_cfg(name, conf)
+        if canonical not in _STREAM_FOLDS:
+            raise ValueError(
+                f"job {name!r} is not shared-scan capable; fusable jobs: "
+                f"{', '.join(stream_fold_names())}")
+        kind, factory = _STREAM_FOLDS[canonical]
+        if any(canonical == b[0] for b in built):
+            raise ValueError(
+                f"job {canonical!r} appears twice in one shared scan")
+        built.append((canonical, kind, cfg, factory, output))
+    kinds = {k for _, k, _, _, _ in built}
+    if len(kinds) != 1:
+        raise ValueError(f"cannot fuse jobs of mixed scan kinds {kinds}")
+    kind = kinds.pop()
+    blocks = {cfg.get_float("stream.block.size.mb", 64.0)
+              for _, _, cfg, _, _ in built}
+    if len(blocks) != 1:
+        raise ValueError(
+            f"fused jobs disagree on stream.block.size.mb: {blocks}")
+    delims = {cfg.field_delim_regex for _, _, cfg, _, _ in built}
+    if len(delims) != 1:
+        raise ValueError(f"fused jobs disagree on field delimiter: {delims}")
+    cfg0 = built[0][2]
+    schema = None
+    if kind == "dataset":
+        spaths = {cfg.assert_get("feature.schema.file.path")
+                  for _, _, cfg, _, _ in built}
+        if len(spaths) != 1:
+            raise ValueError(
+                f"fused jobs disagree on the schema file: {spaths}")
+        schema = _FS.from_file(spaths.pop())
+        chunks = stream_job_inputs(cfg0, list(inputs), schema)
+    else:
+        chunks = stream_job_byte_blocks(cfg0, list(inputs))
+    scan = SharedScan(chunks)
+    folds = []
+    for canonical, _kind, cfg, factory, output in built:
+        fold = factory(cfg, list(inputs), schema)
+        folds.append((canonical, fold, output))
+        scan.add_sink(fold)
+    scan.run()
+    results: Dict[str, JobResult] = {}
+    for canonical, fold, output in folds:
+        if output:
+            parent = os.path.dirname(os.path.abspath(output))
+            os.makedirs(parent, exist_ok=True)
+        results[canonical] = fold.finish(output)
+    return results
+
+
 # =================================================================== bayesian
 @job("bayesianDistr", "bad", "org.avenir.bayesian.BayesianDistribution")
 def bayesian_distribution(cfg: JobConfig, inputs: List[str], output: str) -> JobResult:
@@ -206,36 +574,17 @@ def bayesian_distribution(cfg: JobConfig, inputs: List[str], output: str) -> Job
                          [out], tmodel)
 
     from avenir_tpu.core.stream import stream_job_inputs
-    from avenir_tpu.models.naive_bayes import NaiveBayesModel
 
-    schema = _schema(cfg)
-    model = None
     # block streaming keeps host RSS O(block) however large the input —
     # the mapper's one-line-at-a-time contract at block granularity
     # (BayesianDistribution.java:137); counts are additive so chunking
-    # cannot change the model
-    rows = 0
+    # cannot change the model. The fold sink IS the shared-scan sink
+    # (_NBDistrFold): one-job-one-scan is the single-sink special case.
+    schema = _schema(cfg)
+    fold = _NBDistrFold(cfg, inputs, schema)
     for ds in stream_job_inputs(cfg, inputs, schema):
-        if model is None:
-            # after the first parse, so data-discovered categorical
-            # vocabularies are sized into the count tensors
-            model = NaiveBayesModel.empty(schema)
-        codes, bins = ds.feature_codes(model.binned_fields)
-        if bins != model.bins:
-            raise ValueError(
-                "categorical vocabulary grew mid-stream (a chunk saw a "
-                "value absent from the first chunk / declared "
-                "cardinality); declare full cardinalities in the schema "
-                "to stream")
-        x_cont = ds.feature_matrix(model.cont_fields)
-        model.accumulate(codes, ds.labels(), x_cont, defer=True)
-        rows += len(ds)
-    if model is None:
-        model = NaiveBayesModel.empty(schema)
-    model.flush()
-    model.save(out, delim=cfg.field_delim)
-    return JobResult("bayesianDistr", {"Distribution Data:Records": rows},
-                     [out], model)
+        fold.consume(ds)
+    return fold.finish(output)
 
 
 @job("bayesianPredictor", "bap", "org.avenir.bayesian.BayesianPredictor")
@@ -756,32 +1105,15 @@ def state_transition_rate_job(cfg: JobConfig, inputs: List[str],
 @job("mutualInformation", "mut", "org.avenir.explore.MutualInformation")
 def mutual_information_job(cfg: JobConfig, inputs: List[str], output: str) -> JobResult:
     from avenir_tpu.core.stream import stream_job_inputs
-    from avenir_tpu.models.explore import MutualInformationAnalyzer
 
     # block streaming: MI's count tables fold additively per chunk, so
     # host RSS stays O(block) at any input size (the mapper contract of
-    # MutualInformation.java:138-216)
-    try:
-        mi = MutualInformationAnalyzer.from_chunks(
-            stream_job_inputs(cfg, inputs, _schema(cfg)))
-    except ValueError as e:
-        raise ValueError(f"mutualInformation: empty input "
-                         f"(no records in {inputs})") from e
-    algos = cfg.get_list("mutual.info.score.algorithms", [])
-    out = _out_file(output)
-    delim = cfg.field_delim
-    with open(out, "w") as fh:
-        if cfg.get_bool("output.mutual.info", True):
-            for f, fld in enumerate(mi.fields):
-                fh.write(f"featureClassMI{delim}{fld.ordinal}{delim}"
-                         f"{mi.feature_class_mi[f]:.6f}\n")
-        for algo in algos:
-            scores = mi.score(algo,
-                              cfg.get_float("redundancy.factor", 1.0))
-            for ordinal, s in scores:
-                fh.write(f"{algo}{delim}{ordinal}{delim}{s:.6f}\n")
-    return JobResult("mutualInformation",
-                     {"Basic:Records": mi.n}, [out], mi)
+    # MutualInformation.java:138-216); the fold sink doubles as the
+    # shared-scan sink (_MutualInfoFold)
+    fold = _MutualInfoFold(cfg, inputs, None)
+    for ds in stream_job_inputs(cfg, inputs, _schema(cfg)):
+        fold.consume(ds)
+    return fold.finish(output)
 
 
 @job("ruleEvaluator", "rue", "org.avenir.explore.RuleEvaluator")
@@ -1128,24 +1460,19 @@ def gsp_job(cfg: JobConfig, inputs: List[str], output: str) -> JobResult:
             rows, skip_field_count=skip))
         n_rows = len(rows)
     else:
-        # beyond-RAM (or explicitly chunked): one streamed scan per k
+        # beyond-RAM (or explicitly chunked): one streamed scan per k,
+        # per-k re-scans replaying the pass-1 encoded-block cache
         src = StreamingSequenceSource(
             inputs, delim=cfg.field_delim_regex, skip_field_count=skip,
             block_bytes=int(cfg.get_float("stream.block.size.mb", 64.0)
-                            * (1 << 20)))
+                            * (1 << 20)),
+            spill_cache=cfg.get_bool("stream.encoded.cache", True))
         levels = miner.mine_stream(src)
         n_rows = src.n_rows
+        src.close()
     counters = {"GSP:MaxLength": max(levels) if levels else 0,
                 **throughput_counters(n_rows, time.perf_counter() - t0)}
-    os.makedirs(output or ".", exist_ok=True)
-    outs = []
-    delim = cfg.field_delim
-    for k, seqs in sorted(levels.items()):
-        p = os.path.join(output, f"sequences-{k}.txt")
-        with open(p, "w") as fh:
-            for cand, support in sorted(seqs.items()):
-                fh.write(delim.join([*cand, f"{support:.6f}"]) + "\n")
-        outs.append(p)
+    outs = _write_gsp_outputs(cfg, output, levels)
     return JobResult("candidateGenerationWithSelfJoin", counters,
                      outs, levels)
 
@@ -1291,23 +1618,21 @@ def apriori_job(cfg: JobConfig, inputs: List[str], output: str) -> JobResult:
     else:
         # beyond-RAM (or explicitly chunked): one streamed scan per
         # itemset length — the reference's per-k MR jobs over the same
-        # HDFS input, bit-packed over the frequent vocabulary after k=1;
-        # host RSS stays O(block) at any size
+        # HDFS input, bit-packed over the frequent vocabulary after k=1,
+        # and per-k re-scans replay the pass-1 encoded-block cache
+        # instead of re-parsing CSV; host RSS stays O(block) at any size
         src = StreamingTransactionSource(
             inputs, delim=cfg.field_delim_regex,
             trans_id_ord=trans_id_ord, skip_field_count=skip, marker=marker,
             block_bytes=int(cfg.get_float("stream.block.size.mb", 64.0)
-                            * (1 << 20)))
+                            * (1 << 20)),
+            spill_cache=cfg.get_bool("stream.encoded.cache", True))
         levels = miner.mine_stream(src)
         n_rows = src.n_trans
+        src.close()
     counters = {"Apriori:MaxLength": len(levels),
                 **throughput_counters(n_rows, time.perf_counter() - t0)}
-    outs = []
-    os.makedirs(output or ".", exist_ok=True)
-    for k, isl in enumerate(levels, start=1):
-        p = os.path.join(output, f"itemsets-{k}.txt")
-        isl.save(p, delim=cfg.field_delim)
-        outs.append(p)
+    outs = _write_apriori_outputs(cfg, output, levels)
     return JobResult("frequentItemsApriori", counters, outs, levels)
 
 
@@ -1479,47 +1804,15 @@ def markov_model_job(cfg: JobConfig, inputs: List[str], output: str) -> JobResul
         return JobResult("markovStateTransitionModel",
                          {"Entities:Count": len(entities)}, [out], model)
 
-    class_ord = cfg.get_int("class.label.field.ord")
-    skip = cfg.get_int("skip.field.count", 1)
-    class_labels = cfg.get_list("class.labels")
-    model = MarkovStateTransitionModel(
-        states, scale=scale,
-        class_labels=class_labels,
-    )
-    delim = cfg.field_delim_regex
-    # one shared vocabulary: states first (codes 0..S-1), then any class
-    # labels that are not themselves state names; label_codes maps class
-    # index -> vocab code either way
-    vocab = list(states)
-    for lab in class_labels or []:
-        if lab not in vocab:
-            vocab.append(lab)
-    label_codes = np.asarray([vocab.index(lab)
-                              for lab in class_labels or []])
-    rows = 0
-    from avenir_tpu.native.ingest import native_seq_ready, seq_encode_native
+    # per-class mode: the fold sink doubles as the shared-scan sink
+    # (_MarkovPerClassFold) — native CSR encode per raw byte block when
+    # the C encoder is built, line decode + fit otherwise
+    from avenir_tpu.core.stream import stream_job_byte_blocks
 
-    if native_seq_ready(delim):
-        # native ragged tokenize+encode straight from raw byte blocks
-        # (CSR codes; no per-line Python strings exist at any point)
-        from avenir_tpu.core.stream import stream_job_byte_blocks
-
-        for data in stream_job_byte_blocks(cfg, inputs):
-            # cannot be None: availability + 1-byte delim pre-checked
-            enc = seq_encode_native(data, delim, vocab)
-            model.fit_csr(*enc, skip=skip,
-                          class_ord=class_ord if class_labels else None,
-                          label_codes=label_codes)
-            rows += enc[1].shape[0] - 1
-    else:
-        for lines in stream_job_lines(cfg, inputs):
-            _, seqs, labels = _parse_sequences(lines, delim, skip,
-                                               class_ord)
-            model.fit(seqs, labels if class_labels else None)
-            rows += len(seqs)
-    model.save(out, delim=cfg.field_delim)
-    return JobResult("markovStateTransitionModel",
-                     {"Basic:Records": rows}, [out], model)
+    fold = _MarkovPerClassFold(cfg, inputs)
+    for data in stream_job_byte_blocks(cfg, inputs):
+        fold.consume(data)
+    return fold.finish(output)
 
 
 @job("markovModelClassifier", "mmc",
@@ -1688,20 +1981,12 @@ def logistic_regression_job(cfg: JobConfig, inputs: List[str], output: str) -> J
      "org.avenir.discriminant.FisherDiscriminant")
 def fisher_job(cfg: JobConfig, inputs: List[str], output: str) -> JobResult:
     from avenir_tpu.core.stream import stream_job_inputs
-    from avenir_tpu.models.discriminant import FisherDiscriminant
 
-    fd = FisherDiscriminant()
-    n = 0
+    # the fold sink doubles as the shared-scan sink (_FisherFold)
+    fold = _FisherFold(cfg, inputs, None)
     for chunk in stream_job_inputs(cfg, inputs, _schema(cfg)):
-        fd.accumulate(chunk)
-        n += len(chunk)
-    if n == 0:
-        raise ValueError(f"fisherDiscriminant: empty input "
-                         f"(no records in {inputs})")
-    fd.finalize()
-    out = _out_file(output)
-    fd.save(out, delim=cfg.field_delim)
-    return JobResult("fisherDiscriminant", {}, [out], fd)
+        fold.consume(chunk)
+    return fold.finish(output)
 
 
 # ======================================================================= text
@@ -1812,23 +2097,72 @@ class Pipeline:
         self.on_retry = on_retry
         self.attempts: Dict[str, int] = {}
 
-    def run(self, only: Optional[str] = None) -> Dict[str, JobResult]:
-        for st in self.stages:
-            if only is not None and st.name != only:
-                continue
-            props = dict(self.props)
-            props.update(st.conf_overrides)
-            for attempt in range(1, self.max_attempts + 1):
-                self.attempts[st.name] = attempt
+    def _stage_props(self, st: Stage) -> Dict[str, str]:
+        props = dict(self.props)
+        props.update(st.conf_overrides)
+        return props
+
+    def _run_stage(self, st: Stage) -> None:
+        for attempt in range(1, self.max_attempts + 1):
+            self.attempts[st.name] = attempt
+            try:
+                self.results[st.name] = run_job(
+                    st.job, self._stage_props(st), st.inputs, st.output)
+                break
+            except Exception as exc:
+                if attempt >= self.max_attempts:
+                    raise
+                if self.on_retry is not None:
+                    self.on_retry(st.name, attempt, exc)
+
+    def _fusable(self, st: Stage) -> bool:
+        key = _REGISTRY.get(st.job)
+        return key is not None and key[0] in _STREAM_FOLDS
+
+    def run(self, only: Optional[str] = None,
+            fuse: bool = False) -> Dict[str, JobResult]:
+        """Run the stages. With fuse=True, maximal runs of CONSECUTIVE
+        stages that read the same inputs and are shared-scan capable
+        (stream_fold_names()) execute as ONE SharedScan pass via
+        run_shared() — N jobs, one disk read + parse of the corpus. Any
+        fused-group failure falls back to the existing one-job-one-scan
+        per-stage path (with its usual retry semantics), so fusion is a
+        pure optimization, never a new failure mode."""
+        stages = [st for st in self.stages
+                  if only is None or st.name == only]
+        i = 0
+        while i < len(stages):
+            group = [stages[i]]
+            if fuse and self._fusable(stages[i]):
+                seen = {_REGISTRY[stages[i].job][0]}
+                j = i + 1
+                while (j < len(stages) and self._fusable(stages[j])
+                       and stages[j].inputs == stages[i].inputs
+                       and _REGISTRY[stages[j].job][0] not in seen):
+                    group.append(stages[j])
+                    seen.add(_REGISTRY[stages[j].job][0])
+                    j += 1
+            if len(group) >= 2:
+                specs = [(st.job, self._stage_props(st), st.output)
+                         for st in group]
                 try:
-                    self.results[st.name] = run_job(st.job, props, st.inputs,
-                                                    st.output)
-                    break
+                    shared = run_shared(specs, group[0].inputs)
+                    for st in group:
+                        # keyed lookup, not positional zip: immune to any
+                        # future reordering of run_shared's result dict
+                        self.results[st.name] = shared[_REGISTRY[st.job][0]]
+                        self.attempts[st.name] = 1
+                    i += len(group)
+                    continue
                 except Exception as exc:
-                    if attempt >= self.max_attempts:
-                        raise
+                    # fused attempt failed (mixed configs, a job error,
+                    # ...): the one-job-one-scan path is the fallback
                     if self.on_retry is not None:
-                        self.on_retry(st.name, attempt, exc)
+                        self.on_retry(
+                            "+".join(st.name for st in group), 1, exc)
+            for st in group:
+                self._run_stage(st)
+            i += len(group)
         return self.results
 
 

@@ -82,6 +82,35 @@ KNN_CSV_ROWS = max(100_000, int(os.environ.get(
 KNN_CSV_CACHE = f"/tmp/avenir_bench_knn_{KNN_CSV_ROWS}.csv"
 
 
+@contextlib.contextmanager
+def _host_core_lock():
+    """Exclusive cross-process lock for HOST-RATE reference measurements.
+
+    The r05 lesson (VERDICT weak #5): knn_stream_csv reported overlap
+    efficiency > 1.0 because its parse-only REFERENCE pass ran while the
+    CI suite shared this host's single core (depressing the denominator)
+    while the end-to-end pass ran uncontended. Chip sections already
+    serialize through _chip_lock; host-rate sections get the same
+    treatment with their own lock file — every pass whose rate feeds an
+    overlap-efficiency ratio (reference passes AND the end-to-end pass)
+    runs under this lock, so all of a section's rates see the same
+    contention environment and the ratio is a real <= 1.0 number, no
+    annotation needed. Separate file from _chip_lock so a host-rate
+    measurement never waits on a chip section in flight."""
+    import fcntl
+
+    # '.lock' suffix: rides the repo's '*.lock' gitignore rule, like the
+    # chip/bank lock files
+    lock = open(os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                             ".hostrate.lock"), "w")
+    fcntl.flock(lock, fcntl.LOCK_EX)
+    try:
+        yield
+    finally:
+        fcntl.flock(lock, fcntl.LOCK_UN)
+        lock.close()
+
+
 def _cached_replicated_csv(path: str, total_rows: int, make_blob) -> None:
     """Ensure `path` holds total_rows CSV rows: make_blob() returns a
     100K-row blob that is replicated to the target size, validated by a
@@ -268,40 +297,47 @@ def bench_nb_stream():
         path, STREAM_CSV_ROWS,
         lambda: generate_churn(100_000, seed=9, as_csv=True))
     csv_schema = churn_schema()
-    # parse-only rate (native csv_parse_mt block parse, no device work)
-    t0 = time.perf_counter()
-    parsed = sum(len(c) for c in iter_csv_chunks(path, csv_schema))
-    parse_rps = parsed / (time.perf_counter() - t0)
-    assert parsed == STREAM_CSV_ROWS
-    # fold-only rate on the SAME chunk shape the CSV path feeds (cached
-    # parsed blocks cycled; includes the per-chunk feature_codes host
-    # encode) — the honest denominator for overlap efficiency
-    model2 = NaiveBayesModel.empty(csv_schema)
-    cached = []
-    for ds in iter_csv_chunks(path, csv_schema):
-        cached.append(ds)
-        if len(cached) >= 4:
-            break
-    fold_rows = 0
-    t0 = time.perf_counter()
-    for i in range(20):
-        ds = cached[i % len(cached)]
-        codes, _ = ds.feature_codes(model2.binned_fields)
-        model2.accumulate(codes, ds.labels(),
-                          np.zeros((len(ds), 0), np.float32), defer=True)
-        fold_rows += len(ds)
-    model2.flush()
-    fold_rps = fold_rows / (time.perf_counter() - t0)
-    cached = None
-    model2 = NaiveBayesModel.empty(csv_schema)
-    t0 = time.perf_counter()
-    for ds in prefetched(iter_csv_chunks(path, csv_schema)):
-        codes, _ = ds.feature_codes(model2.binned_fields)
-        model2.accumulate(codes, ds.labels(),
-                          np.zeros((len(ds), 0), np.float32), defer=True)
-    model2.flush()
-    csv_rps = STREAM_CSV_ROWS / (time.perf_counter() - t0)
-    assert model2.class_counts.sum() == STREAM_CSV_ROWS
+    # reference + end-to-end rates serialize against concurrent host work
+    # (_host_core_lock): a contended parse-only pass under an uncontended
+    # end-to-end pass is how r05's overlap_eff read > 1.0
+    with _host_core_lock():
+        # parse-only rate (native csv_parse_mt block parse, no device work)
+        t0 = time.perf_counter()
+        parsed = sum(len(c) for c in iter_csv_chunks(path, csv_schema))
+        parse_rps = parsed / (time.perf_counter() - t0)
+        assert parsed == STREAM_CSV_ROWS
+        # fold-only rate on the SAME chunk shape the CSV path feeds
+        # (cached parsed blocks cycled; includes the per-chunk
+        # feature_codes host encode) — the honest denominator for
+        # overlap efficiency
+        model2 = NaiveBayesModel.empty(csv_schema)
+        cached = []
+        for ds in iter_csv_chunks(path, csv_schema):
+            cached.append(ds)
+            if len(cached) >= 4:
+                break
+        fold_rows = 0
+        t0 = time.perf_counter()
+        for i in range(20):
+            ds = cached[i % len(cached)]
+            codes, _ = ds.feature_codes(model2.binned_fields)
+            model2.accumulate(codes, ds.labels(),
+                              np.zeros((len(ds), 0), np.float32),
+                              defer=True)
+            fold_rows += len(ds)
+        model2.flush()
+        fold_rps = fold_rows / (time.perf_counter() - t0)
+        cached = None
+        model2 = NaiveBayesModel.empty(csv_schema)
+        t0 = time.perf_counter()
+        for ds in prefetched(iter_csv_chunks(path, csv_schema)):
+            codes, _ = ds.feature_codes(model2.binned_fields)
+            model2.accumulate(codes, ds.labels(),
+                              np.zeros((len(ds), 0), np.float32),
+                              defer=True)
+        model2.flush()
+        csv_rps = STREAM_CSV_ROWS / (time.perf_counter() - t0)
+        assert model2.class_counts.sum() == STREAM_CSV_ROWS
     # perfect parse/fold overlap would run at the slower stage's rate
     overlap_eff = csv_rps / min(parse_rps, fold_rps)
     peak_rss_mb = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / 1024.0
@@ -460,31 +496,37 @@ def bench_knn_stream_csv():
         _ = block_topk(
             jnp.asarray(np.zeros((tail + (-tail % 4096), d), np.float32)),
             tail)
-    # parse-only rate (the stage the end-to-end is bound by on 1 core)
-    t0 = time.perf_counter()
-    parsed = sum(len(c) for c in iter_csv_chunks(path, schema))
-    parse_rps = parsed / (time.perf_counter() - t0)
-    assert parsed == KNN_CSV_ROWS
-    # fold-only rate on the same step shape — the overlap denominator is
-    # the SLOWER stage, whichever that is (on a many-core host the
-    # striped parse can outrun the fold). Each call gets distinct data
-    # (device roll) and the result is forced to host via a scalar, per
-    # the module's axon timing methodology
-    rng_f = np.random.default_rng(33)
-    fold_block = jnp.asarray(rng_f.normal(
-        size=(step_rows, d)).astype(np.float32))
-    n_fold = max(4, min(16, KNN_CSV_ROWS // step_rows))
-    t0 = time.perf_counter()
-    acc = 0.0
-    for i in range(n_fold):
-        dist, _idx = block_topk(jnp.roll(fold_block, i, axis=1), step_rows)
-        acc += float(jnp.sum(dist))
-    fold_rps = n_fold * step_rows / (time.perf_counter() - t0)
-    assert np.isfinite(acc)
-    # end-to-end: parse + prefetch + device top-k fold
-    t0 = time.perf_counter()
-    rows, results = fold(prefetched(iter_csv_chunks(path, schema)))
-    dt = time.perf_counter() - t0
+    # every rate below runs under the host-core lock: the parse-only
+    # REFERENCE pass, the fold-only pass and the end-to-end pass must see
+    # the same contention environment or the overlap ratio lies (the r05
+    # >1.0 "measurement artifact" was exactly a contended reference pass)
+    with _host_core_lock():
+        # parse-only rate (the stage the end-to-end is bound by on 1 core)
+        t0 = time.perf_counter()
+        parsed = sum(len(c) for c in iter_csv_chunks(path, schema))
+        parse_rps = parsed / (time.perf_counter() - t0)
+        assert parsed == KNN_CSV_ROWS
+        # fold-only rate on the same step shape — the overlap denominator
+        # is the SLOWER stage, whichever that is (on a many-core host the
+        # striped parse can outrun the fold). Each call gets distinct data
+        # (device roll) and the result is forced to host via a scalar, per
+        # the module's axon timing methodology
+        rng_f = np.random.default_rng(33)
+        fold_block = jnp.asarray(rng_f.normal(
+            size=(step_rows, d)).astype(np.float32))
+        n_fold = max(4, min(16, KNN_CSV_ROWS // step_rows))
+        t0 = time.perf_counter()
+        acc = 0.0
+        for i in range(n_fold):
+            dist, _idx = block_topk(jnp.roll(fold_block, i, axis=1),
+                                    step_rows)
+            acc += float(jnp.sum(dist))
+        fold_rps = n_fold * step_rows / (time.perf_counter() - t0)
+        assert np.isfinite(acc)
+        # end-to-end: parse + prefetch + device top-k fold
+        t0 = time.perf_counter()
+        rows, results = fold(prefetched(iter_csv_chunks(path, schema)))
+        dt = time.perf_counter() - t0
     assert rows == KNN_CSV_ROWS
     # global merge across blocks (tiny: [nq, k*n_blocks])
     d_all = np.concatenate([r[0] for r in results], axis=1)

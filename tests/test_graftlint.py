@@ -18,6 +18,7 @@ import pytest
 
 from avenir_tpu.analysis import load_baseline, run_paths
 from avenir_tpu.analysis.rules import (ALL_RULES, DefaultInt64Rule,
+                                       FoldUndonatedCarryRule,
                                        HostSyncInFoldRule,
                                        Int64LiteralInJnpRule,
                                        RecompileHazardRule,
@@ -365,12 +366,75 @@ def test_int64_literal_silent_on_good(tmp_path):
     assert _lint(tmp_path, _BIGLIT_GOOD, Int64LiteralInJnpRule) == []
 
 
+_CARRY_BAD = """
+import jax
+import jax.numpy as jnp
+from functools import partial
+
+@jax.jit
+def fold(acc, x):
+    return acc + x.sum(axis=0)
+
+@partial(jax.jit, donate_argnums=())
+def fold_explicit_nodonate(acc, x):
+    return acc + x.sum(axis=0)
+
+class Miner:
+    def run(self, chunks):
+        self.acc = jnp.zeros((4,))
+        for x in chunks:
+            self.acc = fold(self.acc, x)        # undonated self-attr carry
+        return self.acc
+
+def count(chunks):
+    acc = jnp.zeros((4,))
+    for x in chunks:
+        acc = fold_explicit_nodonate(acc, x)    # empty donate tuple = none
+    return acc
+"""
+
+_CARRY_GOOD = """
+import jax
+import jax.numpy as jnp
+from functools import partial
+
+@partial(jax.jit, donate_argnums=(0,))
+def fold(acc, x):
+    return acc + x.sum(axis=0)
+
+@jax.jit
+def score(x):
+    return x.sum(axis=0)
+
+def count(chunks):
+    acc = jnp.zeros((4,))
+    for x in chunks:
+        acc = fold(acc, x)          # donated carry: the sanctioned shape
+        s = score(x)                # jitted call, but no carry argument
+        acc = acc + s
+    once = fold(acc, acc)           # carry shape, but not inside a loop
+    return once
+"""
+
+
+def test_fold_undonated_carry_fires_on_bad(tmp_path):
+    findings = _lint(tmp_path, _CARRY_BAD, FoldUndonatedCarryRule)
+    assert {f.rule for f in findings} == {"fold-undonated-carry"}
+    assert len(findings) == 2, [f.render() for f in findings]
+    assert {f.scope for f in findings} == {"Miner.run", "count"}
+
+
+def test_fold_undonated_carry_silent_on_good(tmp_path):
+    assert _lint(tmp_path, _CARRY_GOOD, FoldUndonatedCarryRule) == []
+
+
 def test_every_rule_has_corpus_coverage():
     """Each registered rule appears in this module's fixture corpus, so
     adding a rule without tests fails loudly."""
     covered = {"default-int64", "host-sync-in-fold", "recompile-hazard",
                "tracer-leak", "unseeded-stochastic-test",
-               "sharded-host-materialize", "int64-literal-in-jnp"}
+               "sharded-host-materialize", "int64-literal-in-jnp",
+               "fold-undonated-carry"}
     assert {r.rule_id for r in ALL_RULES} == covered
 
 

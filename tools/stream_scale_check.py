@@ -8,8 +8,16 @@ would need >2x the file size resident; the streamed jobs are asserted
 under 3GB regardless of input size.
 
 With --extra, also runs the multi-pass miners over the same 100M rows:
-  3. frequentItemsApriori (one streamed scan per itemset length);
-  4. candidateGenerationWithSelfJoin / GSP (one scan per sequence length).
+  3. frequentItemsApriori (one streamed scan per itemset length; per-k
+     re-scans replay the pass-1 encoded-block cache);
+  4. candidateGenerationWithSelfJoin / GSP (one scan per sequence length,
+     same cache replay).
+
+With --fused, additionally measures the scan-sharing executor: NB + MI +
+discriminant over the churn corpus run three-jobs-sequential (three full
+CSV scans) and then FUSED through runner.run_shared (ONE scan, three fold
+sinks), recording the speedup ratio and asserting the fused outputs are
+byte-identical to the sequential ones.
 
 Writes one JSON line per job and a summary to STREAM_SCALE_r05.json
 (merged into any existing records, so a partial re-run never erases
@@ -17,6 +25,7 @@ previously recorded jobs). Works on CPU (pins the platform; the point is
 ingest scale, not device speed — bench.py measures the TPU fold rates).
 
 Usage: python tools/stream_scale_check.py [--rows N_MILLION] [--extra]
+                                          [--fused]
 """
 
 import json
@@ -32,6 +41,11 @@ ROWS_M = int(sys.argv[sys.argv.index("--rows") + 1]) \
 CHURN_CSV = f"/tmp/avenir_scale_churn_{ROWS_M}m.csv"
 SEQ_CSV = f"/tmp/avenir_scale_seq_{ROWS_M}m.csv"
 RSS_LIMIT_MB = 3072
+# only the canonical 100M run updates the tracked record file; proxy
+# sizes (e.g. --rows 10, the CPU acceptance proxy) write a sibling so a
+# 10M run can never clobber the 100M rows the record is anchored to
+RECORD = ("STREAM_SCALE_r05.json" if ROWS_M == 100
+          else f"/tmp/avenir_stream_scale_{ROWS_M}m.json")
 
 _CHILD = r'''
 import json, os, resource, sys, time
@@ -49,6 +63,27 @@ rows = next((v for k, v in res.counters.items() if "Records" in k), None)
 print(json.dumps({"job": job, "seconds": round(dt, 1),
                   "rows": rows, "peak_rss_mb": round(rss, 1),
                   "counters": res.counters}))
+'''
+
+
+_CHILD_SHARED = r'''
+import json, os, resource, sys, time
+sys.path.insert(0, ".")
+import jax
+jax.config.update("jax_platforms", "cpu")
+from avenir_tpu.runner import run_shared
+
+specs_json, inp, outdir = sys.argv[1:4]
+specs = [(job, conf, os.path.join(outdir, job))
+         for job, conf in json.loads(specs_json)]
+t0 = time.perf_counter()
+res = run_shared(specs, [inp])
+dt = time.perf_counter() - t0
+rss = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / 1024.0
+print(json.dumps({"job": "sharedScan", "jobs": sorted(res),
+                  "seconds": round(dt, 1), "peak_rss_mb": round(rss, 1),
+                  "outputs": sorted(p for r in res.values()
+                                    for p in r.outputs)}))
 '''
 
 
@@ -137,14 +172,57 @@ def main():
              "cgs.skip.field.count": "2",
              "cgs.stream.block.size.mb": "64"},
             SEQ_CSV, "/tmp/avenir_scale_gsp")
+    if "--fused" in sys.argv:
+        # scan-sharing A/B: the three churn profilers sequentially (one
+        # full CSV scan EACH) vs fused through run_shared (ONE scan,
+        # three fold sinks); outputs must be byte-identical
+        jobs3 = [
+            ("bayesianDistr",
+             {"bad.feature.schema.file.path": schema_path}, "bad"),
+            ("mutualInformation",
+             {"mut.feature.schema.file.path": schema_path,
+              "mut.mutual.info.score.algorithms":
+                  "mutual.info.maximization"}, "mut"),
+            ("fisherDiscriminant",
+             {"fid.feature.schema.file.path": schema_path}, "fid"),
+        ]
+        seq_s, seq_outs = 0.0, []
+        for job, conf, _p in jobs3:
+            line = run_child(job, conf, CHURN_CSV,
+                             f"/tmp/avenir_scale_seq_{job}.txt")
+            seq_s += line["seconds"]
+            results[f"sequential_{job}"] = line
+            seq_outs.append(f"/tmp/avenir_scale_seq_{job}.txt")
+        outdir = "/tmp/avenir_scale_fused"
+        os.makedirs(outdir, exist_ok=True)
+        env = dict(os.environ, AVENIR_SKIP_DEVICE_PROBE="1")
+        proc = subprocess.run(
+            [sys.executable, "-c", _CHILD_SHARED,
+             json.dumps([(j, c) for j, c, _p in jobs3]), CHURN_CSV, outdir],
+            capture_output=True, text=True, timeout=7200, env=env)
+        if proc.returncode != 0:
+            raise RuntimeError(f"fused scan failed: {proc.stderr[-500:]}")
+        fused = json.loads(proc.stdout.strip().splitlines()[-1])
+        print(json.dumps(fused), flush=True)
+        assert fused["peak_rss_mb"] < RSS_LIMIT_MB
+        for job, _conf, _p in jobs3:
+            seq_out = f"/tmp/avenir_scale_seq_{job}.txt"
+            fused_out = os.path.join(outdir, job)
+            with open(seq_out, "rb") as fa, open(fused_out, "rb") as fb:
+                assert fa.read() == fb.read(), \
+                    f"fused output {fused_out} != sequential {seq_out}"
+        fused["sequential_seconds"] = round(seq_s, 1)
+        fused["speedup"] = round(seq_s / fused["seconds"], 2)
+        fused["outputs_byte_identical"] = True
+        results["sharedScan"] = fused
     merged = {}
-    if os.path.exists("STREAM_SCALE_r05.json"):
+    if os.path.exists(RECORD):
         try:
-            merged = json.load(open("STREAM_SCALE_r05.json"))
+            merged = json.load(open(RECORD))
         except ValueError:
             merged = {}
     merged.update(results)
-    with open("STREAM_SCALE_r05.json", "w") as fh:
+    with open(RECORD, "w") as fh:
         json.dump(merged, fh, indent=1)
     summary = {"stream_scale": "done",
                "mi_rows_per_sec": round(
@@ -159,6 +237,8 @@ def main():
                      ("gsp_rows_per_sec", "candidateGenerationWithSelfJoin")):
         if job in results:
             summary[key] = results[job]["counters"].get("Basic:RowsPerSec")
+    if "sharedScan" in results:
+        summary["shared_scan_speedup"] = results["sharedScan"]["speedup"]
     print(json.dumps(summary))
     return 0
 
