@@ -21,6 +21,12 @@ Entry points:
     rules over the host streaming surface + the chunk-invariance audit
     of the manifest's streamed fold kernels (jax pulled in only when
     the audit actually runs);
+  - ``avenir_tpu.analysis.mem.run_mem`` — the mem layer
+    (``graftlint --mem``): memory-footprint rules + the analytic
+    footprint model and its mechanical RSS auditor, which proves the
+    model against sampled peak RSS for every streamed job at >= 2
+    block sizes (``mem.memory_manifest()`` exports the machine-
+    readable admission oracle);
   - ``graftlint_baseline.txt`` — the allowlist: accepted findings keyed
     by ``path::rule::scope`` with a one-line justification each, shared
     by both modes.

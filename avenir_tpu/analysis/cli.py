@@ -1,7 +1,7 @@
 """graftlint CLI: `graftlint <paths>` (console script) or
 `python tools/graftlint.py <paths>`.
 
-Three modes sharing one report/baseline/exit contract:
+Four modes sharing one report/baseline/exit contract:
 
 - AST (default): lint source paths with the rules.py catalog.
 - IR (``--ir``, no paths): trace the kernel manifest
@@ -11,6 +11,10 @@ Three modes sharing one report/baseline/exit contract:
   surface): the host concurrency/determinism rules (analysis/flow.py)
   plus the chunk-invariance audit of the streamed fold kernels
   (manifest ``stream_entries()``).
+- Mem (``--mem``, paths optional — same default surface): the memory-
+  footprint rules (analysis/mem.py) plus the RSS/live-bytes footprint
+  audit that proves the analytic memory model against sampled peak RSS
+  for every streamed job at >= 2 block sizes.
 
 Exit-code contract (stable — bench_scaling.py and CI tripwire on it):
   0  clean: no findings, no stale baseline entries, no parse errors
@@ -18,11 +22,11 @@ Exit-code contract (stable — bench_scaling.py and CI tripwire on it):
      parse errors in the linted sources
   2  usage-or-trace-error — bad flags/baseline format/unreadable input,
      a manifest entry that failed to trace/lower (--ir), or a stream
-     kernel that failed to run (--flow)
+     kernel that failed to run (--flow / --mem)
 
 `--json` prints one machine-readable object in every mode (same schema:
 `payload_audit` is empty outside --ir, `invariance_audit` outside
---flow).
+--flow, `footprint_audit` outside --mem).
 """
 
 from __future__ import annotations
@@ -56,6 +60,11 @@ def build_parser() -> argparse.ArgumentParser:
                         "rules over the paths (default: the gated repo "
                         "surface) + the chunk-invariance audit of the "
                         "streamed fold kernels")
+    p.add_argument("--mem", action="store_true",
+                   help="memory-footprint analysis: the mem-* rules over "
+                        "the paths (default: the gated repo surface) + the "
+                        "RSS footprint audit proving the analytic memory "
+                        "model for every streamed job at >= 2 block sizes")
     p.add_argument("--baseline", default=None,
                    help="allowlist file (default: "
                         "avenir_tpu/analysis/graftlint_baseline.txt)")
@@ -66,7 +75,7 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--rules", default=None, metavar="ID[,ID...]",
                    help=f"comma-separated subset of: {', '.join(rule_ids())} "
                         f"(or the ir-* ids with --ir, the flow-* ids with "
-                        f"--flow)")
+                        f"--flow, the mem-* ids with --mem)")
     p.add_argument("--no-md", action="store_true",
                    help="skip ```python fences in .md files")
     p.add_argument("--allow-stale", action="store_true",
@@ -124,18 +133,18 @@ def _report_root(args) -> Optional[str]:
 def main(argv: Optional[List[str]] = None) -> int:
     parser = build_parser()
     args = parser.parse_args(argv)
-    if args.ir and args.flow:
-        print("graftlint: --ir and --flow are separate analysis tiers; "
-              "run them as two invocations", file=sys.stderr)
+    if sum(1 for m in (args.ir, args.flow, args.mem) if m) > 1:
+        print("graftlint: --ir, --flow and --mem are separate analysis "
+              "tiers; run them as separate invocations", file=sys.stderr)
         return 2
     if args.ir and args.paths:
         print("graftlint: --ir lints the kernel manifest; do not pass "
               "paths (run the two modes as two invocations)",
               file=sys.stderr)
         return 2
-    if not args.ir and not args.flow and not args.paths:
-        print("graftlint: pass paths to lint, or --ir / --flow for the "
-              "manifest audits", file=sys.stderr)
+    if not args.ir and not args.flow and not args.mem and not args.paths:
+        print("graftlint: pass paths to lint, or --ir / --flow / --mem "
+              "for the manifest audits", file=sys.stderr)
         return 2
 
     if args.ir:
@@ -151,6 +160,13 @@ def main(argv: Optional[List[str]] = None) -> int:
                                               FlowAuditError, flow_rule_ids,
                                               run_flow)
         known = flow_rule_ids()
+    elif args.mem:
+        # the footprint audit runs real jobs too: same platform pin
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        from avenir_tpu.analysis.mem import (ALL_MEM_RULES, MEM_AUDIT_RULE,
+                                             MemAuditError, mem_rule_ids,
+                                             run_mem)
+        known = mem_rule_ids()
     else:
         known = rule_ids()
 
@@ -197,6 +213,21 @@ def main(argv: Optional[List[str]] = None) -> int:
         except OSError as e:
             print(f"graftlint: cannot read input: {e}", file=sys.stderr)
             return 2
+    elif args.mem:
+        mem_rules = ([r() for r in ALL_MEM_RULES] if wanted is None
+                     else [r() for r in ALL_MEM_RULES
+                           if r.rule_id in wanted])
+        audit = wanted is None or MEM_AUDIT_RULE in wanted
+        try:
+            report = run_mem(paths=args.paths or None, rules=mem_rules,
+                             baseline=baseline, root=_report_root(args),
+                             include_md=not args.no_md, audit=audit)
+        except MemAuditError as e:
+            print(f"graftlint: footprint audit error: {e}", file=sys.stderr)
+            return 2
+        except OSError as e:
+            print(f"graftlint: cannot read input: {e}", file=sys.stderr)
+            return 2
     else:
         rules = (None if wanted is None
                  else [r() for r in ALL_RULES if r.rule_id in wanted])
@@ -228,6 +259,12 @@ def main(argv: Optional[List[str]] = None) -> int:
                      if a["invariance_validated"])
             tail += (f", chunk-invariance audit {ok}/"
                      f"{len(report.invariance_audit)} stream kernels "
+                     f"validated")
+        if report.footprint_audit:
+            ok = sum(1 for a in report.footprint_audit
+                     if a["footprint_model_validated"])
+            tail += (f", footprint audit {ok}/"
+                     f"{len(report.footprint_audit)} streamed jobs "
                      f"validated")
         print(f"graftlint: {len(report.scanned)} {unit}, "
               f"{len(report.findings)} finding(s), "

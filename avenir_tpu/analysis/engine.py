@@ -73,9 +73,11 @@ class Report:
     per distributed family with its HLO-vs-analytic collective payload
     verdict. `invariance_audit` is filled only by flow runs
     (analysis/flow.py): one entry per streamed fold kernel with its
-    chunk-layout/scheduler byte-identity verdict. Other modes leave them
-    empty — the keys are always present in the JSON so downstream
-    tripwires can parse one schema."""
+    chunk-layout/scheduler byte-identity verdict. `footprint_audit` is
+    filled only by mem runs (analysis/mem.py): one entry per streamed
+    job with its measured-RSS-vs-analytic-footprint verdict. Other
+    modes leave them empty — the keys are always present in the JSON so
+    downstream tripwires can parse one schema."""
 
     findings: List[Finding] = field(default_factory=list)
     suppressed: List[Finding] = field(default_factory=list)
@@ -84,6 +86,7 @@ class Report:
     errors: List[Finding] = field(default_factory=list)
     payload_audit: List[dict] = field(default_factory=list)
     invariance_audit: List[dict] = field(default_factory=list)
+    footprint_audit: List[dict] = field(default_factory=list)
 
     def counts(self) -> Dict[str, int]:
         out: Dict[str, int] = {}
@@ -105,6 +108,7 @@ class Report:
             "files_scanned": len(self.scanned),
             "payload_audit": self.payload_audit,
             "invariance_audit": self.invariance_audit,
+            "footprint_audit": self.footprint_audit,
             "clean": self.clean,
         }
 

@@ -307,7 +307,12 @@ class StreamKernelSpec:
     size and returns the output artifact's bytes. `layouts` holds >= 3
     block sizes chosen so the corpus chunks into visibly different
     layouts (single block / a dozen / dozens) — the auditor verifies the
-    chunk counts actually differ, then asserts the bytes don't."""
+    chunk counts actually differ, then asserts the bytes don't.
+
+    ``jobs`` names the registered runner job(s) the spec drives (several
+    for the fused shared-scan entries): the memory auditor
+    (analysis/mem.py) keys its per-job analytic footprint model on
+    them, so every stream entry is memory-auditable by construction."""
 
     name: str
     path: str                     # repo-relative module of the fold kernel
@@ -315,6 +320,7 @@ class StreamKernelSpec:
     prepare: Callable             # workdir -> ctx dict
     run: Callable                 # (ctx, block_mb) -> bytes
     layouts: Tuple[float, ...] = (64.0, 0.002, 0.0005)
+    jobs: Tuple[str, ...] = ()
 
 
 def _job_runner(job: str, prefix: str, conf: dict, inputs_key: str = "csv"):
@@ -425,24 +431,27 @@ def stream_entries() -> List[StreamKernelSpec]:
     from avenir_tpu.models.naive_bayes import NaiveBayesModel
     from avenir_tpu.models.sequence import GSPMiner
 
-    def spec(name, ref, prepare, run):
+    def spec(name, ref, prepare, run, jobs=()):
         path, line = _loc(ref)
-        return StreamKernelSpec(name, path, line, prepare, run)
+        return StreamKernelSpec(name, path, line, prepare, run,
+                                jobs=tuple(jobs))
 
     schema_conf = lambda prefix: {
         f"{prefix}.feature.schema.file.path": "{schema}"}
     return [
         spec("nb_stream", NaiveBayesModel.accumulate, _churn_corpus,
-             _job_runner("bayesianDistr", "bad", schema_conf("bad"))),
+             _job_runner("bayesianDistr", "bad", schema_conf("bad")),
+             jobs=("bayesianDistr",)),
         spec("mi_stream", MutualInformationAnalyzer.add, _churn_corpus,
              _job_runner("mutualInformation", "mut", {
                  **schema_conf("mut"),
                  "mut.mutual.info.score.algorithms":
                      "mutual.info.maximization,min.redundancy.max.relevance",
-             })),
+             }), jobs=("mutualInformation",)),
         spec("discriminant_stream", FisherDiscriminant.accumulate,
              _churn_corpus,
-             _job_runner("fisherDiscriminant", "fid", schema_conf("fid"))),
+             _job_runner("fisherDiscriminant", "fid", schema_conf("fid")),
+             jobs=("fisherDiscriminant",)),
         spec("markov_stream", MarkovStateTransitionModel.fit_csr,
              _seq_corpus,
              _job_runner("markovStateTransitionModel", "mst", {
@@ -450,20 +459,20 @@ def stream_entries() -> List[StreamKernelSpec]:
                  "mst.class.label.field.ord": "1",
                  "mst.skip.field.count": "2",
                  "mst.class.labels": "T,F",
-             })),
+             }), jobs=("markovStateTransitionModel",)),
         spec("apriori_stream", FrequentItemsApriori.mine_stream,
              _seq_corpus,
              _job_runner("frequentItemsApriori", "fia", {
                  "fia.support.threshold": "0.3",
                  "fia.item.set.length": "2",
                  "fia.skip.field.count": "2",
-             })),
+             }), jobs=("frequentItemsApriori",)),
         spec("gsp_stream", GSPMiner.mine_stream, _seq_corpus,
              _job_runner("candidateGenerationWithSelfJoin", "cgs", {
                  "cgs.support.threshold": "0.3",
                  "cgs.item.set.length": "2",
                  "cgs.skip.field.count": "2",
-             })),
+             }), jobs=("candidateGenerationWithSelfJoin",)),
         # fused shared-scan entries: the SAME jobs through the
         # scan-sharing executor (ONE read + parse, N fold sinks). The
         # auditor re-proves every round that fan-out changes nothing —
@@ -480,7 +489,9 @@ def stream_entries() -> List[StreamKernelSpec]:
                          "min.redundancy.max.relevance",
                  }),
                  ("fisherDiscriminant", "fid", schema_conf("fid")),
-             ])),
+             ]),
+             jobs=("bayesianDistr", "mutualInformation",
+                   "fisherDiscriminant")),
         spec("shared_seq_stream", SharedScan.run, _seq_corpus,
              _shared_runner([
                  ("markovStateTransitionModel", "mst", {
@@ -494,7 +505,8 @@ def stream_entries() -> List[StreamKernelSpec]:
                      "fia.item.set.length": "2",
                      "fia.skip.field.count": "2",
                  }),
-             ])),
+             ]),
+             jobs=("markovStateTransitionModel", "frequentItemsApriori")),
     ]
 
 

@@ -109,6 +109,25 @@ _DONE = object()
 #: Production leaves it None; the check is one load per block.
 _produce_hook = None
 
+#: Byte-accounting hook: when set, called with the byte size of every
+#: bytes-like item a prefetched() worker produces (0 for non-bytes
+#: items, which carry their own accounting). The memory auditor
+#: (analysis/mem.py) installs a recorder here to prove the footprint
+#: model's block-size term against the blocks that actually flowed —
+#: the stream layer's half of the RSS oracle. Production leaves it
+#: None; the check is one load per block.
+_bytes_hook = None
+
+
+def _item_nbytes(item) -> int:
+    """Accountable byte size of a produced item: RAW byte blocks only —
+    parsed/encoded items (Datasets, padded pages, packed bitsets) are
+    priced by the footprint model's own per-job terms, so counting them
+    here would double-book them against the raw-block term."""
+    if isinstance(item, (bytes, bytearray, memoryview)):
+        return len(item)
+    return 0
+
 #: consumer-side poll granularity: bounds how long a pull can block
 #: before re-checking that the worker is still alive (a dead worker with
 #: an empty queue would otherwise hang the consumer forever)
@@ -141,6 +160,9 @@ def _prefetch_worker(items: Iterable, q: "queue.Queue",
             hook = _produce_hook
             if hook is not None:
                 hook()
+            bhook = _bytes_hook
+            if bhook is not None:
+                bhook(_item_nbytes(item))
             if not put(item):
                 break
         else:

@@ -131,7 +131,8 @@ class StreamingTransactionSource(SpillScanMixin):
                  trans_id_ord: int = 0, skip_field_count: int = 1,
                  marker: Optional[str] = None,
                  block_bytes: int = 64 << 20,
-                 spill_cache: bool = True):
+                 spill_cache: bool = True,
+                 cache_budget_bytes: Optional[int] = None):
         self.paths = list(paths)
         self.delim = delim
         self.trans_id_ord = trans_id_ord
@@ -139,6 +140,7 @@ class StreamingTransactionSource(SpillScanMixin):
         self.marker = marker
         self.block_bytes = block_bytes
         self.spill_cache = spill_cache
+        self.cache_budget_bytes = cache_budget_bytes
         self.vocab: List[str] = []
         self.index: Dict[str, int] = {}
         self.n_trans = 0
@@ -282,59 +284,67 @@ class StreamingTransactionSource(SpillScanMixin):
     def _dense_chunks(self, block_rows: int):
         """uint8 [block_rows, V_masked] multi-hot blocks (mask applied).
         Replays the encoded-block cache when pass 1 spilled one and the
-        sources are unchanged — no CSV read, no re-tokenize; otherwise
-        the native (or python) re-parse path runs as before."""
+        sources are unchanged — no CSV read, no re-tokenize; sources
+        whose segment the cache's byte budget evicted re-parse natively
+        while the survivors keep replaying; otherwise the native (or
+        python) re-parse path runs as before."""
         from avenir_tpu.core.stream import prefetched
         from avenir_tpu.native.ingest import (csr_region_mask, csr_rows,
                                               native_seq_ready,
                                               seq_encode_native)
 
         vm = max(self.masked_width, 1)
-        if self._cache is not None and self._cache.valid:
-            for counts, codes in prefetched(self._cache.blocks(), depth=1):
+
+        def pages(r, c, n):
+            # r is sorted (row_of nondecreasing): each page is a
+            # searchsorted slice, not a full-array rescan
+            bounds = np.searchsorted(
+                r, np.arange(0, n + block_rows, block_rows,
+                             dtype=np.int32))
+            for page, (lo, hi) in enumerate(zip(bounds[:-1], bounds[1:])):
+                mh = np.zeros((block_rows, vm), np.uint8)
+                mh[r[lo:hi] - page * block_rows, c[lo:hi]] = 1
+                yield mh
+
+        def replay_pages(blk_iter):
+            for counts, codes in prefetched(blk_iter, depth=1):
                 n = counts.shape[0]
                 if n <= 0:
                     continue
                 row_of = np.repeat(np.arange(n, dtype=np.int32), counts)
                 r, c = self._apply_mask(row_of, codes)
-                bounds = np.searchsorted(
-                    r, np.arange(0, n + block_rows, block_rows,
-                                 dtype=np.int32))
-                for page, (lo, hi) in enumerate(
-                        zip(bounds[:-1], bounds[1:])):
-                    mh = np.zeros((block_rows, vm), np.uint8)
-                    mh[r[lo:hi] - page * block_rows, c[lo:hi]] = 1
-                    yield mh
+                yield from pages(r, c, n)
+
+        def parse_pages(path):
+            from avenir_tpu.core.stream import iter_byte_blocks
+
+            for data in prefetched(
+                    iter_byte_blocks(path, self.block_bytes), depth=1):
+                # cannot be None: availability + 1-byte delim checked
+                codes, offsets = seq_encode_native(
+                    data, self.delim, self.vocab)
+                n = offsets.shape[0] - 1
+                if n <= 0:
+                    continue
+                # item region only; unknown tokens (-1: ids, marker,
+                # empties) drop exactly like the python path
+                valid = csr_region_mask(offsets, self.skip,
+                                        codes.shape[0])
+                np.logical_and(valid, codes >= 0, out=valid)
+                row_of, _ = csr_rows(offsets)
+                r, c = self._apply_mask(row_of[valid], codes[valid])
+                yield from pages(r, c, n)
+
+        if self._cache is not None and self._cache.valid:
+            yield from replay_pages(self._cache.blocks())
             return
         if native_seq_ready(self.delim):
-            from avenir_tpu.core.stream import iter_byte_blocks, prefetched
-
-            for path in self.paths:
-                for data in prefetched(
-                        iter_byte_blocks(path, self.block_bytes), depth=1):
-                    # cannot be None: availability + 1-byte delim checked
-                    codes, offsets = seq_encode_native(
-                        data, self.delim, self.vocab)
-                    n = offsets.shape[0] - 1
-                    if n <= 0:
-                        continue
-                    # item region only; unknown tokens (-1: ids, marker,
-                    # empties) drop exactly like the python path
-                    valid = csr_region_mask(offsets, self.skip,
-                                            codes.shape[0])
-                    np.logical_and(valid, codes >= 0, out=valid)
-                    row_of, _ = csr_rows(offsets)
-                    r, c = self._apply_mask(row_of[valid], codes[valid])
-                    # r is sorted (row_of nondecreasing): each page is a
-                    # searchsorted slice, not a full-array rescan
-                    bounds = np.searchsorted(
-                        r, np.arange(0, n + block_rows, block_rows,
-                                     dtype=np.int32))
-                    for page, (lo, hi) in enumerate(
-                            zip(bounds[:-1], bounds[1:])):
-                        mh = np.zeros((block_rows, vm), np.uint8)
-                        mh[r[lo:hi] - page * block_rows, c[lo:hi]] = 1
-                        yield mh
+            for si, path in enumerate(self.paths):
+                if self._cache is not None \
+                        and self._cache.source_valid(si):
+                    yield from replay_pages(self._cache.blocks(si))
+                else:
+                    yield from parse_pages(path)
             return
 
         for mh, _ids in self.chunks(block_rows):

@@ -167,12 +167,14 @@ class StreamingSequenceSource(SpillScanMixin):
 
     def __init__(self, paths: Sequence[str], delim: str = ",",
                  skip_field_count: int = 1, block_bytes: int = 64 << 20,
-                 spill_cache: bool = True):
+                 spill_cache: bool = True,
+                 cache_budget_bytes: Optional[int] = None):
         self.paths = list(paths)
         self.delim = delim
         self.skip = skip_field_count
         self.block_bytes = block_bytes
         self.spill_cache = spill_cache
+        self.cache_budget_bytes = cache_budget_bytes
         self.vocab: List[str] = []
         self.index: Dict[str, int] = {}
         self.n_rows = 0
@@ -322,19 +324,19 @@ class StreamingSequenceSource(SpillScanMixin):
                     pos[lo:hi]] = enc[lo:hi]
                 yield blk
 
-        if self._cache is not None and self._cache.valid:
+        def replay_pages(blk_iter):
             # encoded-block replay: the pass-1 cache holds each block's
             # region tokens (counts per row + codes) — apply the
             # frequent-token mask, recompute compacted positions, page.
             # No CSV read, no tokenizer, either engine.
             from avenir_tpu.core.stream import prefetched
 
-            for counts, codes in prefetched(self._cache.blocks(), depth=1):
+            for counts, codes in prefetched(blk_iter, depth=1):
                 n = counts.shape[0]
                 if n <= 0:
                     continue
                 starts = np.zeros(n, np.int64)
-                np.cumsum(counts[:-1], out=starts[1:])
+                starts[1:] = np.cumsum(counts[:-1], dtype=np.int64)
                 row_of = np.repeat(np.arange(n, dtype=np.int32), counts)
                 if self._remap is not None:
                     enc_all = self._remap[codes]
@@ -349,44 +351,56 @@ class StreamingSequenceSource(SpillScanMixin):
                 rows_v = row_of[valid]
                 pos = cs[valid] - 1 - base[rows_v]
                 yield from pages(rows_v, pos, enc_all[valid], n)
+
+        def parse_pages(path):
+            from avenir_tpu.core.stream import iter_byte_blocks, prefetched
+
+            for data in prefetched(
+                    iter_byte_blocks(path, self.block_bytes), depth=1):
+                codes, offsets = seq_encode_native(
+                    data, self.delim, self.vocab)
+                n = offsets.shape[0] - 1
+                if n <= 0:
+                    continue
+                # sequence region, empty/meta tokens dropped like the
+                # python path (ids can collide with item tokens only
+                # at positions < skip, which this mask excludes)
+                valid = csr_region_mask(offsets, self.skip,
+                                        codes.shape[0])
+                np.logical_and(valid, codes >= 0, out=valid)
+                if self._remap is not None:
+                    # frequent-token mask: infrequent tokens drop and
+                    # positions compact (pos derives from survivors)
+                    codes = np.where(valid, self._remap[
+                        np.clip(codes, 0, None)], -1)
+                    np.logical_and(valid, codes >= 0, out=valid)
+                row_of, starts = csr_rows(offsets)
+                # within-row rank of each surviving token in int32
+                # region-mask form: one cumsum over the valid mask
+                # replaces the flatnonzero/arange/searchsorted int64
+                # triple that was the GSP pass's largest transient
+                # (blocks never hold 2^31 tokens — they are tens of MB)
+                cs = np.cumsum(valid, dtype=np.int32)
+                base = np.zeros(n, np.int32)
+                nz = starts > 0
+                base[nz] = cs[starts[nz] - 1]
+                rows_v = row_of[valid]
+                pos = cs[valid] - 1 - base[rows_v]
+                yield from pages(rows_v, pos, codes[valid], n)
+
+        if self._cache is not None and self._cache.valid:
+            yield from replay_pages(self._cache.blocks())
             return
 
         if native_seq_ready(self.delim):
-            from avenir_tpu.core.stream import iter_byte_blocks, prefetched
-
-            for path in self.paths:
-                for data in prefetched(
-                        iter_byte_blocks(path, self.block_bytes), depth=1):
-                    codes, offsets = seq_encode_native(
-                        data, self.delim, self.vocab)
-                    n = offsets.shape[0] - 1
-                    if n <= 0:
-                        continue
-                    # sequence region, empty/meta tokens dropped like the
-                    # python path (ids can collide with item tokens only
-                    # at positions < skip, which this mask excludes)
-                    valid = csr_region_mask(offsets, self.skip,
-                                            codes.shape[0])
-                    np.logical_and(valid, codes >= 0, out=valid)
-                    if self._remap is not None:
-                        # frequent-token mask: infrequent tokens drop and
-                        # positions compact (pos derives from survivors)
-                        codes = np.where(valid, self._remap[
-                            np.clip(codes, 0, None)], -1)
-                        np.logical_and(valid, codes >= 0, out=valid)
-                    row_of, starts = csr_rows(offsets)
-                    # within-row rank of each surviving token in int32
-                    # region-mask form: one cumsum over the valid mask
-                    # replaces the flatnonzero/arange/searchsorted int64
-                    # triple that was the GSP pass's largest transient
-                    # (blocks never hold 2^31 tokens — they are tens of MB)
-                    cs = np.cumsum(valid, dtype=np.int32)
-                    base = np.zeros(n, np.int32)
-                    nz = starts > 0
-                    base[nz] = cs[starts[nz] - 1]
-                    rows_v = row_of[valid]
-                    pos = cs[valid] - 1 - base[rows_v]
-                    yield from pages(rows_v, pos, codes[valid], n)
+            # per-source mix: sources whose segment the cache's byte
+            # budget evicted re-parse natively, survivors keep replaying
+            for si, path in enumerate(self.paths):
+                if self._cache is not None \
+                        and self._cache.source_valid(si):
+                    yield from replay_pages(self._cache.blocks(si))
+                else:
+                    yield from parse_pages(path)
             return
 
         buf: List[List[int]] = []

@@ -337,6 +337,13 @@ def scan_encode_blocks(paths, delim: str, skip: int, vocab: List[str],
 _ENC_MAGIC = b"AVNRENC1"
 _ENC_DTYPES = {0: np.uint8, 1: np.uint16, 2: np.uint32}
 
+#: the cache's default on-disk byte budget — generous (the 100M-row
+#: anchors spill ~1.5GB of CSV into ~600MB of codes), but FINITE: an
+#: unbudgeted spill is exactly the `mem-cache-spill-unbudgeted` hazard
+#: graftlint --mem flags, and the resident job server needs every spill
+#: evictable
+DEFAULT_CACHE_BUDGET_BYTES = 1 << 30
+
 
 def _enc_dtype_code(max_value: int) -> int:
     if max_value < (1 << 8):
@@ -366,6 +373,18 @@ class EncodedBlockCache:
     full-codes transients of the scan never materialize again (this is
     also what buys back Apriori's thin RSS headroom at 100M rows).
 
+    Byte budget: the spill is bounded by `byte_budget` (default
+    :data:`DEFAULT_CACHE_BUDGET_BYTES`; a config surface sits at the
+    jobs' ``stream.encoded.cache.budget.mb`` key). Blocks land in one
+    SEGMENT per source (``set_source``; writers that cannot attribute
+    blocks — the shared-scan external feed — use one combined segment).
+    Exceeding the budget evicts whole least-recently-replayed source
+    segments atomically (never-replayed segments first, in write
+    order), accumulating ``evicted_bytes``; consumers re-parse evicted
+    sources and keep replaying the survivors (``source_valid(i)`` /
+    ``blocks(i)``), so a tight budget degrades throughput, never
+    correctness.
+
     Invalidation contract: the cache fingerprints its source files
     (path, size, mtime_ns) at begin() and re-verifies at commit() and
     before every replay — a source that changed invalidates the cache
@@ -374,20 +393,37 @@ class EncodedBlockCache:
     removed on close()/GC; it is a within-job spill, not a cross-run
     artifact store."""
 
+    #: segment key of the combined (source-unattributed) write stream
+    _COMBINED = None
+
     def __init__(self, sources: Sequence[str],
-                 cache_dir: Optional[str] = None):
+                 cache_dir: Optional[str] = None,
+                 byte_budget: Optional[int] = None):
         import tempfile
 
         self.sources = list(sources)
+        self.byte_budget = (DEFAULT_CACHE_BUDGET_BYTES
+                            if byte_budget is None else int(byte_budget))
         self._own_dir = cache_dir is None
         self._dir = cache_dir or tempfile.mkdtemp(prefix="avenir_encblk_")
         os.makedirs(self._dir, exist_ok=True)
-        self._path = os.path.join(self._dir, "encoded_blocks.bin")
         self._fh = None
+        self._cur = self._COMBINED        # segment being written
+        self._seg_order: list = []        # segment keys in write order
+        self._seg_bytes: dict = {}        # segment key -> bytes written
+        self._evicted: set = set()
+        self._last_replay: dict = {}      # segment key -> replay clock
+        self._replay_clock = 0
         self._fingerprint = None
         self._committed = False
         self.n_blocks = 0
+        self.evicted_bytes = 0
         self.replays = 0          # completed replay passes (bench tripwire)
+
+    def _seg_path(self, key) -> str:
+        name = ("encoded_blocks.bin" if key is self._COMBINED
+                else f"encoded_blocks_s{key}.bin")
+        return os.path.join(self._dir, name)
 
     # ------------------------------------------------------------- write
     def _current_fingerprint(self):
@@ -403,36 +439,129 @@ class EncodedBlockCache:
     def begin(self) -> None:
         """Start (or restart) a write pass; any prior content is gone."""
         self.abort()
+        for key in self._seg_order:
+            try:
+                os.remove(self._seg_path(key))
+            except OSError:
+                pass
         self._fingerprint = self._current_fingerprint()
-        self._fh = open(self._path, "wb")
-        self._fh.write(_ENC_MAGIC)
+        self._seg_order = []
+        self._seg_bytes = {}
+        self._evicted = set()
+        self._last_replay = {}
+        self._cur = self._COMBINED
         self.n_blocks = 0
+        self.evicted_bytes = 0
+
+    def set_source(self, index: int) -> None:
+        """Attribute subsequent add_block() calls to source `index` —
+        per-source segments are what make partial eviction (and partial
+        replay) possible. Writers that cannot attribute blocks simply
+        never call this and get one combined segment."""
+        if self._cur == index:
+            return
+        if self._fh is not None:
+            self._fh.close()
+            self._fh = None
+        self._cur = index
+
+    def _open_segment(self) -> None:
+        path = self._seg_path(self._cur)
+        if self._cur in self._seg_order and os.path.exists(path):
+            # a writer returning to an earlier source (interleaved
+            # set_source calls) must EXTEND its segment — "wb" here
+            # would silently truncate committed blocks and replay a
+            # partial segment as if it were whole
+            self._fh = open(path, "ab")
+            return
+        self._fh = open(path, "wb")
+        self._fh.write(_ENC_MAGIC)
+        self._seg_bytes[self._cur] = len(_ENC_MAGIC)
+        if self._cur not in self._seg_order:
+            self._seg_order.append(self._cur)
+
+    def _spilled_bytes(self) -> int:
+        """Live spill size from the per-segment byte counters — O(live
+        segments) arithmetic, no flush/stat per call (add_block calls
+        this once per block)."""
+        return sum(n for k, n in self._seg_bytes.items()
+                   if k not in self._evicted)
+
+    def _evict_segment(self, key) -> None:
+        if key == self._cur and self._fh is not None:
+            self._fh.close()
+            self._fh = None
+        try:
+            os.remove(self._seg_path(key))
+        except OSError:
+            pass
+        self.evicted_bytes += self._seg_bytes.get(key, 0)
+        self._evicted.add(key)
+
+    def evict_to(self, byte_budget: int) -> int:
+        """Evict whole segments, least-recently-replayed first (never-
+        replayed segments in write order before any replayed one), until
+        the spill fits `byte_budget`. The currently-written segment goes
+        last — but it too is evicted when it alone exceeds the budget
+        (the cache then quietly disables itself for that source and the
+        consumer re-parses). Returns the bytes evicted by this call."""
+        before = self.evicted_bytes
+        order = {k: i for i, k in enumerate(self._seg_order)}
+        live = [k for k in self._seg_order if k not in self._evicted]
+        live.sort(key=lambda k: (k == self._cur,
+                                 self._last_replay.get(k, -1), order[k]))
+        spilled = self._spilled_bytes()
+        for key in live:
+            if spilled <= byte_budget:
+                break
+            spilled -= self._seg_bytes.get(key, 0)
+            self._evict_segment(key)
+        return self.evicted_bytes - before
 
     def add_block(self, counts: np.ndarray, codes: np.ndarray) -> None:
         """Append one block: per-row region token counts + the region
-        token codes (row-major). Narrowest-dtype encoding per block."""
+        token codes (row-major). Narrowest-dtype encoding per block; a
+        write that pushes the spill past the byte budget triggers
+        whole-segment eviction. Blocks for an already-evicted segment
+        are dropped (and counted) — the budget is a hard bound."""
         import struct
 
-        if self._fh is None:
+        if self._fingerprint is None:
             raise RuntimeError("add_block() before begin()")
+        if self._committed:
+            raise RuntimeError(
+                "add_block() after commit(): a sealed cache never grows "
+                "— call begin() to rewrite it")
         counts = np.ascontiguousarray(counts)
         codes = np.ascontiguousarray(codes)
         cd = _enc_dtype_code(int(counts.max(initial=0)))
         kd = _enc_dtype_code(int(codes.max(initial=0)))
+        size = (18 + counts.shape[0] * _ENC_DTYPES[cd]().itemsize
+                + codes.shape[0] * _ENC_DTYPES[kd]().itemsize)
+        if self._cur in self._evicted:
+            self.evicted_bytes += size
+            return
+        if self._fh is None:
+            self._open_segment()
         self._fh.write(struct.pack("<qqBB", counts.shape[0],
                                    codes.shape[0], cd, kd))
         counts.astype(_ENC_DTYPES[cd]).tofile(self._fh)
         codes.astype(_ENC_DTYPES[kd]).tofile(self._fh)
         self.n_blocks += 1
+        self._seg_bytes[self._cur] = self._seg_bytes.get(self._cur, 0) + size
+        if self._spilled_bytes() > self.byte_budget:
+            self.evict_to(self.byte_budget)
 
     def commit(self) -> bool:
         """Seal the write pass. Returns False (and stays invalid) when a
         source changed while the scan ran — a torn cache must never be
-        replayed."""
-        if self._fh is None:
+        replayed. Segments evicted by the budget stay evicted; the
+        surviving ones replay."""
+        if self._fingerprint is None:
             return False
-        self._fh.close()
-        self._fh = None
+        if self._fh is not None:
+            self._fh.close()
+            self._fh = None
         self._committed = self._fingerprint == self._current_fingerprint()
         return self._committed
 
@@ -440,26 +569,45 @@ class EncodedBlockCache:
         if self._fh is not None:
             self._fh.close()
             self._fh = None
+        self._fingerprint = None     # a new begin() must precede writes
         self._committed = False
 
     # ------------------------------------------------------------ replay
+    def _fingerprint_ok(self) -> bool:
+        return (self._committed
+                and self._fingerprint == self._current_fingerprint())
+
     @property
     def valid(self) -> bool:
-        """True when a committed cache exists AND the sources are
-        byte-for-byte the ones it encoded (size+mtime fingerprint)."""
-        return (self._committed
-                and self._fingerprint == self._current_fingerprint()
-                and os.path.exists(self._path))
+        """True when a committed cache exists, the sources are
+        byte-for-byte the ones it encoded (size+mtime fingerprint), AND
+        no segment was evicted — the all-or-nothing replay gate. With
+        evictions, consumers use the per-source gate below."""
+        return (self._fingerprint_ok() and not self._evicted
+                and all(os.path.exists(self._seg_path(k))
+                        for k in self._seg_order))
 
-    def blocks(self):
-        """Yield (counts int64 [n_rows], codes int32 [n_tokens]) per
-        cached block. Raises RuntimeError when the cache is not valid —
-        callers check `valid` and fall back to the re-parse path."""
+    def source_valid(self, index: int) -> bool:
+        """True when source `index`'s blocks can replay: its own segment
+        survives, or the cache wrote one combined segment for a single
+        source. A multi-source combined segment cannot split, so it
+        replays only through the all-or-nothing `valid` gate."""
+        if not self._fingerprint_ok():
+            return False
+        if index in self._seg_order:
+            return (index not in self._evicted
+                    and os.path.exists(self._seg_path(index)))
+        if self._COMBINED in self._seg_order and len(self.sources) == 1 \
+                and index == 0:
+            return (self._COMBINED not in self._evicted
+                    and os.path.exists(self._seg_path(self._COMBINED)))
+        return False
+
+    def _read_segment(self, key):
         import struct
 
-        if not self.valid:
-            raise RuntimeError("encoded-block cache is stale or absent")
-        with open(self._path, "rb") as fh:
+        path = self._seg_path(key)
+        with open(path, "rb") as fh:
             if fh.read(len(_ENC_MAGIC)) != _ENC_MAGIC:
                 raise RuntimeError("encoded-block cache is corrupt")
             while True:
@@ -471,12 +619,40 @@ class EncodedBlockCache:
                 codes = np.fromfile(fh, _ENC_DTYPES[kd], n_tok)
                 if counts.shape[0] != n_rows or codes.shape[0] != n_tok:
                     raise RuntimeError("encoded-block cache is truncated")
-                yield counts.astype(np.int64), codes.astype(np.int32)
+                # int32 both ways: per-row region counts are bounded by
+                # tokens-per-row and codes by the vocab — widening the
+                # block-proportional arrays to int64 here was exactly the
+                # mem-dtype-expansion-at-parse shape this tier flags
+                yield counts.astype(np.int32), codes.astype(np.int32)
+        self._replay_clock += 1
+        self._last_replay[key] = self._replay_clock
+
+    def blocks(self, source: Optional[int] = None):
+        """Yield (counts int32 [n_rows], codes int32 [n_tokens]) per
+        cached block — all segments in write order by default, one
+        source's segment with `source=i`. Raises RuntimeError when the
+        requested scope is not replayable — callers check `valid` /
+        `source_valid(i)` and fall back to the re-parse path."""
+        if source is not None:
+            if not self.source_valid(source):
+                raise RuntimeError(
+                    f"encoded-block segment for source {source} is "
+                    f"stale, evicted or absent")
+            key = source if source in self._seg_order else self._COMBINED
+            yield from self._read_segment(key)
+            live = [k for k in self._seg_order if k not in self._evicted]
+            if live and key == live[-1]:
+                self.replays += 1
+            return
+        if not self.valid:
+            raise RuntimeError("encoded-block cache is stale or absent")
+        for key in self._seg_order:
+            yield from self._read_segment(key)
         self.replays += 1
 
     def nbytes(self) -> int:
         try:
-            return os.path.getsize(self._path)
+            return self._spilled_bytes()
         except OSError:
             return 0
 
@@ -527,7 +703,9 @@ class SpillScanMixin:
     ``_reset_scan_state()`` (zero the per-scan row counters) and
     ``_scan_result()`` (the (vocab, counts, n) tuple scan()/scan_items()
     return). ``_scan_marker`` is the infrequent-item sentinel forwarded
-    to the encoder (None when the format has none)."""
+    to the encoder (None when the format has none); an optional
+    ``cache_budget_bytes`` attribute bounds the encoded-block spill
+    (None -> the cache's generous default)."""
 
     _scan_marker: Optional[str] = None
 
@@ -541,7 +719,9 @@ class SpillScanMixin:
         if self.spill_cache:
             if self._cache is not None:
                 self._cache.close()
-            self._cache = EncodedBlockCache(self.paths)
+            self._cache = EncodedBlockCache(
+                self.paths,
+                byte_budget=getattr(self, "cache_budget_bytes", None))
             self._cache.begin()
 
     def _grow_counts(self) -> None:
@@ -553,11 +733,16 @@ class SpillScanMixin:
 
     def _scan_all(self):
         """Own-read scan driver: prefetched byte blocks of every path
-        through _scan_block, then seal."""
+        through _scan_block, then seal. Blocks attribute to per-source
+        cache segments so a budget eviction drops whole sources, not the
+        whole cache (the SharedScan feed below cannot attribute and
+        writes one combined segment)."""
         from avenir_tpu.core.stream import iter_byte_blocks, prefetched
 
         self._scan_begin()
-        for path in self.paths:
+        for si, path in enumerate(self.paths):
+            if self._cache is not None:
+                self._cache.set_source(si)
             for data in prefetched(iter_byte_blocks(path, self.block_bytes),
                                    depth=1):
                 self._scan_block(data)
@@ -598,6 +783,13 @@ class SpillScanMixin:
     def cache_nbytes(self) -> int:
         """On-disk size of the encoded-block spill cache (0 when off)."""
         return self._cache.nbytes() if self._cache is not None else 0
+
+    @property
+    def cache_evicted_bytes(self) -> int:
+        """Bytes the spill cache evicted (or dropped) to hold its byte
+        budget — surfaced as the Cache:EvictedBytes job counter."""
+        return (self._cache.evicted_bytes
+                if self._cache is not None else 0)
 
     def close(self) -> None:
         if self._cache is not None:

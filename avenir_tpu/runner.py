@@ -108,13 +108,54 @@ def _job_cfg(name: str, conf) -> Tuple[str, str, JobConfig]:
 
 def run_job(name: str, conf, inputs: Sequence[str], output: str = "") -> JobResult:
     """Run a registered job. `conf` is a properties file path, a dict, or a
-    JobConfig; the job sees it scoped under its reference prefix."""
+    JobConfig; the job sees it scoped under its reference prefix.
+
+    Every streamed job's result additionally carries the memory-oracle
+    counter pair: `Mem:PredictedPeakBytes` (the analysis/mem analytic
+    footprint model at the job's block size and corpus) next to the
+    measured `Mem:PeakRSS` — so long-running anchors (the 100M-row
+    stream_scale_check children run one job per process) record the
+    model's error over time."""
     canonical, _prefix, cfg = _job_cfg(name, conf)
     fn = _REGISTRY[canonical][2]
     if output:
         parent = os.path.dirname(os.path.abspath(output))
         os.makedirs(parent, exist_ok=True)
-    return fn(cfg, list(inputs), output)
+    res = fn(cfg, list(inputs), output)
+    _add_mem_counters(canonical, cfg, inputs, res)
+    return res
+
+
+def _add_mem_counters(canonical: str, cfg: JobConfig,
+                      inputs: Sequence[str], res: JobResult) -> None:
+    """Attach the memory-oracle counters to a streamed job's result.
+    Advisory by contract: a failure to PREDICT must never fail a job
+    that already ran, so any error here drops the counters silently."""
+    if canonical not in _STREAM_FOLDS:
+        return
+    try:
+        import resource
+
+        from avenir_tpu.analysis.mem import corpus_stats, footprint_model
+
+        paths = [p for p in inputs if os.path.exists(p)]
+        if not paths:
+            return
+        # linux ru_maxrss is KB; this is the process peak at job end —
+        # exact for the one-job-per-process scale anchors, an upper
+        # bound inside long-lived processes
+        rss = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss * 1024
+        block = int(cfg.get_float("stream.block.size.mb", 64.0) * (1 << 20))
+        stats = corpus_stats(paths, delim=cfg.field_delim_regex)
+        schema = None
+        schema_path = cfg.get("feature.schema.file.path")
+        if schema_path:
+            schema = FeatureSchema.from_file(schema_path)
+        est = footprint_model(canonical, block, schema, stats)
+        res.counters["Mem:PredictedPeakBytes"] = float(est.total_bytes)
+        res.counters["Mem:PeakRSS"] = float(rss)
+    except Exception:
+        pass
 
 
 # ---------------------------------------------------------------- helpers
@@ -351,6 +392,28 @@ class _MarkovPerClassFold:
                          {"Basic:Records": self.rows}, [out], self.model)
 
 
+def _cache_budget(cfg: JobConfig) -> int:
+    """The encoded-block spill cache's on-disk byte budget
+    (`stream.encoded.cache.budget.mb`, default generous — see
+    native.ingest.DEFAULT_CACHE_BUDGET_BYTES). Exceeding it evicts whole
+    least-recently-replayed sources; the job re-parses those and reports
+    the eviction through Cache:EvictedBytes."""
+    from avenir_tpu.native.ingest import DEFAULT_CACHE_BUDGET_BYTES
+
+    return int(cfg.get_float("stream.encoded.cache.budget.mb",
+                             DEFAULT_CACHE_BUDGET_BYTES / (1 << 20))
+               * (1 << 20))
+
+
+def _cache_counters(src) -> Dict[str, float]:
+    """Spill-cache counters for a miner JobResult: on-disk spill bytes
+    and what the byte budget evicted (0 in the healthy case — a nonzero
+    value is the admission layer's signal that this corpus outgrew its
+    cache budget)."""
+    return {"Cache:SpillBytes": float(src.cache_nbytes),
+            "Cache:EvictedBytes": float(src.cache_evicted_bytes)}
+
+
 def _write_apriori_outputs(cfg: JobConfig, output: str, levels) -> List[str]:
     outs = []
     os.makedirs(output or ".", exist_ok=True)
@@ -401,7 +464,8 @@ class _MinerScanFold:
                 list(inputs), delim=cfg.field_delim_regex,
                 trans_id_ord=cfg.get_int("tans.id.ord", 0),
                 skip_field_count=skip, marker=cfg.get("infreq.item.marker"),
-                block_bytes=block, spill_cache=spill)
+                block_bytes=block, spill_cache=spill,
+                cache_budget_bytes=_cache_budget(cfg))
         else:
             from avenir_tpu.models.sequence import (GSPMiner,
                                                     StreamingSequenceSource)
@@ -412,7 +476,8 @@ class _MinerScanFold:
             self.src = StreamingSequenceSource(
                 list(inputs), delim=cfg.field_delim_regex,
                 skip_field_count=skip, block_bytes=block,
-                spill_cache=spill)
+                spill_cache=spill,
+                cache_budget_bytes=_cache_budget(cfg))
         self._sink = self.src.scan_consumer()
 
     def consume(self, data: bytes) -> None:
@@ -425,13 +490,15 @@ class _MinerScanFold:
             n_rows = self.src.n_trans
             counters = {"Apriori:MaxLength": len(levels),
                         **throughput_counters(
-                            n_rows, time.perf_counter() - self.t0)}
+                            n_rows, time.perf_counter() - self.t0),
+                        **_cache_counters(self.src)}
             outs = _write_apriori_outputs(self.cfg, output, levels)
         else:
             n_rows = self.src.n_rows
             counters = {"GSP:MaxLength": max(levels) if levels else 0,
                         **throughput_counters(
-                            n_rows, time.perf_counter() - self.t0)}
+                            n_rows, time.perf_counter() - self.t0),
+                        **_cache_counters(self.src)}
             outs = _write_gsp_outputs(self.cfg, output, levels)
         self.src.close()
         return JobResult(self.job, counters, outs, levels)
@@ -530,6 +597,9 @@ def run_shared(specs: Sequence[Tuple[str, object, str]],
             parent = os.path.dirname(os.path.abspath(output))
             os.makedirs(parent, exist_ok=True)
         results[canonical] = fold.finish(output)
+        _add_mem_counters(canonical, next(
+            cfg for c, _k, cfg, _f, _o in built if c == canonical),
+            inputs, results[canonical])
     return results
 
 
@@ -617,8 +687,11 @@ def bayesian_predictor(cfg: JobConfig, inputs: List[str], output: str) -> JobRes
     out = _out_file(output)
     counters: Dict[str, float] = {}
     cls_vals = schema.class_values()
-    actual: List[np.ndarray] = []
-    predicted: List[np.ndarray] = []
+    # validation folds a ConfusionMatrix PER CHUNK (its count matrix is
+    # additive), instead of collecting per-chunk label/code arrays and
+    # concatenating at the end — that carry grew with rows seen, the
+    # exact mem-unbounded-carry shape graftlint --mem flags
+    cm: Optional[ConfusionMatrix] = None
     # map-only job: test rows stream in blocks (host RSS O(block))
     from avenir_tpu.core.stream import stream_job_inputs
 
@@ -638,13 +711,14 @@ def bayesian_predictor(cfg: JobConfig, inputs: List[str], output: str) -> JobRes
                     prob = int(np.rint(100.0 * row_post[int(c)] / tot))
                     fh.write(delim.join(raw + [cls_vals[int(c)], str(prob)]) + "\n")
                 if validate:
-                    actual.append(ds.labels())
-                    predicted.append(codes)
-    if actual:
-        pos = cfg.get("positive.class.value")
-        pi = cls_vals.index(pos) if pos else 1
-        counters = _validate(cls_vals, np.concatenate(actual),
-                             np.concatenate(predicted), pi)
+                    if cm is None:
+                        pos = cfg.get("positive.class.value")
+                        cm = ConfusionMatrix(
+                            cls_vals,
+                            pos_class=cls_vals.index(pos) if pos else 1)
+                    cm.add(ds.labels(), codes)
+    if cm is not None:
+        counters = cm.counters()
     return JobResult("bayesianPredictor", counters, [out])
 
 
@@ -700,9 +774,10 @@ def nearest_neighbor(cfg: JobConfig, inputs: List[str], output: str) -> JobResul
         pos_i, neg_i = cls_vals.index(pos_v), cls_vals.index(neg_v)
         clf.positive_class = pos_i
     # queries stream in blocks against the resident train index — test-set
-    # size never bounds host RSS (the model is the index, not the queries)
-    actual: List[np.ndarray] = []
-    predicted: List[np.ndarray] = []
+    # size never bounds host RSS (the model is the index, not the
+    # queries); validation folds the additive ConfusionMatrix per chunk
+    # instead of carrying every chunk's labels to the end
+    cm: Optional[ConfusionMatrix] = None
     with open(out, "w") as fh:
         for test in stream_job_inputs(cfg, [test_path], schema):
             codes, scores = clf.predict(test)
@@ -720,12 +795,11 @@ def nearest_neighbor(cfg: JobConfig, inputs: List[str], output: str) -> JobResul
                                for j in range(len(cls_vals))]
                 fh.write(out_delim.join(fields) + "\n")
             if validate:
-                actual.append(test.labels())
-                predicted.append(codes)
-    counters: Dict[str, float] = {}
-    if actual:
-        counters = _validate(cls_vals, np.concatenate(actual),
-                             np.concatenate(predicted), clf.positive_class)
+                if cm is None:
+                    cm = ConfusionMatrix(cls_vals,
+                                         pos_class=clf.positive_class)
+                cm.add(test.labels(), codes)
+    counters: Dict[str, float] = cm.counters() if cm is not None else {}
     return JobResult("nearestNeighbor", counters, [out])
 
 
@@ -1466,12 +1540,15 @@ def gsp_job(cfg: JobConfig, inputs: List[str], output: str) -> JobResult:
             inputs, delim=cfg.field_delim_regex, skip_field_count=skip,
             block_bytes=int(cfg.get_float("stream.block.size.mb", 64.0)
                             * (1 << 20)),
-            spill_cache=cfg.get_bool("stream.encoded.cache", True))
+            spill_cache=cfg.get_bool("stream.encoded.cache", True),
+            cache_budget_bytes=_cache_budget(cfg))
         levels = miner.mine_stream(src)
         n_rows = src.n_rows
+        cache_counters = _cache_counters(src)
         src.close()
     counters = {"GSP:MaxLength": max(levels) if levels else 0,
-                **throughput_counters(n_rows, time.perf_counter() - t0)}
+                **throughput_counters(n_rows, time.perf_counter() - t0),
+                **(cache_counters if not in_ram else {})}
     outs = _write_gsp_outputs(cfg, output, levels)
     return JobResult("candidateGenerationWithSelfJoin", counters,
                      outs, levels)
@@ -1626,12 +1703,15 @@ def apriori_job(cfg: JobConfig, inputs: List[str], output: str) -> JobResult:
             trans_id_ord=trans_id_ord, skip_field_count=skip, marker=marker,
             block_bytes=int(cfg.get_float("stream.block.size.mb", 64.0)
                             * (1 << 20)),
-            spill_cache=cfg.get_bool("stream.encoded.cache", True))
+            spill_cache=cfg.get_bool("stream.encoded.cache", True),
+            cache_budget_bytes=_cache_budget(cfg))
         levels = miner.mine_stream(src)
         n_rows = src.n_trans
+        cache_counters = _cache_counters(src)
         src.close()
     counters = {"Apriori:MaxLength": len(levels),
-                **throughput_counters(n_rows, time.perf_counter() - t0)}
+                **throughput_counters(n_rows, time.perf_counter() - t0),
+                **(cache_counters if not in_ram else {})}
     outs = _write_apriori_outputs(cfg, output, levels)
     return JobResult("frequentItemsApriori", counters, outs, levels)
 

@@ -27,13 +27,16 @@ import tempfile
 
 def graftlint_tripwire() -> dict:
     """Run the graftlint CLI (--json) over the package, the --ir
-    manifest audit AND the --flow concurrency/invariance audit, failing
-    the bench on any non-allowlisted finding, stale baseline entry,
-    trace error, a distributed family whose collective payload drifted
-    off the scaling.py analytic model, or a streamed fold kernel whose
-    output bytes moved with the chunk layout — hazard/traffic/
-    determinism regressions surface here every round, not at the next
-    100M-row run."""
+    manifest audit, the --flow concurrency/invariance audit AND the
+    --mem footprint audit, failing the bench on any non-allowlisted
+    finding, stale baseline entry, trace error, a distributed family
+    whose collective payload drifted off the scaling.py analytic model,
+    a streamed fold kernel whose output bytes moved with the chunk
+    layout, or a streamed job whose measured peak RSS left the memory
+    model's tolerance band — hazard/traffic/determinism/footprint
+    regressions surface here every round, not at the next 100M-row run.
+    The round's memory manifest (the job server's admission oracle) is
+    re-derived and written next to the STREAM_SCALE_*.json records."""
     import os
     import subprocess
 
@@ -75,13 +78,36 @@ def graftlint_tripwire() -> dict:
         raise RuntimeError(
             f"chunk-invariance audit regression: {len(inv)} stream "
             f"kernels audited, drifted={drifted}")
+    mem_rep = run(["--mem"], "--mem")
+    fp = mem_rep["footprint_audit"]
+    unbanded = [r["kernel"] for r in fp
+                if not r["footprint_model_validated"]]
+    # same >= 8 floor as the invariance audit: every streamed fold
+    # kernel (solo + fused) must re-prove the memory oracle per round
+    if unbanded or len(fp) < 8:
+        raise RuntimeError(
+            f"footprint audit regression: {len(fp)} streamed jobs "
+            f"audited, out-of-band={unbanded}")
+    # re-derive the admission oracle and pin it next to the scale
+    # records so the job-server work consumes a fresh artifact, not a
+    # stale hand-written one
+    from avenir_tpu.analysis.mem import memory_manifest
+
+    manifest = memory_manifest()
+    manifest["footprint_audit"] = fp
+    with open(os.path.join(root, "MEMORY_MANIFEST.json"), "w") as fh:
+        json.dump(manifest, fh, indent=1)
     return {"files": ast_rep["files_scanned"], "findings": 0,
             "allowlisted": ast_rep["suppressed"],
             "ir_findings": 0,
             "payload_families_validated": len(audit),
             "flow_findings": 0,
             "flow_allowlisted": flow_rep["suppressed"],
-            "stream_kernels_validated": len(inv)}
+            "stream_kernels_validated": len(inv),
+            "mem_findings": 0,
+            "mem_allowlisted": mem_rep["suppressed"],
+            "footprint_jobs_validated": len(fp),
+            "memory_manifest": "MEMORY_MANIFEST.json"}
 
 
 def miner_tripwire(rows: int = 20_000) -> dict:

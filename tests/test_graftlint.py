@@ -43,14 +43,21 @@ def test_repo_lints_clean_against_baseline():
 
 
 def test_baseline_entries_all_used():
-    """Every allowlist entry must still excuse a live finding somewhere in
-    the gated surface (stale entries are dead weight that would mask a
-    regression landing in the same scope)."""
+    """Every AST-tier allowlist entry must still excuse a live finding
+    somewhere in the gated surface (stale entries are dead weight that
+    would mask a regression landing in the same scope). The baseline is
+    shared across tiers — flow/mem entries are enforced the same way by
+    their own gate tests (stale detection is rule-active-aware)."""
+    from avenir_tpu.analysis.rules import rule_ids
+
     baseline = load_baseline()
     assert baseline, "baseline file missing or empty"
+    ast_ids = set(rule_ids())
+    ast_entries = [e for e in baseline if e.key.split("::")[1] in ast_ids]
+    assert ast_entries, "no AST-tier entries left in the baseline?"
     report = run_paths([os.path.join(REPO, p) for p in GATED],
                        baseline=baseline, root=REPO)
-    assert len(report.suppressed) >= len(baseline)
+    assert len(report.suppressed) >= len(ast_entries)
 
 
 # ------------------------------------------------- fixture corpus helpers
@@ -540,7 +547,7 @@ def test_cli_baseline_matches_from_any_cwd(tmp_path):
     proc = _cli([os.path.join(REPO, "avenir_tpu"), "--json"], str(tmp_path))
     assert proc.returncode == 0, proc.stdout + proc.stderr
     rep = json.loads(proc.stdout)
-    assert rep["clean"] and rep["suppressed"] >= 18
+    assert rep["clean"] and rep["suppressed"] >= 15
 
 
 def test_cli_package_gate_matches_inprocess_gate():

@@ -77,7 +77,16 @@ def test_dataset_fused_outputs_byte_identical(tmp_path):
     assert set(fused) == set(seq)
     for name in seq:
         assert _read_outputs(fused[name]) == _read_outputs(seq[name]), name
-        assert fused[name].counters == seq[name].counters
+        # Mem:PeakRSS is a process measurement, not a job output — it
+        # legitimately differs between the two passes; everything else
+        # (including the deterministic Mem:PredictedPeakBytes) must match
+        drop = {"Mem:PeakRSS"}
+        assert {k: v for k, v in fused[name].counters.items()
+                if k not in drop} \
+            == {k: v for k, v in seq[name].counters.items()
+                if k not in drop}
+        assert fused[name].counters["Mem:PeakRSS"] > 0
+        assert seq[name].counters["Mem:PeakRSS"] > 0
 
 
 def test_bytes_fused_outputs_byte_identical(tmp_path):
@@ -249,7 +258,8 @@ def test_sink_failure_closes_generator_feeds(tmp_path):
 def test_cache_cold_warm_and_source_invalidation(tmp_path):
     src_file = tmp_path / "corpus.csv"
     src_file.write_text("a,b,c\n" * 100)
-    cache = EncodedBlockCache([str(src_file)], cache_dir=str(tmp_path / "c"))
+    cache = EncodedBlockCache([str(src_file)], cache_dir=str(tmp_path / "c"),
+                              byte_budget=1 << 20)
     # cold: nothing committed, replay refuses
     assert not cache.valid
     with pytest.raises(RuntimeError):
@@ -281,7 +291,8 @@ def test_cache_cold_warm_and_source_invalidation(tmp_path):
 def test_cache_commit_detects_mid_scan_source_change(tmp_path):
     src_file = tmp_path / "corpus.csv"
     src_file.write_text("a,b\n" * 10)
-    cache = EncodedBlockCache([str(src_file)], cache_dir=str(tmp_path / "c"))
+    cache = EncodedBlockCache([str(src_file)], cache_dir=str(tmp_path / "c"),
+                              byte_budget=1 << 20)
     cache.begin()
     cache.add_block(np.array([1], np.int64), np.array([0], np.int32))
     with open(src_file, "a") as fh:

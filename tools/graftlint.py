@@ -3,6 +3,8 @@
 
     python tools/graftlint.py avenir_tpu/ [--json] [--baseline FILE]
     python tools/graftlint.py --ir [--json]     # kernel-manifest IR audit
+    python tools/graftlint.py --flow [--json]   # concurrency + invariance
+    python tools/graftlint.py --mem [--json]    # footprint rules + audit
 
 Same entry point as the `graftlint` console script. Exit codes: 0 clean,
 1 findings/stale/parse errors, 2 usage-or-trace errors. See

@@ -106,6 +106,17 @@ def run_child(job, conf, inp, out):
     if proc.returncode != 0:
         raise RuntimeError(f"{job} failed: {proc.stderr[-500:]}")
     line = json.loads(proc.stdout.strip().splitlines()[-1])
+    # memory-oracle delta column: the runner attaches
+    # Mem:PredictedPeakBytes (analysis/mem footprint model) next to the
+    # measured Mem:PeakRSS, so every 100M anchor records the model's
+    # error over time — the real-scale complement of the CI-scale
+    # graftlint --mem band
+    predicted = line.get("counters", {}).get("Mem:PredictedPeakBytes")
+    if predicted:
+        pred_mb = predicted / (1 << 20)
+        line["predicted_peak_mb"] = round(pred_mb, 1)
+        line["mem_model_delta_pct"] = round(
+            100.0 * (line["peak_rss_mb"] - pred_mb) / pred_mb, 1)
     print(json.dumps(line), flush=True)
     assert line["peak_rss_mb"] < RSS_LIMIT_MB, \
         f"{job} RSS {line['peak_rss_mb']}MB not O(block)"
@@ -237,6 +248,11 @@ def main():
                      ("gsp_rows_per_sec", "candidateGenerationWithSelfJoin")):
         if job in results:
             summary[key] = results[job]["counters"].get("Basic:RowsPerSec")
+    # predicted-vs-measured memory column per streamed job (model error
+    # at real scale; the record file keeps the full per-job numbers)
+    summary["mem_model_delta_pct"] = {
+        job: line["mem_model_delta_pct"] for job, line in results.items()
+        if isinstance(line, dict) and "mem_model_delta_pct" in line}
     if "sharedScan" in results:
         summary["shared_scan_speedup"] = results["sharedScan"]["speedup"]
     print(json.dumps(summary))
