@@ -27,6 +27,12 @@ Entry points:
     model against sampled peak RSS for every streamed job at >= 2
     block sizes (``mem.memory_manifest()`` exports the machine-
     readable admission oracle);
+  - ``avenir_tpu.analysis.merge.run_merge`` — the merge layer
+    (``graftlint --merge``): fold-state merge-algebra rules + the
+    mechanical shard-merge/resume auditor, which proves every streamed
+    job's carry merges across P ∈ {2, 4} shards and checkpoint-resumes
+    byte-identically through the registered ``runner.StreamFoldOps``
+    (``graftlint --all`` runs all five tiers with one worst-of exit);
   - ``graftlint_baseline.txt`` — the allowlist: accepted findings keyed
     by ``path::rule::scope`` with a one-line justification each, shared
     by both modes.

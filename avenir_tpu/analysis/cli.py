@@ -1,7 +1,7 @@
 """graftlint CLI: `graftlint <paths>` (console script) or
 `python tools/graftlint.py <paths>`.
 
-Four modes sharing one report/baseline/exit contract:
+Five modes sharing one report/baseline/exit contract, plus ``--all``:
 
 - AST (default): lint source paths with the rules.py catalog.
 - IR (``--ir``, no paths): trace the kernel manifest
@@ -15,6 +15,13 @@ Four modes sharing one report/baseline/exit contract:
   footprint rules (analysis/mem.py) plus the RSS/live-bytes footprint
   audit that proves the analytic memory model against sampled peak RSS
   for every streamed job at >= 2 block sizes.
+- Merge (``--merge``, paths optional — same default surface): the
+  fold-state merge-algebra rules (analysis/merge.py) plus the
+  shard-merge/resume audit proving every streamed job's carry merges
+  across P ∈ {2, 4} shards and checkpoint-resumes byte-identically.
+- All (``--all``): the five tiers in ONE process — combined JSON under
+  a ``modes`` key and a single worst-of exit code (one command for CI
+  and the bench tripwire's local reproduction).
 
 Exit-code contract (stable — bench_scaling.py and CI tripwire on it):
   0  clean: no findings, no stale baseline entries, no parse errors
@@ -22,11 +29,14 @@ Exit-code contract (stable — bench_scaling.py and CI tripwire on it):
      parse errors in the linted sources
   2  usage-or-trace-error — bad flags/baseline format/unreadable input,
      a manifest entry that failed to trace/lower (--ir), or a stream
-     kernel that failed to run (--flow / --mem)
+     kernel that failed to run (--flow / --mem / --merge)
+``--all`` exits with the WORST code any tier produced.
 
-`--json` prints one machine-readable object in every mode (same schema:
-`payload_audit` is empty outside --ir, `invariance_audit` outside
---flow, `footprint_audit` outside --mem).
+`--json` prints one machine-readable object in every single-tier mode
+(same schema: `payload_audit` is empty outside --ir, `invariance_audit`
+outside --flow, `footprint_audit` outside --mem, `merge_audit` outside
+--merge); ``--all --json`` prints ``{"modes": {<tier>: <report>},
+"clean": bool}`` with every tier's report under its name.
 """
 
 from __future__ import annotations
@@ -40,6 +50,9 @@ from typing import List, Optional
 from avenir_tpu.analysis.engine import (default_baseline_path, load_baseline,
                                         run_paths)
 from avenir_tpu.analysis.rules import ALL_RULES, rule_ids
+
+#: the five analysis tiers, in audit-cost order (cheapest first)
+TIERS = ("ast", "ir", "flow", "mem", "merge")
 
 
 def build_parser() -> argparse.ArgumentParser:
@@ -65,6 +78,16 @@ def build_parser() -> argparse.ArgumentParser:
                         "the paths (default: the gated repo surface) + the "
                         "RSS footprint audit proving the analytic memory "
                         "model for every streamed job at >= 2 block sizes")
+    p.add_argument("--merge", action="store_true",
+                   help="fold-state merge-algebra analysis: the merge-* "
+                        "rules over the paths (default: the gated repo "
+                        "surface) + the shard-merge/resume audit proving "
+                        "every streamed job's carry merges across shards "
+                        "and checkpoint-resumes byte-identically")
+    p.add_argument("--all", action="store_true", dest="all_tiers",
+                   help="run all five tiers in one process: combined JSON "
+                        "(modes keyed by tier) and a single worst-of exit "
+                        "code")
     p.add_argument("--baseline", default=None,
                    help="allowlist file (default: "
                         "avenir_tpu/analysis/graftlint_baseline.txt)")
@@ -75,7 +98,9 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--rules", default=None, metavar="ID[,ID...]",
                    help=f"comma-separated subset of: {', '.join(rule_ids())} "
                         f"(or the ir-* ids with --ir, the flow-* ids with "
-                        f"--flow, the mem-* ids with --mem)")
+                        f"--flow, the mem-* ids with --mem, the merge-* ids "
+                        f"with --merge; --all accepts ids from any tier and "
+                        f"skips tiers with none selected)")
     p.add_argument("--no-md", action="store_true",
                    help="skip ```python fences in .md files")
     p.add_argument("--allow-stale", action="store_true",
@@ -130,21 +155,160 @@ def _report_root(args) -> Optional[str]:
         default_baseline_path())))
 
 
+def _print_report(report, is_ir: bool) -> None:
+    for f in report.errors + report.findings:
+        print(f.render())
+    for e in report.stale:
+        print(f"stale baseline entry (line {e.lineno}): {e.key} — the "
+              f"finding it excused is gone; delete it", file=sys.stderr)
+    unit = "kernel modules" if is_ir else "files"
+    tail = ""
+    if report.payload_audit:
+        ok = sum(1 for a in report.payload_audit
+                 if a["payload_model_validated"])
+        tail = (f", payload audit {ok}/{len(report.payload_audit)} "
+                f"families validated")
+    if report.invariance_audit:
+        ok = sum(1 for a in report.invariance_audit
+                 if a["invariance_validated"])
+        tail += (f", chunk-invariance audit {ok}/"
+                 f"{len(report.invariance_audit)} stream kernels "
+                 f"validated")
+    if report.footprint_audit:
+        ok = sum(1 for a in report.footprint_audit
+                 if a["footprint_model_validated"])
+        tail += (f", footprint audit {ok}/"
+                 f"{len(report.footprint_audit)} streamed jobs "
+                 f"validated")
+    if report.merge_audit:
+        ok = sum(1 for a in report.merge_audit if a["merge_validated"])
+        tail += (f", merge audit {ok}/{len(report.merge_audit)} "
+                 f"stream kernels validated")
+    print(f"graftlint: {len(report.scanned)} {unit}, "
+          f"{len(report.findings)} finding(s), "
+          f"{len(report.suppressed)} allowlisted, "
+          f"{len(report.stale)} stale baseline entr(y/ies)"
+          + (f", {len(report.errors)} parse error(s)"
+             if report.errors else "") + tail)
+
+
+def _exit_code(report, args) -> int:
+    if report.findings or report.errors:
+        return 1
+    if report.stale and not args.allow_stale:
+        return 1
+    return 0
+
+
+def _run_all(args, baseline, wanted: Optional[List[str]]) -> int:
+    """The ``--all`` mode: five tiers, one process, worst-of exit.
+
+    A ``--rules`` subset skips every tier it names no rules of (its
+    audit included only when the tier's audit pseudo-rule is named), so
+    fixture-level CI checks stay fast; the full run is what the bench
+    tripwire executes every round."""
+    _bootstrap_ir_env()
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    from avenir_tpu.analysis.flow import (ALL_FLOW_RULES, FLOW_AUDIT_RULE,
+                                          FlowAuditError, run_flow)
+    from avenir_tpu.analysis.ir import (ALL_IR_RULES, IRTraceError,
+                                        PAYLOAD_RULE, run_ir)
+    from avenir_tpu.analysis.mem import (ALL_MEM_RULES, MEM_AUDIT_RULE,
+                                         MemAuditError, run_mem)
+    from avenir_tpu.analysis.merge import (ALL_MERGE_RULES, MERGE_AUDIT_RULE,
+                                           MergeAuditError, run_merge)
+
+    paths = args.paths or None
+    root = _report_root(args)
+    md = not args.no_md
+
+    def pick(rule_classes):
+        if wanted is None:
+            return [r() for r in rule_classes]
+        return [r() for r in rule_classes if r.rule_id in wanted]
+
+    def want_audit(audit_rule):
+        return wanted is None or audit_rule in wanted
+
+    modes = {}
+    worst = 0
+    runs = [
+        ("ast", None, None,
+         lambda: run_paths(paths or _default_surface(), rules=pick(ALL_RULES),
+                           baseline=baseline, root=root, include_md=md),
+         lambda: bool(pick(ALL_RULES))),
+        ("ir", IRTraceError, "trace error",
+         lambda: run_ir(rules=pick(ALL_IR_RULES), baseline=baseline,
+                        audit=want_audit(PAYLOAD_RULE)),
+         lambda: bool(pick(ALL_IR_RULES)) or want_audit(PAYLOAD_RULE)),
+        ("flow", FlowAuditError, "stream audit error",
+         lambda: run_flow(paths=paths, rules=pick(ALL_FLOW_RULES),
+                          baseline=baseline, root=root, include_md=md,
+                          audit=want_audit(FLOW_AUDIT_RULE)),
+         lambda: bool(pick(ALL_FLOW_RULES)) or want_audit(FLOW_AUDIT_RULE)),
+        ("mem", MemAuditError, "footprint audit error",
+         lambda: run_mem(paths=paths, rules=pick(ALL_MEM_RULES),
+                         baseline=baseline, root=root, include_md=md,
+                         audit=want_audit(MEM_AUDIT_RULE)),
+         lambda: bool(pick(ALL_MEM_RULES)) or want_audit(MEM_AUDIT_RULE)),
+        ("merge", MergeAuditError, "merge audit error",
+         lambda: run_merge(paths=paths, rules=pick(ALL_MERGE_RULES),
+                           baseline=baseline, root=root, include_md=md,
+                           audit=want_audit(MERGE_AUDIT_RULE)),
+         lambda: bool(pick(ALL_MERGE_RULES)) or want_audit(MERGE_AUDIT_RULE)),
+    ]
+    for name, err_cls, err_label, run, active in runs:
+        if wanted is not None and not active():
+            modes[name] = {"skipped": True}
+            continue
+        try:
+            report = run()
+        except tuple(c for c in (err_cls, OSError) if c is not None) as e:
+            label = err_label or "error"
+            print(f"graftlint [{name}]: {label}: {e}", file=sys.stderr)
+            modes[name] = {"error": str(e)}
+            worst = 2
+            continue
+        modes[name] = report.to_json()
+        if not args.as_json:
+            print(f"-- {name} " + "-" * (68 - len(name)))
+            _print_report(report, is_ir=(name == "ir"))
+        worst = max(worst, _exit_code(report, args))
+    clean = worst == 0
+    if args.as_json:
+        print(json.dumps({"modes": modes, "clean": clean}, indent=1))
+    else:
+        print(f"graftlint --all: "
+              f"{sum(1 for m in modes.values() if 'skipped' in m)} tier(s) "
+              f"skipped, worst exit {worst}")
+    return worst
+
+
+def _default_surface() -> List[str]:
+    from avenir_tpu.analysis.flow import default_flow_paths
+
+    return default_flow_paths(os.getcwd())
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     parser = build_parser()
     args = parser.parse_args(argv)
-    if sum(1 for m in (args.ir, args.flow, args.mem) if m) > 1:
-        print("graftlint: --ir, --flow and --mem are separate analysis "
-              "tiers; run them as separate invocations", file=sys.stderr)
+    tier_flags = sum(1 for m in (args.ir, args.flow, args.mem, args.merge)
+                     if m)
+    if tier_flags > 1 or (args.all_tiers and tier_flags):
+        print("graftlint: --ir, --flow, --mem and --merge are separate "
+              "analysis tiers; run them as separate invocations (or use "
+              "--all for every tier at once)", file=sys.stderr)
         return 2
     if args.ir and args.paths:
         print("graftlint: --ir lints the kernel manifest; do not pass "
               "paths (run the two modes as two invocations)",
               file=sys.stderr)
         return 2
-    if not args.ir and not args.flow and not args.mem and not args.paths:
-        print("graftlint: pass paths to lint, or --ir / --flow / --mem "
-              "for the manifest audits", file=sys.stderr)
+    if not args.all_tiers and not tier_flags and not args.paths:
+        print("graftlint: pass paths to lint, or --ir / --flow / --mem / "
+              "--merge for the manifest audits (or --all for every tier)",
+              file=sys.stderr)
         return 2
 
     if args.ir:
@@ -167,6 +331,22 @@ def main(argv: Optional[List[str]] = None) -> int:
                                              MemAuditError, mem_rule_ids,
                                              run_mem)
         known = mem_rule_ids()
+    elif args.merge:
+        # the shard-merge/resume audit drives real fold sinks: same pin
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        from avenir_tpu.analysis.merge import (ALL_MERGE_RULES,
+                                               MERGE_AUDIT_RULE,
+                                               MergeAuditError,
+                                               merge_rule_ids, run_merge)
+        known = merge_rule_ids()
+    elif args.all_tiers:
+        from avenir_tpu.analysis.flow import flow_rule_ids
+        from avenir_tpu.analysis.mem import mem_rule_ids
+        from avenir_tpu.analysis.merge import merge_rule_ids
+        # ir_rule_ids needs no jax; import via the module like the rest
+        from avenir_tpu.analysis.ir import ir_rule_ids
+        known = (rule_ids() + ir_rule_ids() + flow_rule_ids()
+                 + mem_rule_ids() + merge_rule_ids())
     else:
         known = rule_ids()
 
@@ -187,6 +367,9 @@ def main(argv: Optional[List[str]] = None) -> int:
     except ValueError as e:
         print(f"graftlint: {e}", file=sys.stderr)
         return 2
+
+    if args.all_tiers:
+        return _run_all(args, baseline, wanted)
 
     if args.ir:
         from avenir_tpu.analysis.ir import PAYLOAD_RULE
@@ -228,6 +411,21 @@ def main(argv: Optional[List[str]] = None) -> int:
         except OSError as e:
             print(f"graftlint: cannot read input: {e}", file=sys.stderr)
             return 2
+    elif args.merge:
+        merge_rules = ([r() for r in ALL_MERGE_RULES] if wanted is None
+                       else [r() for r in ALL_MERGE_RULES
+                             if r.rule_id in wanted])
+        audit = wanted is None or MERGE_AUDIT_RULE in wanted
+        try:
+            report = run_merge(paths=args.paths or None, rules=merge_rules,
+                               baseline=baseline, root=_report_root(args),
+                               include_md=not args.no_md, audit=audit)
+        except MergeAuditError as e:
+            print(f"graftlint: merge audit error: {e}", file=sys.stderr)
+            return 2
+        except OSError as e:
+            print(f"graftlint: cannot read input: {e}", file=sys.stderr)
+            return 2
     else:
         rules = (None if wanted is None
                  else [r() for r in ALL_RULES if r.rule_id in wanted])
@@ -242,42 +440,9 @@ def main(argv: Optional[List[str]] = None) -> int:
     if args.as_json:
         print(json.dumps(report.to_json(), indent=1))
     else:
-        for f in report.errors + report.findings:
-            print(f.render())
-        for e in report.stale:
-            print(f"stale baseline entry (line {e.lineno}): {e.key} — the "
-                  f"finding it excused is gone; delete it", file=sys.stderr)
-        unit = "kernel modules" if args.ir else "files"
-        tail = ""
-        if report.payload_audit:
-            ok = sum(1 for a in report.payload_audit
-                     if a["payload_model_validated"])
-            tail = (f", payload audit {ok}/{len(report.payload_audit)} "
-                    f"families validated")
-        if report.invariance_audit:
-            ok = sum(1 for a in report.invariance_audit
-                     if a["invariance_validated"])
-            tail += (f", chunk-invariance audit {ok}/"
-                     f"{len(report.invariance_audit)} stream kernels "
-                     f"validated")
-        if report.footprint_audit:
-            ok = sum(1 for a in report.footprint_audit
-                     if a["footprint_model_validated"])
-            tail += (f", footprint audit {ok}/"
-                     f"{len(report.footprint_audit)} streamed jobs "
-                     f"validated")
-        print(f"graftlint: {len(report.scanned)} {unit}, "
-              f"{len(report.findings)} finding(s), "
-              f"{len(report.suppressed)} allowlisted, "
-              f"{len(report.stale)} stale baseline entr(y/ies)"
-              + (f", {len(report.errors)} parse error(s)"
-                 if report.errors else "") + tail)
+        _print_report(report, is_ir=args.ir)
 
-    if report.findings or report.errors:
-        return 1
-    if report.stale and not args.allow_stale:
-        return 1
-    return 0
+    return _exit_code(report, args)
 
 
 if __name__ == "__main__":

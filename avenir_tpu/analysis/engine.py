@@ -75,9 +75,12 @@ class Report:
     (analysis/flow.py): one entry per streamed fold kernel with its
     chunk-layout/scheduler byte-identity verdict. `footprint_audit` is
     filled only by mem runs (analysis/mem.py): one entry per streamed
-    job with its measured-RSS-vs-analytic-footprint verdict. Other
-    modes leave them empty — the keys are always present in the JSON so
-    downstream tripwires can parse one schema."""
+    job with its measured-RSS-vs-analytic-footprint verdict.
+    `merge_audit` is filled only by merge runs (analysis/merge.py): one
+    entry per streamed fold kernel with its shard-merge/checkpoint-
+    resume byte-identity verdict. Other modes leave them empty — the
+    keys are always present in the JSON so downstream tripwires can
+    parse one schema."""
 
     findings: List[Finding] = field(default_factory=list)
     suppressed: List[Finding] = field(default_factory=list)
@@ -87,6 +90,7 @@ class Report:
     payload_audit: List[dict] = field(default_factory=list)
     invariance_audit: List[dict] = field(default_factory=list)
     footprint_audit: List[dict] = field(default_factory=list)
+    merge_audit: List[dict] = field(default_factory=list)
 
     def counts(self) -> Dict[str, int]:
         out: Dict[str, int] = {}
@@ -109,6 +113,7 @@ class Report:
             "payload_audit": self.payload_audit,
             "invariance_audit": self.invariance_audit,
             "footprint_audit": self.footprint_audit,
+            "merge_audit": self.merge_audit,
             "clean": self.clean,
         }
 

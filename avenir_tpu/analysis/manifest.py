@@ -312,7 +312,14 @@ class StreamKernelSpec:
     ``jobs`` names the registered runner job(s) the spec drives (several
     for the fused shared-scan entries): the memory auditor
     (analysis/mem.py) keys its per-job analytic footprint model on
-    them, so every stream entry is memory-auditable by construction."""
+    them, so every stream entry is memory-auditable by construction.
+
+    ``fold_specs`` carries the same jobs as ``(job, prefix, conf)``
+    triples (conf values may hold ``{schema}``-style ctx placeholders,
+    formatted exactly like ``_job_runner`` does): the shard-merge/
+    resume auditor (analysis/merge.py) drives each job's REGISTERED
+    fold sink (runner.stream_fold_ops) directly with them, so every
+    stream entry is merge-auditable by construction too."""
 
     name: str
     path: str                     # repo-relative module of the fold kernel
@@ -321,6 +328,7 @@ class StreamKernelSpec:
     run: Callable                 # (ctx, block_mb) -> bytes
     layouts: Tuple[float, ...] = (64.0, 0.002, 0.0005)
     jobs: Tuple[str, ...] = ()
+    fold_specs: Tuple[Tuple[str, str, dict], ...] = ()
 
 
 def _job_runner(job: str, prefix: str, conf: dict, inputs_key: str = "csv"):
@@ -431,48 +439,60 @@ def stream_entries() -> List[StreamKernelSpec]:
     from avenir_tpu.models.naive_bayes import NaiveBayesModel
     from avenir_tpu.models.sequence import GSPMiner
 
-    def spec(name, ref, prepare, run, jobs=()):
+    def spec(name, ref, prepare, run, fold_specs):
         path, line = _loc(ref)
-        return StreamKernelSpec(name, path, line, prepare, run,
-                                jobs=tuple(jobs))
+        return StreamKernelSpec(
+            name, path, line, prepare, run,
+            jobs=tuple(job for job, _prefix, _conf in fold_specs),
+            fold_specs=tuple((job, prefix, dict(conf))
+                            for job, prefix, conf in fold_specs))
 
     schema_conf = lambda prefix: {
         f"{prefix}.feature.schema.file.path": "{schema}"}
+    # ONE definition of each job's audit config, shared by the runner
+    # closures (chunk-invariance / footprint audits) and the fold_specs
+    # (shard-merge/resume audit) so the tiers can never drift apart
+    nb_spec = ("bayesianDistr", "bad", schema_conf("bad"))
+    mi_spec = ("mutualInformation", "mut", {
+        **schema_conf("mut"),
+        "mut.mutual.info.score.algorithms":
+            "mutual.info.maximization,min.redundancy.max.relevance",
+    })
+    fid_spec = ("fisherDiscriminant", "fid", schema_conf("fid"))
+    mst_spec = ("markovStateTransitionModel", "mst", {
+        "mst.model.states": "L,M,H",
+        "mst.class.label.field.ord": "1",
+        "mst.skip.field.count": "2",
+        "mst.class.labels": "T,F",
+    })
+    fia_spec = ("frequentItemsApriori", "fia", {
+        "fia.support.threshold": "0.3",
+        "fia.item.set.length": "2",
+        "fia.skip.field.count": "2",
+    })
+    cgs_spec = ("candidateGenerationWithSelfJoin", "cgs", {
+        "cgs.support.threshold": "0.3",
+        "cgs.item.set.length": "2",
+        "cgs.skip.field.count": "2",
+    })
+
+    def solo(name, ref, prepare, job_spec):
+        job, prefix, conf = job_spec
+        return spec(name, ref, prepare, _job_runner(job, prefix, conf),
+                    [job_spec])
+
     return [
-        spec("nb_stream", NaiveBayesModel.accumulate, _churn_corpus,
-             _job_runner("bayesianDistr", "bad", schema_conf("bad")),
-             jobs=("bayesianDistr",)),
-        spec("mi_stream", MutualInformationAnalyzer.add, _churn_corpus,
-             _job_runner("mutualInformation", "mut", {
-                 **schema_conf("mut"),
-                 "mut.mutual.info.score.algorithms":
-                     "mutual.info.maximization,min.redundancy.max.relevance",
-             }), jobs=("mutualInformation",)),
-        spec("discriminant_stream", FisherDiscriminant.accumulate,
-             _churn_corpus,
-             _job_runner("fisherDiscriminant", "fid", schema_conf("fid")),
-             jobs=("fisherDiscriminant",)),
-        spec("markov_stream", MarkovStateTransitionModel.fit_csr,
-             _seq_corpus,
-             _job_runner("markovStateTransitionModel", "mst", {
-                 "mst.model.states": "L,M,H",
-                 "mst.class.label.field.ord": "1",
-                 "mst.skip.field.count": "2",
-                 "mst.class.labels": "T,F",
-             }), jobs=("markovStateTransitionModel",)),
-        spec("apriori_stream", FrequentItemsApriori.mine_stream,
-             _seq_corpus,
-             _job_runner("frequentItemsApriori", "fia", {
-                 "fia.support.threshold": "0.3",
-                 "fia.item.set.length": "2",
-                 "fia.skip.field.count": "2",
-             }), jobs=("frequentItemsApriori",)),
-        spec("gsp_stream", GSPMiner.mine_stream, _seq_corpus,
-             _job_runner("candidateGenerationWithSelfJoin", "cgs", {
-                 "cgs.support.threshold": "0.3",
-                 "cgs.item.set.length": "2",
-                 "cgs.skip.field.count": "2",
-             }), jobs=("candidateGenerationWithSelfJoin",)),
+        solo("nb_stream", NaiveBayesModel.accumulate, _churn_corpus,
+             nb_spec),
+        solo("mi_stream", MutualInformationAnalyzer.add, _churn_corpus,
+             mi_spec),
+        solo("discriminant_stream", FisherDiscriminant.accumulate,
+             _churn_corpus, fid_spec),
+        solo("markov_stream", MarkovStateTransitionModel.fit_csr,
+             _seq_corpus, mst_spec),
+        solo("apriori_stream", FrequentItemsApriori.mine_stream,
+             _seq_corpus, fia_spec),
+        solo("gsp_stream", GSPMiner.mine_stream, _seq_corpus, cgs_spec),
         # fused shared-scan entries: the SAME jobs through the
         # scan-sharing executor (ONE read + parse, N fold sinks). The
         # auditor re-proves every round that fan-out changes nothing —
@@ -480,33 +500,11 @@ def stream_entries() -> List[StreamKernelSpec]:
         # and the adversarial prefetch scheduler, exactly like the
         # one-job-one-scan entries above.
         spec("shared_churn_stream", SharedScan.run, _churn_corpus,
-             _shared_runner([
-                 ("bayesianDistr", "bad", schema_conf("bad")),
-                 ("mutualInformation", "mut", {
-                     **schema_conf("mut"),
-                     "mut.mutual.info.score.algorithms":
-                         "mutual.info.maximization,"
-                         "min.redundancy.max.relevance",
-                 }),
-                 ("fisherDiscriminant", "fid", schema_conf("fid")),
-             ]),
-             jobs=("bayesianDistr", "mutualInformation",
-                   "fisherDiscriminant")),
+             _shared_runner([nb_spec, mi_spec, fid_spec]),
+             [nb_spec, mi_spec, fid_spec]),
         spec("shared_seq_stream", SharedScan.run, _seq_corpus,
-             _shared_runner([
-                 ("markovStateTransitionModel", "mst", {
-                     "mst.model.states": "L,M,H",
-                     "mst.class.label.field.ord": "1",
-                     "mst.skip.field.count": "2",
-                     "mst.class.labels": "T,F",
-                 }),
-                 ("frequentItemsApriori", "fia", {
-                     "fia.support.threshold": "0.3",
-                     "fia.item.set.length": "2",
-                     "fia.skip.field.count": "2",
-                 }),
-             ]),
-             jobs=("markovStateTransitionModel", "frequentItemsApriori")),
+             _shared_runner([mst_spec, fia_spec]),
+             [mst_spec, fia_spec]),
     ]
 
 

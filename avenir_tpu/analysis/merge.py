@@ -1,0 +1,648 @@
+"""graftlint-merge: fold-state merge-algebra analysis of the streamed
+jobs, plus the mechanical shard-merge/resume auditor.
+
+The flow tier proves streamed folds *deterministic* under re-chunking;
+the mem tier proves them *admissible*. Nothing yet proves the property
+the two heaviest ROADMAP items — incremental/resumable analytics and
+multi-host sharded streaming with straggler tolerance — both reduce to:
+that every streamed job's fold state is a *mergeable, serializable*
+algebra, i.e. ``merge(fold(shard_A), fold(shard_B)) == fold(A ++ B)``
+byte-identically, and a mid-scan carry can be checkpointed and resumed
+to the same bytes. MapReduce systems got this for free from the
+combiner/reducer contract (arXiv:1801.09802); redundant-work straggler
+designs (arXiv:1802.03049) additionally need to know whether
+*overlapping* shard results merge idempotently. This tier checks all of
+it mechanically, every round.
+
+Two layers, mirroring the proven ir/flow/mem split:
+
+- **Merge rules** — structural shapes over fold-SINK classes (a class
+  defining both ``consume`` and ``finish``, the shared-scan sink
+  protocol): a sink with no merge op at all (``merge-missing-op``), a
+  float accumulator in a carry whose merge would reorder summands
+  (``merge-order-sensitive-float``), a carry mutated in place while
+  also aliased into a cache/closure so a restored checkpoint reads
+  stale state (``merge-inplace-aliased-state``), and a carry holding
+  threads/open files/generators with no declared host round-trip
+  (``merge-unserializable-carry``).
+- **Shard-merge/resume auditor** — for every streamed fold kernel in
+  the manifest (``stream_entries()``, solo AND fused): (a) split the
+  proxy corpus on block boundaries into P ∈ {2, 4} shards, fold each
+  shard independently through the job's REGISTERED fold sink
+  (``runner.stream_fold_ops``), merge via ``merge_states``, and assert
+  the finished artifacts byte-identical to a cold full scan through
+  the real runner; (b) checkpoint mid-scan — ``serialize_state`` the
+  carry after ~half the chunks, ``restore_state`` into a fresh fold,
+  finish, and assert byte-identity again; (c) an overlap probe that
+  re-folds one boundary block into a shard and records whether the
+  merge absorbed it (idempotent/dedup) or the family is
+  non-idempotent — the contract straggler/redundant-work scan designs
+  must consult before double-computing a block.
+
+Findings flow through the shared engine (same ``path::rule::scope``
+keys, same allowlist baseline); entry points: ``graftlint --merge``
+(analysis/cli.py) or :func:`run_merge` in-process. A stream kernel that
+fails to RUN raises :class:`MergeAuditError` — the CLI maps that to
+exit code 2; a merge or resume that drifts a byte is a finding under
+``merge-fold-algebra`` (exit 1): fix the fold's algebra, never
+allowlist the drift.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import shutil
+import tempfile
+from typing import Dict, Iterator, List, Optional, Sequence, Set, Tuple
+
+from avenir_tpu.analysis.engine import (BaselineEntry, Finding, ModuleContext,
+                                        Report, apply_baseline,
+                                        collect_findings)
+from avenir_tpu.analysis.flow import (OrderSensitiveFoldRule, _MUTATORS,
+                                      default_flow_paths)
+from avenir_tpu.analysis.mem import _bind_key
+
+#: the audit's pseudo-rule id: a shard merge or checkpoint resume whose
+#: output drifted a byte surfaces as a finding under it (never allowlist
+#: one — a fold state that is not a merge algebra blocks both the
+#: resumable-scan and the multi-host streaming work)
+MERGE_AUDIT_RULE = "merge-fold-algebra"
+
+#: block size (MB) the auditor shards and checkpoints at: small enough
+#: that both proxy corpora cut into well over 4 blocks, so P=4 shards
+#: and the mid-scan checkpoint all land on real boundaries
+AUDIT_BLOCK_MB = 0.001
+
+#: shard counts the merge is proven at; 2 exercises one merge, 4
+#: exercises merge chaining (associativity of the registered op)
+AUDIT_SHARDS = (2, 4)
+
+#: the fold-sink protocol: a class with both methods is a shared-scan
+#: sink (runner._STREAM_FOLDS registers them; SharedScan fans to them)
+_SINK_METHODS = {"consume", "finish"}
+
+#: method names that count as a declared merge op on a sink class
+_MERGE_METHODS = {"merge", "merge_states", "merge_from"}
+
+#: method names that count as a declared host round-trip for the
+#: unserializable-carry rule
+_ROUNDTRIP_METHODS = {"state_dict", "load_state", "serialize_state",
+                      "__getstate__"}
+
+#: constructors whose result cannot cross a serialize/restore boundary
+_UNSERIALIZABLE_CTORS = {
+    "open", "iter",
+    "threading.Thread", "threading.Lock", "threading.RLock",
+    "threading.Condition", "threading.Semaphore",
+    "threading.BoundedSemaphore", "threading.Event",
+    "subprocess.Popen", "socket.socket", "socket.create_connection",
+}
+
+#: shared float-init recognizer (the flow tier's, applied to carries)
+_FLOAT_INIT = OrderSensitiveFoldRule()
+
+
+class MergeAuditError(RuntimeError):
+    """A streamed fold kernel could not be prepared, driven or merged."""
+
+
+# --------------------------------------------------------------------------
+# shared AST helpers
+# --------------------------------------------------------------------------
+def _methods_of(node: ast.ClassDef) -> Dict[str, ast.FunctionDef]:
+    return {m.name: m for m in node.body
+            if isinstance(m, (ast.FunctionDef, ast.AsyncFunctionDef))}
+
+
+def _fold_sink_classes(ctx: ModuleContext
+                       ) -> Iterator[Tuple[ast.ClassDef,
+                                           Dict[str, ast.FunctionDef]]]:
+    """Classes implementing the fold-sink protocol (consume + finish) —
+    the carries whose merge algebra this tier judges."""
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.ClassDef):
+            methods = _methods_of(node)
+            if _SINK_METHODS <= set(methods):
+                yield node, methods
+
+
+def _method_nodes(fn: ast.AST) -> Iterator[ast.AST]:
+    """Nodes of a method body, not descending into nested defs."""
+    stack = list(ast.iter_child_nodes(fn))
+    while stack:
+        node = stack.pop()
+        yield node
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.Lambda)):
+            stack.extend(ast.iter_child_nodes(node))
+
+
+def _self_attr(node: ast.AST) -> Optional[str]:
+    """`attr` when `node` is a ``self.attr`` expression, else None."""
+    key = _bind_key(node)
+    return key[1:] if key is not None and key.startswith(".") else None
+
+
+# --------------------------------------------------------------------------
+# rules
+# --------------------------------------------------------------------------
+class MergeRule:
+    rule_id: str = ""
+    description: str = ""
+    hint: str = ""
+
+    def check(self, ctx: ModuleContext) -> Iterator[Finding]:
+        raise NotImplementedError
+
+    def finding(self, ctx: ModuleContext, node: ast.AST, message: str,
+                hint: Optional[str] = None) -> Finding:
+        return Finding(ctx.path, getattr(node, "lineno", 1), self.rule_id,
+                       message, hint or self.hint, ctx.scope_of(node))
+
+
+class MergeMissingOpRule(MergeRule):
+    """A fold-sink class (defines both ``consume`` and ``finish``) with
+    no declared merge op (no ``merge``/``merge_states``/``merge_from``
+    method). Its carry can be folded but never combined: the job cannot
+    shard across hosts, cannot fold an appended delta into a saved
+    carry, and cannot survive the redundant-work straggler designs —
+    every path the ROADMAP's two heaviest items need. Every sink in
+    ``runner._STREAM_FOLDS`` carries one by construction."""
+
+    rule_id = "merge-missing-op"
+    description = "fold sink has no registered merge/serialize op"
+    hint = ("implement `merge(other)` as an additive combine of the "
+            "sufficient statistic (the NaiveBayesModel.merge pattern; "
+            "miners use models.association.merge_support_counts), or "
+            "allowlist only a sink whose state provably merges at "
+            "another level (say which)")
+
+    def check(self, ctx: ModuleContext) -> Iterator[Finding]:
+        for node, methods in _fold_sink_classes(ctx):
+            if _MERGE_METHODS & set(methods):
+                continue
+            yield self.finding(
+                ctx, node,
+                f"fold sink `{node.name}` (consume + finish) declares no "
+                f"merge op: its carry cannot combine across shards or "
+                f"resume from a checkpoint")
+
+
+class MergeOrderSensitiveFloatRule(MergeRule):
+    """A fold-sink carry accumulating NON-integer floats: an attribute
+    initialized to a float in ``__init__`` and ``+=``-folded in
+    ``consume`` (or in the merge op itself). ``merge(A, B)`` computes
+    ``(a1+...+an) + (b1+...+bm)`` — a different summation tree than the
+    in-order fold — so float reassociation makes the merged result
+    drift from ``fold(A++B)`` in the last bits, and the shard-merge
+    audit's byte-identity is unprovable. Integer-dtype carries (and
+    integer-valued float64 counts, the repo's standard) are exact under
+    any grouping and stay silent."""
+
+    rule_id = "merge-order-sensitive-float"
+    description = "float accumulation in a carry whose merge reorders summands"
+    hint = ("carry exact values (integer dtypes, or integer-valued "
+            "float64 counts within the documented exactness bound — see "
+            "NaiveBayesModel._FLUSH_ROWS), or use a compensated/"
+            "fixed-order reduction and register the kernel's tolerance "
+            "explicitly instead of claiming byte-identity")
+
+    _FOLD_METHODS = ("consume",) + tuple(sorted(_MERGE_METHODS))
+
+    def _float_attr_inits(self, ctx: ModuleContext,
+                          init: ast.FunctionDef) -> Set[str]:
+        out: Set[str] = set()
+        for node in _method_nodes(init):
+            if not isinstance(node, ast.Assign) or len(node.targets) != 1:
+                continue
+            attr = _self_attr(node.targets[0])
+            if attr is None:
+                continue
+            if _FLOAT_INIT._is_float_init(ctx, node.value):
+                out.add(attr)
+        return out
+
+    def check(self, ctx: ModuleContext) -> Iterator[Finding]:
+        for cls, methods in _fold_sink_classes(ctx):
+            init = methods.get("__init__")
+            if init is None:
+                continue
+            floats = self._float_attr_inits(ctx, init)
+            if not floats:
+                continue
+            seen: Set[str] = set()
+            for mname in self._FOLD_METHODS:
+                fn = methods.get(mname)
+                if fn is None:
+                    continue
+                for node in _method_nodes(fn):
+                    attr: Optional[str] = None
+                    if isinstance(node, ast.AugAssign) \
+                            and isinstance(node.op, ast.Add):
+                        attr = _self_attr(node.target)
+                    elif isinstance(node, ast.Assign) \
+                            and len(node.targets) == 1 \
+                            and isinstance(node.value, ast.BinOp) \
+                            and isinstance(node.value.op, ast.Add):
+                        tgt = _self_attr(node.targets[0])
+                        left = _self_attr(node.value.left)
+                        if tgt is not None and tgt == left:
+                            attr = tgt
+                    if attr in floats and attr not in seen:
+                        seen.add(attr)
+                        yield self.finding(
+                            ctx, node,
+                            f"float carry `self.{attr}` accumulates in "
+                            f"`{cls.name}.{mname}`: a shard merge "
+                            f"re-groups its summands, so merged output "
+                            f"drifts from the in-order fold's bytes")
+
+
+class MergeInplaceAliasedStateRule(MergeRule):
+    """A fold-sink carry mutated IN PLACE while also aliased outside the
+    sink — stored into a module/cache container or captured by a nested
+    function. After ``restore_state`` builds a fresh carry, the alias
+    still points at the pre-checkpoint object: the cache serves stale
+    state and the closure mutates an orphan. Reassignment
+    (``self.x = self.x + d``) rebinds instead of mutating and stays
+    silent, as does state that never escapes the sink."""
+
+    rule_id = "merge-inplace-aliased-state"
+    description = "carry mutated in place while aliased by a cache/closure"
+    hint = ("keep the carry private to the sink (hand copies outward), "
+            "or rebind on every fold (`self.x = self.x + d`) so an old "
+            "alias can never observe post-checkpoint mutation")
+
+    def _inplace_attrs(self, methods) -> Dict[str, ast.AST]:
+        out: Dict[str, ast.AST] = {}
+        for fn in methods.values():
+            for node in _method_nodes(fn):
+                attr: Optional[str] = None
+                if isinstance(node, ast.AugAssign):
+                    attr = _self_attr(node.target)
+                elif isinstance(node, ast.Call) \
+                        and isinstance(node.func, ast.Attribute) \
+                        and node.func.attr in _MUTATORS:
+                    attr = _self_attr(node.func.value)
+                if attr is not None and attr not in out:
+                    out[attr] = node
+        return out
+
+    def _escaped_attrs(self, methods) -> Set[str]:
+        out: Set[str] = set()
+        for fn in methods.values():
+            for node in _method_nodes(fn):
+                # CACHE[key] = self.attr — stored into a container that
+                # is not the sink's own attribute
+                if isinstance(node, ast.Assign):
+                    attr = _self_attr(node.value)
+                    if attr is not None and any(
+                            isinstance(t, ast.Subscript)
+                            and _self_attr(t.value) is None
+                            for t in node.targets):
+                        out.add(attr)
+                # self.attr captured by a nested def/lambda
+                if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                     ast.Lambda)):
+                    for sub in ast.walk(node):
+                        attr = _self_attr(sub)
+                        if attr is not None:
+                            out.add(attr)
+        return out
+
+    def check(self, ctx: ModuleContext) -> Iterator[Finding]:
+        for cls, methods in _fold_sink_classes(ctx):
+            inplace = self._inplace_attrs(methods)
+            escaped = self._escaped_attrs(methods)
+            for attr in sorted(set(inplace) & escaped):
+                yield self.finding(
+                    ctx, inplace[attr],
+                    f"carry `self.{attr}` of `{cls.name}` is mutated in "
+                    f"place AND aliased outside the sink: a restored "
+                    f"checkpoint leaves the alias pointing at stale "
+                    f"pre-checkpoint state")
+
+
+class MergeUnserializableCarryRule(MergeRule):
+    """A fold-sink carry binding resources that cannot cross a
+    serialize/restore boundary — open files, threads, processes,
+    sockets, locks, or live generators/iterators — in a class that
+    declares no host round-trip (``state_dict``/``load_state``/
+    ``serialize_state``/``__getstate__``). Checkpointing such a sink
+    either fails outright or silently drops the resource's position.
+    A sink that DOES declare the round-trip owns the problem (its
+    state_dict must re-derive the resource) and stays silent."""
+
+    rule_id = "merge-unserializable-carry"
+    description = "carry holds threads/files/generators with no round-trip"
+    hint = ("carry plain data (paths, offsets, count arrays) and "
+            "re-open/re-derive the resource after restore, or declare "
+            "the round-trip by implementing state_dict()/load_state() "
+            "so the checkpoint contract is explicit")
+
+    def _bad_value(self, ctx: ModuleContext, value: ast.AST
+                   ) -> Optional[str]:
+        if isinstance(value, ast.GeneratorExp):
+            return "a live generator"
+        if isinstance(value, ast.Call):
+            name = ctx.dotted(value.func)
+            if name in _UNSERIALIZABLE_CTORS:
+                return f"`{name}(...)`"
+        return None
+
+    def check(self, ctx: ModuleContext) -> Iterator[Finding]:
+        for cls, methods in _fold_sink_classes(ctx):
+            if _ROUNDTRIP_METHODS & set(methods):
+                continue
+            for fn in methods.values():
+                for node in _method_nodes(fn):
+                    if not isinstance(node, ast.Assign) \
+                            or len(node.targets) != 1:
+                        continue
+                    attr = _self_attr(node.targets[0])
+                    if attr is None:
+                        continue
+                    what = self._bad_value(ctx, node.value)
+                    if what is not None:
+                        yield self.finding(
+                            ctx, node,
+                            f"carry `self.{attr}` of `{cls.name}` holds "
+                            f"{what}: it cannot cross a checkpoint "
+                            f"serialize/restore boundary and no host "
+                            f"round-trip is declared")
+
+
+ALL_MERGE_RULES = [MergeMissingOpRule, MergeOrderSensitiveFloatRule,
+                   MergeInplaceAliasedStateRule,
+                   MergeUnserializableCarryRule]
+
+
+def merge_rule_ids() -> List[str]:
+    return [r.rule_id for r in ALL_MERGE_RULES] + [MERGE_AUDIT_RULE]
+
+
+# --------------------------------------------------------------------------
+# shard-merge / resume auditor
+# --------------------------------------------------------------------------
+def _job_contexts(spec, ctx: dict, block_mb: float) -> List[tuple]:
+    """[(job, cfg, ops)] for every fold the spec registers, conf values
+    formatted against the prepared corpus ctx exactly like
+    manifest._job_runner does."""
+    from avenir_tpu.runner import _job_cfg, stream_fold_ops
+
+    if not getattr(spec, "fold_specs", ()):
+        raise MergeAuditError(
+            f"{spec.name}: stream entry carries no fold_specs; the "
+            f"merge auditor drives registered fold sinks directly")
+    out = []
+    for job, prefix, conf in spec.fold_specs:
+        props = {k: (v.format(**ctx) if isinstance(v, str) else v)
+                 for k, v in conf.items()}
+        props[f"{prefix}.stream.block.size.mb"] = repr(float(block_mb))
+        canonical, _prefix, cfg = _job_cfg(job, props)
+        out.append((canonical, cfg, stream_fold_ops(canonical)))
+    kinds = {ops.kind for _j, _c, ops in out}
+    if len(kinds) != 1:
+        raise MergeAuditError(f"{spec.name}: mixed fold kinds {kinds}")
+    return out
+
+
+def _load_schema(ctx: dict):
+    if "schema" not in ctx:
+        return None
+    from avenir_tpu.core.schema import FeatureSchema
+
+    return FeatureSchema.from_file(ctx["schema"])
+
+
+def _chunk_list(kind: str, cfg, paths: Sequence[str], schema) -> list:
+    """The REAL runner chunk feed (stream_job_inputs /
+    stream_job_byte_blocks), materialized — the audit corpora are a few
+    tens of KB, and the checkpoint split needs random access."""
+    from avenir_tpu.core.stream import (stream_job_byte_blocks,
+                                        stream_job_inputs)
+
+    if kind == "dataset":
+        return list(stream_job_inputs(cfg, list(paths), schema))
+    return list(stream_job_byte_blocks(cfg, list(paths)))
+
+
+def _drive(jobs_ctx: List[tuple], paths: Sequence[str], schema) -> list:
+    """Build every job's registered fold sink over `paths` and drive
+    them through ONE SharedScan of the real chunk feed — the exact
+    fan-out the fused runner uses — returning the fed folds."""
+    from avenir_tpu.core.stream import SharedScan
+
+    kind = jobs_ctx[0][2].kind
+    folds = [ops.factory(cfg, list(paths), schema)
+             for _job, cfg, ops in jobs_ctx]
+    chunks = _chunk_list(kind, jobs_ctx[0][1], paths, schema)
+    scan = SharedScan(iter(chunks))
+    for fold in folds:
+        scan.add_sink(fold)
+    scan.run()
+    return folds
+
+
+def _finish_artifact(jobs_ctx: List[tuple], folds: list, out_base: str
+                     ) -> bytes:
+    """finish() every fold and render the same name-tagged artifact the
+    manifest runners produce (job-prefixed tags when the entry fuses
+    multiple jobs), so comparisons against spec.run() baselines are
+    byte-for-byte."""
+    multi = len(jobs_ctx) > 1
+    blobs = []
+    for (job, _cfg, _ops), fold in zip(jobs_ctx, folds):
+        out = f"{out_base}_{job}"
+        res = fold.finish(out)
+        for p in sorted(res.outputs):
+            rel = os.path.relpath(p, out)
+            tag = f"{job}:{rel}" if multi else rel
+            with open(p, "rb") as fh:
+                blobs.append(tag.encode() + b"\0" + fh.read())
+    return b"\n".join(blobs)
+
+
+def _shard_files(workdir: str, blocks: List[bytes], P: int, tag: str,
+                 overlap: bool = False) -> List[str]:
+    """Write P shard files of consecutive block runs covering the corpus
+    exactly once (row-aligned: blocks come from iter_byte_blocks, which
+    cuts at line boundaries). With `overlap`, shard 0 additionally
+    re-contains shard 1's first block — the redundant-work probe."""
+    bounds = [round(i * len(blocks) / P) for i in range(P + 1)]
+    paths = []
+    for i in range(P):
+        part = blocks[bounds[i]:bounds[i + 1]]
+        if overlap and i == 0 and bounds[1] < len(blocks):
+            part = part + [blocks[bounds[1]]]
+        p = os.path.join(workdir, f"shard_{tag}_{P}_{i}.csv")
+        with open(p, "wb") as fh:
+            fh.write(b"".join(part))
+        paths.append(p)
+    return paths
+
+
+def audit_merge(spec, shard_counts: Sequence[int] = AUDIT_SHARDS,
+                block_mb: float = AUDIT_BLOCK_MB
+                ) -> Tuple[dict, Optional[Finding]]:
+    """Prove one stream entry's fold state is a merge algebra: shard
+    folds merge to the cold full scan's bytes at every P, a mid-scan
+    checkpoint resumes to the same bytes, and the overlap probe records
+    the family's idempotency contract. Returns (audit row, finding or
+    None); a kernel that fails to RUN raises :class:`MergeAuditError`."""
+    from avenir_tpu.core.stream import iter_byte_blocks
+
+    workdir = tempfile.mkdtemp(prefix=f"graftlint_merge_{spec.name}_")
+    try:
+        ctx = spec.prepare(workdir)
+        jobs_ctx = _job_contexts(spec, ctx, block_mb)
+        kind = jobs_ctx[0][2].kind
+        baseline = spec.run(ctx, block_mb)
+
+        block_bytes = max(int(block_mb * (1 << 20)), 64)
+        blocks = list(iter_byte_blocks(ctx["csv"], block_bytes))
+        enough = len(blocks) >= max(shard_counts)
+
+        shard_rows: List[dict] = []
+        checkpoint: Optional[dict] = None
+        overlap: Optional[dict] = None
+        if enough:
+            for P in shard_counts:
+                shards = _shard_files(workdir, blocks, P, "m")
+                folds = []
+                for shard in shards:
+                    fed = _drive(jobs_ctx, [shard], _load_schema(ctx))
+                    folds.append(fed)
+                merged = folds[0]
+                for nxt in folds[1:]:
+                    merged = [ops.merge_states(a, b)
+                              for (_j, _c, ops), a, b
+                              in zip(jobs_ctx, merged, nxt)]
+                art = _finish_artifact(
+                    jobs_ctx, merged, os.path.join(workdir, f"merge{P}"))
+                shard_rows.append({
+                    "P": P, "blocks": len(blocks),
+                    "byte_identical": art == baseline,
+                })
+
+            # (b) checkpoint mid-scan: serialize after ~half the chunks,
+            # restore into FRESH folds, finish, compare
+            schema = _load_schema(ctx)
+            chunks = _chunk_list(kind, jobs_ctx[0][1], [ctx["csv"]], schema)
+            half = max(1, len(chunks) // 2)
+            folds = [ops.factory(cfg, [ctx["csv"]], schema)
+                     for _j, cfg, ops in jobs_ctx]
+            for chunk in chunks[:half]:
+                for fold in folds:
+                    fold.consume(chunk)
+            states = [ops.serialize_state(fold)
+                      for (_j, _c, ops), fold in zip(jobs_ctx, folds)]
+            restored = [ops.restore_state(cfg, [ctx["csv"]], blob,
+                                          schema=schema)
+                        for (_j, cfg, ops), blob in zip(jobs_ctx, states)]
+            for chunk in chunks[half:]:
+                for fold in restored:
+                    fold.consume(chunk)
+            ck_art = _finish_artifact(jobs_ctx, restored,
+                                      os.path.join(workdir, "resume"))
+            checkpoint = {
+                "chunks": len(chunks), "checkpoint_after": half,
+                "state_bytes": int(sum(len(b) for b in states)),
+                "byte_identical": ck_art == baseline,
+            }
+
+            # (c) overlap probe: shard 0 re-folds shard 1's first block;
+            # additive count families MUST change their output (the
+            # merge is not idempotent — redundant-work designs have to
+            # dedup at block granularity BEFORE the fold), so the row
+            # records the contract instead of asserting identity
+            shards = _shard_files(workdir, blocks, 2, "o", overlap=True)
+            folds = [_drive(jobs_ctx, [shard], _load_schema(ctx))
+                     for shard in shards]
+            merged = [ops.merge_states(a, b)
+                      for (_j, _c, ops), a, b
+                      in zip(jobs_ctx, folds[0], folds[1])]
+            ov_art = _finish_artifact(jobs_ctx, merged,
+                                      os.path.join(workdir, "overlap"))
+            overlap = {
+                "output_changed": ov_art != baseline,
+                "contract": ("non-idempotent" if ov_art != baseline
+                             else "overlap-insensitive"),
+            }
+    except MergeAuditError:
+        raise
+    except Exception as e:
+        raise MergeAuditError(
+            f"{spec.name}: fold kernel failed to drive/merge: {e!r}") from e
+    finally:
+        shutil.rmtree(workdir, ignore_errors=True)
+
+    ok = enough and all(r["byte_identical"] for r in shard_rows) \
+        and checkpoint is not None and checkpoint["byte_identical"]
+    row = {
+        "kernel": spec.name,
+        "jobs": [j for j, _c, _o in jobs_ctx],
+        "block_mb": float(block_mb),
+        "shards": shard_rows,
+        "checkpoint": checkpoint,
+        "overlap": overlap,
+        "merge_validated": ok,
+    }
+    finding = None
+    if not ok:
+        if not enough:
+            why = (f"corpus cut into only {len(blocks)} blocks at "
+                   f"{block_mb:g}MB — too few for P={max(shard_counts)} "
+                   f"shards (auditor corpus too small)")
+        else:
+            bad = [f"P={r['P']}" for r in shard_rows
+                   if not r["byte_identical"]]
+            if not checkpoint["byte_identical"]:
+                bad.append("checkpoint-resume")
+            why = f"output bytes drifted under: {', '.join(bad)}"
+        finding = Finding(
+            spec.path, spec.line, MERGE_AUDIT_RULE,
+            f"streamed kernel `{spec.name}` is not a merge algebra: {why}",
+            "make the carry an exact additive sufficient statistic with "
+            "a lossless state_dict (see runner.StreamFoldOps); never "
+            "allowlist a merge drift",
+            spec.name)
+    return row, finding
+
+
+# --------------------------------------------------------------------------
+# runner
+# --------------------------------------------------------------------------
+def run_merge(paths: Optional[Sequence[str]] = None,
+              rules: Optional[Sequence[MergeRule]] = None,
+              baseline: Optional[Sequence[BaselineEntry]] = None,
+              root: Optional[str] = None, include_md: bool = True,
+              audit: bool = True, entries: Optional[Sequence] = None,
+              shard_counts: Sequence[int] = AUDIT_SHARDS) -> Report:
+    """Lint `paths` (default: the gated repo surface) with the merge
+    rules, run the shard-merge/resume auditor over the streamed-kernel
+    manifest, and apply the allowlist baseline to both finding sets."""
+    active = list(rules) if rules is not None else \
+        [r() for r in ALL_MERGE_RULES]
+    root = os.path.abspath(root or os.getcwd())
+    scan = list(paths) if paths else default_flow_paths(root)
+    report, raw = collect_findings(scan, active, root, include_md)
+    if audit:
+        specs = list(entries) if entries is not None else None
+        if specs is None:
+            from avenir_tpu.analysis.manifest import stream_entries
+            specs = stream_entries()
+        for spec in specs:
+            # NOT added to report.scanned — same reasoning as the other
+            # audit tiers: the audit drives the kernel, it does not lint
+            # its file
+            row, finding = audit_merge(spec, shard_counts=shard_counts)
+            report.merge_audit.append(row)
+            if finding is not None:
+                raw.append(finding)
+    active_ids = {r.rule_id for r in active}
+    if audit:
+        active_ids.add(MERGE_AUDIT_RULE)
+    apply_baseline(report, raw, baseline, active_ids)
+    return report

@@ -44,6 +44,27 @@ from avenir_tpu.native.ingest import SpillScanMixin
 # --------------------------------------------------------------------------
 # Transaction ingest
 # --------------------------------------------------------------------------
+def merge_support_counts(*states: "Dict") -> "Dict":
+    """The miners' support-merge rule (the ROADMAP open question): sum
+    per-candidate support counts keyed by candidate identity across
+    shard states. Candidates are keyed canonically (token or
+    sorted-token tuples), NOT by per-shard masked ids — shard sources
+    discover vocabularies in data order, so only token-space keys align
+    across shards. int32-safe by construction: per-shard device folds
+    carry int32 counts, and this merge accumulates them as unbounded
+    Python ints, so P shards each near the int32 ceiling can never wrap
+    the merged total. A candidate absent from a shard simply contributes
+    nothing (support 0 there). This is the reducer half of the
+    MapReduce combiner/reducer contract (arXiv:1801.09802) the sharded
+    mining drivers — and the straggler/redundant-work designs of
+    arXiv:1802.03049 — are built on."""
+    out: Dict = {}
+    for state in states:
+        for cand, cnt in state.items():
+            out[cand] = out.get(cand, 0) + int(cnt)
+    return out
+
+
 class TransactionSet:
     """Dictionary-encoded transactions: multi-hot uint8 [N, V] + id column.
 
@@ -260,6 +281,21 @@ class StreamingTransactionSource(SpillScanMixin):
         if self._kept_ids is None:
             return self.vocab[masked_id]
         return self.vocab[int(self._kept_ids[masked_id])]
+
+    def token_code(self, tok: str) -> int:
+        """Candidate-encoding lookup in the packed_chunks() id space
+        (masked when a mask is installed); -2 marks a token this source
+        never saw / masked out — its candidates count 0 here. Mirrors
+        StreamingSequenceSource.token_code so the sharded mining driver
+        translates canonical token-space candidates per shard."""
+        i = self.index.get(tok)
+        if i is None:
+            return -2
+        if self._remap is not None:
+            i = int(self._remap[i])
+            if i < 0:
+                return -2
+        return i
 
     def _apply_mask(self, r: np.ndarray, c: np.ndarray):
         if self._remap is None:
@@ -592,10 +628,6 @@ class FrequentItemsApriori:
         dispatch asynchronously with one host pull at the end. Per-k
         re-scans replay the pass-1 encoded-block cache when the sources
         are unchanged (see EncodedBlockCache) instead of re-parsing."""
-        from avenir_tpu.core.stream import double_buffered
-        from avenir_tpu.ops.bitset import (bitset_fold_counts,
-                                           pack_index_rows_u32)
-
         vocab, col_counts, n = src.scan_items()
         min_count = self.support_threshold * n
 
@@ -617,12 +649,7 @@ class FrequentItemsApriori:
             # pad the candidate axis to a bucket size so recurring rounds
             # reuse the compiled executable; zero candidate rows count 0
             c_pad = max(64, 1 << (len(cands) - 1).bit_length())
-            cand_d = jnp.asarray(pack_index_rows_u32(cands, vm, c_pad))
-            counts_d = jnp.zeros(c_pad, jnp.int32)
-            for packed in double_buffered(src.packed_chunks(self.block)):
-                counts_d = bitset_fold_counts(
-                    counts_d, jnp.asarray(packed), cand_d)
-            counts = np.asarray(counts_d, np.int64)
+            counts = self._stream_support(src, cands, c_pad)
             kept = [(c, int(cnt)) for c, cnt in zip(cands, counts[:len(cands)])
                     if cnt > min_count]
             if not kept:
@@ -640,6 +667,130 @@ class FrequentItemsApriori:
                 tids[at:at + len(ids_k)] if tids is not None else None))
             at += len(ids_k)
         return out
+
+    def _stream_support(self, src: StreamingTransactionSource,
+                        cand_ids: List[Tuple[int, ...]], c_pad: int
+                        ) -> np.ndarray:
+        """One streamed support pass over ONE source: candidates (masked
+        item-id tuples in `src`'s id space) packed into a [c_pad, words]
+        bitset matrix, blocks double-buffered against the donated int32
+        device fold. The SINGLE implementation of the N-proportional
+        counting, shared by mine_stream and the sharded
+        mine_stream_merged driver — which is what makes their counts
+        (and therefore their outputs) identical by construction."""
+        from avenir_tpu.core.stream import double_buffered
+        from avenir_tpu.ops.bitset import (bitset_fold_counts,
+                                           pack_index_rows_u32)
+
+        cand_d = jnp.asarray(pack_index_rows_u32(
+            cand_ids, src.masked_width, c_pad))
+        counts_d = jnp.zeros(c_pad, jnp.int32)
+        for packed in double_buffered(src.packed_chunks(self.block)):
+            counts_d = bitset_fold_counts(
+                counts_d, jnp.asarray(packed), cand_d)
+        return np.asarray(counts_d, np.int64)
+
+    def mine_stream_merged(self, sources: Sequence[StreamingTransactionSource]
+                           ) -> List[ItemSetList]:
+        """mine_stream() over P shard sources with the support-merge
+        algebra: each per-k round counts every candidate independently
+        per shard (the SAME _stream_support fold mine_stream drives) and
+        merges the counts via merge_support_counts, thresholding against
+        the GLOBAL transaction count — so the mined output is
+        byte-identical to a single mine_stream over the concatenated
+        shards (integer counts partition exactly across row-aligned
+        shards; the shard-merge auditor re-proves this every round).
+
+        Candidates live in canonical token space here — per-shard masked
+        ids don't align across shards (vocab discovery order is data
+        order) — and translate per shard via token_code; a candidate
+        with a token some shard never saw counts 0 there without a scan.
+        fia.emit.trans.id concatenates per-shard id lists in shard
+        order, which IS corpus order for byte-range shards."""
+        srcs = list(sources)
+        if len(srcs) == 1:
+            return self.mine_stream(srcs[0])
+        scans = [src.scan_items() for src in srcs]
+        n = sum(s[2] for s in scans)
+        min_count = self.support_threshold * n
+        support1 = merge_support_counts(
+            *[{vocab[i]: int(counts[i]) for i in range(len(vocab))}
+              for vocab, counts, _n in scans])
+        freq_toks = sorted(t for t, cnt in support1.items()
+                           if cnt > min_count)
+        for src in srcs:
+            src.mask_items([src.index[t] for t in freq_toks
+                            if t in src.index])
+        rounds: List[Tuple[int, List[Tuple[str, ...]], List[int]]] = [
+            (1, [(t,) for t in freq_toks],
+             [int(support1[t]) for t in freq_toks])]
+
+        freq_sets: List[Tuple[str, ...]] = rounds[0][1]
+        for k in range(2, self.max_length + 1):
+            cands = _generate_candidates(freq_sets, k)
+            if not cands:
+                break
+            c_pad = max(64, 1 << (len(cands) - 1).bit_length())
+            counts = np.zeros(len(cands), np.int64)
+            for src in srcs:
+                ids = [tuple(src.token_code(t) for t in cd) for cd in cands]
+                present = [ci for ci, m in enumerate(ids)
+                           if all(i >= 0 for i in m)]
+                if not present:
+                    continue
+                shard = self._stream_support(
+                    src, [ids[ci] for ci in present], c_pad)
+                counts[present] += shard[:len(present)]
+            kept = [(cd, int(cnt)) for cd, cnt in zip(cands, counts)
+                    if cnt > min_count]
+            if not kept:
+                break
+            freq_sets = [cd for cd, _ in kept]
+            rounds.append((k, freq_sets, [cnt for _, cnt in kept]))
+
+        tids = self._collect_trans_ids_merged(srcs, rounds) \
+            if self.emit_trans_id else None
+        out: List[ItemSetList] = []
+        at = 0
+        for k, sets_k, counts_k in rounds:
+            n_k = len(sets_k)
+            sets = []
+            for ci, cd in enumerate(sets_k):
+                sets.append(ItemSet(
+                    tuple(sorted(cd)), counts_k[ci] / n, int(counts_k[ci]),
+                    tids[at + ci] if tids is not None else None))
+            sets.sort(key=lambda s: s.items)
+            out.append(ItemSetList(k, sets))
+            at += n_k
+        return out
+
+    def _collect_trans_ids_merged(self, srcs, rounds) -> List[List[str]]:
+        """The exact-trans-id pass of the sharded driver: one fused
+        all-lengths scan PER SHARD, per-candidate id lists concatenated
+        in shard order (= corpus order for byte-range shards)."""
+        from avenir_tpu.ops.bitset import (bitset_contain_mask,
+                                           pack_index_rows_u32, pack_rows_u32)
+
+        all_sets = [cd for _k, sets_k, _c in rounds for cd in sets_k]
+        tids: List[List[str]] = [[] for _ in all_sets]
+        if not all_sets:
+            return tids
+        c_pad = max(64, 1 << (len(all_sets) - 1).bit_length())
+        for src in srcs:
+            ids = [tuple(src.token_code(t) for t in cd) for cd in all_sets]
+            present = [ci for ci, m in enumerate(ids)
+                       if all(i >= 0 for i in m)]
+            if not present:
+                continue
+            cand_d = jnp.asarray(pack_index_rows_u32(
+                [ids[ci] for ci in present], src.masked_width, c_pad))
+            for mh, row_ids in src.chunks(self.block, with_ids=True):
+                m = np.asarray(bitset_contain_mask(
+                    jnp.asarray(pack_rows_u32(mh)), cand_d))
+                for pi, ci in enumerate(present):
+                    for r in np.flatnonzero(m[:len(row_ids), pi]):
+                        tids[ci].append(str(row_ids[r]))
+        return tids
 
     def _collect_trans_ids(self, src: StreamingTransactionSource,
                            rounds) -> List[List[str]]:

@@ -51,6 +51,28 @@ class FisherDiscriminant:
         self._s2 += np.asarray(jnp.einsum("nk,nf->kf", oh, x * x))
         return self
 
+    def merge(self, other: "FisherDiscriminant") -> "FisherDiscriminant":
+        """Fold another partial fit's per-class moments into this one —
+        the NaiveBayesModel.merge algebra for the discriminant: (count,
+        sum, sum-sq) are additive, so merging shard fits equals fitting
+        the concatenated shards. Both sides must be un-finalized partial
+        accumulations over the same numeric feature set; an empty
+        `other` merges as a no-op and an empty `self` adopts `other`."""
+        if other._cnt is None:
+            return self
+        if self._cnt is None:
+            self.fields = other.fields
+            self._cnt, self._s1, self._s2 = other._cnt, other._s1, other._s2
+            return self
+        if [f.ordinal for f in self.fields] != \
+                [f.ordinal for f in other.fields]:
+            raise ValueError(
+                "cannot merge discriminants over different feature sets")
+        self._cnt += other._cnt
+        self._s1 += other._s1
+        self._s2 += other._s2
+        return self
+
     def finalize(self) -> "FisherDiscriminant":
         cnt_np, s1_np, s2_np = self._cnt, self._s1, self._s2
         mean = s1_np / np.maximum(cnt_np[:, None], _EPS)

@@ -229,6 +229,40 @@ class MutualInformationAnalyzer:
                             np.float64).reshape(bi, bj, self.k))
         self.n += len(ds)
 
+    def merge(self, other: "MutualInformationAnalyzer"
+              ) -> "MutualInformationAnalyzer":
+        """Fold another analyzer's count tables into this one — the
+        NaiveBayesModel.merge algebra for MI: every table is an additive
+        integer-count tensor, so ``merge(add(A), add(B))`` equals
+        ``add(A ++ B)`` exactly (the shard-merge contract graftlint
+        --merge proves mechanically). Both sides must be un-finalized
+        partial fits over the same feature set; an empty `other` (no
+        chunks seen) merges as a no-op, and an empty `self` adopts
+        `other`'s state. Grown data-discovered vocabularies zero-pad
+        along the bin axes, exactly like chunked add()."""
+        if other.fields is None:
+            return self
+        if self.fields is None:
+            self.fields = other.fields
+            self.k = other.k
+            self.bins = [0] * len(other.fields)
+            self._fc = [np.zeros((0, self.k), np.float64)
+                        for _ in other.fields]
+        if self.k != other.k or [f.ordinal for f in self.fields] != \
+                [f.ordinal for f in other.fields]:
+            raise ValueError(
+                "cannot merge MI analyzers over different feature sets "
+                "or class counts")
+        self.bins = [max(a, b) for a, b in zip(self.bins, other.bins)]
+        for i in range(len(self.fields)):
+            self._fc[i] = _padded_add(self._fc[i], other._fc[i])
+        for key, tbl in other._pair.items():
+            self._pair[key] = _padded_add(self._pair.get(key), tbl)
+        for key, tbl in other._pairc.items():
+            self._pairc[key] = _padded_add(self._pairc.get(key), tbl)
+        self.n += other.n
+        return self
+
     def finalize(self) -> None:
         """Derive all MI statistics from the accumulated count tables."""
         F = len(self.bins)

@@ -201,6 +201,24 @@ class MarkovStateTransitionModel:
                                  ((0, len(new)), (0, 0), (0, 0)))
         return self.fit(seqs, entity_keys)
 
+    def merge(self, other: "MarkovStateTransitionModel"
+              ) -> "MarkovStateTransitionModel":
+        """Fold another partial fit's transition counts into this one —
+        the NaiveBayesModel.merge algebra for the (per-class) markov
+        counts: bigram counts are additive, so merging shard fits
+        equals fitting the concatenated shards, and a streamed fold's
+        carry can be checkpointed/merged byte-exactly (integer-valued
+        float64 cells). Both sides must agree on states, scale and
+        class labels (per-entity fits with divergent entity sets merge
+        through fit_entities' growth path instead, outside this op)."""
+        if self.states != other.states or self.scale != other.scale \
+                or self.class_labels != other.class_labels:
+            raise ValueError(
+                "cannot merge markov models with different states, "
+                "scale or class labels")
+        self.counts += other.counts
+        return self
+
     def matrix(self, class_label: Optional[str] = None,
                scaled: bool = True) -> np.ndarray:
         ki = (self.class_labels.index(class_label)

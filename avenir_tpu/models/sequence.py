@@ -523,14 +523,74 @@ class GSPMiner:
             # state through every time step, so a small round's padding
             # multiplies real work (unlike the bitset matmul's free lanes)
             c_pad = max(16, 1 << (len(cands) - 1).bit_length())
-            cand_d, kv = self._cand_arrays(cands, src.token_code, c_pad)
-            counts_d = jnp.zeros(c_pad, jnp.int32)
-            for blk in double_buffered(src.chunks(self.block)):
-                counts_d = _subseq_fold_kernel(
-                    counts_d, jnp.asarray(blk), cand_d, kv)
-            counts = np.asarray(counts_d, np.int64)
+            counts = self._stream_support(src, cands, c_pad)
             freq = {c: cnt / n
                     for c, cnt in zip(cands, counts[: len(cands)])
+                    if cnt > min_count}
+            if not freq:
+                break
+            out[k] = freq
+        return out
+
+    def _stream_support(self, src: StreamingSequenceSource,
+                        cands: List[Tuple[str, ...]], c_pad: int
+                        ) -> np.ndarray:
+        """One streamed support pass over ONE source: token-space
+        candidates encoded via src.token_code (-2 for tokens this source
+        never saw, which match nothing), blocks double-buffered against
+        the donated int32 device fold. The SINGLE implementation of the
+        N-proportional counting, shared by mine_stream and the sharded
+        mine_stream_merged driver — which is what makes their counts
+        (and therefore their outputs) identical by construction."""
+        from avenir_tpu.core.stream import double_buffered
+
+        cand_d, kv = self._cand_arrays(cands, src.token_code, c_pad)
+        counts_d = jnp.zeros(c_pad, jnp.int32)
+        for blk in double_buffered(src.chunks(self.block)):
+            counts_d = _subseq_fold_kernel(
+                counts_d, jnp.asarray(blk), cand_d, kv)
+        return np.asarray(counts_d, np.int64)
+
+    def mine_stream_merged(self, sources: Sequence[StreamingSequenceSource]
+                           ) -> Dict[int, Dict[Tuple[str, ...], float]]:
+        """mine_stream() over P shard sources with the support-merge
+        algebra (association.merge_support_counts): every per-k round
+        counts each candidate independently per shard through the SAME
+        _stream_support fold and sums the counts, thresholding against
+        the GLOBAL row count — so the mined output equals a single
+        mine_stream over the concatenated shards byte-identically
+        (int32 per-shard counts partition exactly across row-aligned
+        shards; the shard-merge auditor re-proves this every round).
+        GSP candidates are already canonical token tuples, so no
+        per-shard id translation beyond token_code is needed."""
+        from avenir_tpu.models.association import merge_support_counts
+
+        srcs = list(sources)
+        if len(srcs) == 1:
+            return self.mine_stream(srcs[0])
+        scans = [src.scan() for src in srcs]
+        n = sum(s[2] for s in scans)
+        min_count = self.support_threshold * n
+        support1 = merge_support_counts(
+            *[{vocab[i]: int(counts[i]) for i in range(len(vocab))}
+              for vocab, counts, _n in scans])
+        out: Dict[int, Dict[Tuple[str, ...], float]] = {}
+        freq = {(tok,): cnt / n for tok, cnt in sorted(support1.items())
+                if cnt > min_count}
+        out[1] = freq
+        for src in srcs:
+            src.mask_tokens([src.index[tok] for (tok,) in freq
+                             if tok in src.index])
+
+        for k in range(2, self.max_length + 1):
+            cands = generate_sequence_candidates(list(freq))
+            if not cands:
+                break
+            c_pad = max(16, 1 << (len(cands) - 1).bit_length())
+            counts = np.zeros(len(cands), np.int64)
+            for src in srcs:
+                counts += self._stream_support(src, cands, c_pad)[:len(cands)]
+            freq = {c: cnt / n for c, cnt in zip(cands, counts)
                     if cnt > min_count}
             if not freq:
                 break

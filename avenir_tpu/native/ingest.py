@@ -774,6 +774,32 @@ class SpillScanMixin:
             self._cache = None
         return self._scan_result()
 
+    def restore_scan_state(self, vocab, counts) -> None:
+        """Restore a mid-scan checkpoint into a freshly-BEGUN scan (the
+        fold-state resume contract, graftlint --merge): reinstall the
+        checkpointed discovery vocabulary and partial per-item counts in
+        place (the encoder holds references to `vocab`/`index`, so they
+        mutate, never rebind), rebuild the native encoder over them, and
+        DROP the spill cache — a cache begun after the restore would
+        hold only post-restore blocks yet commit as complete, and a
+        later per-k pass would replay a truncated corpus. Restored scans
+        therefore re-parse their sources per-k: correctness over
+        throughput, documented in docs/DESIGN.md. Callers restore their
+        own row counters (n_trans / n_rows / t_max) — the mixin does not
+        know their names."""
+        self.vocab[:] = list(vocab)
+        self.index.clear()
+        self.index.update({t: i for i, t in enumerate(self.vocab)})
+        self._scan_counts = np.asarray(counts, np.int64).copy()
+        if self._scan_encoder is not None:
+            self._scan_encoder = BlockScanEncoder(
+                self.delim, self.skip, self.vocab, self.index,
+                marker=self._scan_marker)
+        if self._cache is not None:
+            self._cache.close()
+            self._cache = None
+        self.spill_cache = False
+
     @property
     def cache_replays(self) -> int:
         """Completed encoded-block replay passes (bench tripwire hook)."""

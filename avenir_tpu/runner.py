@@ -22,6 +22,7 @@ Markov matrix files, LR coefficient history.
 
 from __future__ import annotations
 
+import io
 import json
 import math
 import os
@@ -264,6 +265,58 @@ class _NBDistrFold:
                          {"Distribution Data:Records": self.rows},
                          [out], model)
 
+    # ----------------------------------------------- merge algebra ops
+    def merge(self, other: "_NBDistrFold") -> "_NBDistrFold":
+        """Shard-merge: NB sufficient statistics are additive
+        (NaiveBayesModel.merge — the reducer algebra), so merging shard
+        folds equals folding the concatenated shards."""
+        if other.model is not None:
+            if self.model is None:
+                self.model = other.model
+            else:
+                self.model.merge(other.model)
+        self.rows += other.rows
+        return self
+
+    def state_dict(self) -> Dict[str, object]:
+        meta = {"rows": self.rows, "cards": None}
+        arrays: Dict[str, object] = {}
+        if self.model is not None:
+            m = self.model
+            m.flush()
+            # data-discovered categorical vocabularies are part of the
+            # carry: codes in later chunks must keep meaning the same
+            # tokens after a restore into a freshly-loaded schema
+            meta["cards"] = {str(f.ordinal): list(f.cardinality)
+                             for f in m.binned_fields if f.is_categorical}
+            arrays = {"post": m.post_counts, "mom": m.cont_moments,
+                      "cls": m.class_counts}
+        return {"meta": np.array(json.dumps(meta)), **arrays}
+
+    def load_state(self, state: Dict[str, object]) -> None:
+        from avenir_tpu.models.naive_bayes import NaiveBayesModel
+
+        meta = json.loads(str(state["meta"]))
+        self.rows = int(meta["rows"])
+        if meta["cards"] is None:
+            return                      # checkpoint taken before any chunk
+        by_ord = {f.ordinal: f for f in self.schema.fields}
+        for o, card in meta["cards"].items():
+            fld = by_ord[int(o)]
+            if fld.is_categorical and list(fld.cardinality or []) != card:
+                fld.cardinality = list(card)
+                fld.discovered_cardinality = True
+        self.model = NaiveBayesModel.empty(self.schema)
+        for key, attr in (("post", "post_counts"), ("mom", "cont_moments"),
+                          ("cls", "class_counts")):
+            arr = np.asarray(state[key], np.float64)
+            if arr.shape != getattr(self.model, attr).shape:
+                raise ValueError(
+                    f"checkpointed NB {attr} shape {arr.shape} does not "
+                    f"match the schema-derived model "
+                    f"{getattr(self.model, attr).shape}")
+            setattr(self.model, attr, arr)
+
 
 class _MutualInfoFold:
     """mutualInformation as a shared-scan sink: additive contingency
@@ -274,10 +327,57 @@ class _MutualInfoFold:
 
         self.cfg = cfg
         self.inputs = list(inputs)
+        self.schema = schema
         self.mi = MutualInformationAnalyzer()
 
     def consume(self, ds: Dataset) -> None:
         self.mi.add(ds)
+
+    # ----------------------------------------------- merge algebra ops
+    def merge(self, other: "_MutualInfoFold") -> "_MutualInfoFold":
+        """Shard-merge: every MI table is an additive integer-count
+        tensor (MutualInformationAnalyzer.merge)."""
+        self.mi.merge(other.mi)
+        return self
+
+    def state_dict(self) -> Dict[str, object]:
+        mi = self.mi
+        meta = {"n": mi.n, "k": mi.k, "bins": list(mi.bins),
+                "ordinals": ([f.ordinal for f in mi.fields]
+                             if mi.fields is not None else None),
+                "pairs": sorted(mi._pair)}
+        arrays: Dict[str, object] = {}
+        if mi.fields is not None:
+            for i, fc in enumerate(mi._fc):
+                arrays[f"fc_{i}"] = fc
+            for (i, j) in mi._pair:
+                arrays[f"pair_{i}_{j}"] = mi._pair[(i, j)]
+                arrays[f"pairc_{i}_{j}"] = mi._pairc[(i, j)]
+        return {"meta": np.array(json.dumps(meta)), **arrays}
+
+    def load_state(self, state: Dict[str, object]) -> None:
+        meta = json.loads(str(state["meta"]))
+        if meta["ordinals"] is None:
+            return                      # checkpoint taken before any chunk
+        if self.schema is None:
+            self.schema = _schema(self.cfg)
+        mi = self.mi
+        # the encodable field set is schema-derived, exactly what the
+        # first add() would have installed (Dataset.encodable_feature_fields)
+        mi.fields = [f for f in self.schema.feature_fields
+                     if f.num_bins() > 0]
+        if [f.ordinal for f in mi.fields] != list(meta["ordinals"]):
+            raise ValueError(
+                "checkpointed MI field ordinals do not match the schema")
+        mi.k = int(meta["k"])
+        mi.bins = [int(b) for b in meta["bins"]]
+        mi.n = int(meta["n"])
+        mi._fc = [np.asarray(state[f"fc_{i}"], np.float64)
+                  for i in range(len(mi.fields))]
+        mi._pair = {(i, j): np.asarray(state[f"pair_{i}_{j}"], np.float64)
+                    for i, j in (tuple(p) for p in meta["pairs"])}
+        mi._pairc = {(i, j): np.asarray(state[f"pairc_{i}_{j}"], np.float64)
+                     for i, j in (tuple(p) for p in meta["pairs"])}
 
     def finish(self, output: str) -> JobResult:
         cfg, mi = self.cfg, self.mi
@@ -311,12 +411,48 @@ class _FisherFold:
 
         self.cfg = cfg
         self.inputs = list(inputs)
+        self.schema = schema
         self.fd = FisherDiscriminant()
         self.rows = 0
 
     def consume(self, ds: Dataset) -> None:
         self.fd.accumulate(ds)
         self.rows += len(ds)
+
+    # ----------------------------------------------- merge algebra ops
+    def merge(self, other: "_FisherFold") -> "_FisherFold":
+        """Shard-merge: per-class (count, sum, sum-sq) moments are
+        additive (FisherDiscriminant.merge)."""
+        self.fd.merge(other.fd)
+        self.rows += other.rows
+        return self
+
+    def state_dict(self) -> Dict[str, object]:
+        fd = self.fd
+        meta = {"rows": self.rows,
+                "ordinals": ([f.ordinal for f in fd.fields]
+                             if fd._cnt is not None else None)}
+        arrays: Dict[str, object] = {}
+        if fd._cnt is not None:
+            arrays = {"cnt": fd._cnt, "s1": fd._s1, "s2": fd._s2}
+        return {"meta": np.array(json.dumps(meta)), **arrays}
+
+    def load_state(self, state: Dict[str, object]) -> None:
+        meta = json.loads(str(state["meta"]))
+        self.rows = int(meta["rows"])
+        if meta["ordinals"] is None:
+            return                      # checkpoint taken before any chunk
+        if self.schema is None:
+            self.schema = _schema(self.cfg)
+        fd = self.fd
+        fd.fields = [f for f in self.schema.feature_fields if f.is_numeric]
+        if [f.ordinal for f in fd.fields] != list(meta["ordinals"]):
+            raise ValueError(
+                "checkpointed discriminant field ordinals do not match "
+                "the schema")
+        fd._cnt = np.asarray(state["cnt"], np.float64)
+        fd._s1 = np.asarray(state["s1"], np.float64)
+        fd._s2 = np.asarray(state["s2"], np.float64)
 
     def finish(self, output: str) -> JobResult:
         if self.rows == 0:
@@ -390,6 +526,35 @@ class _MarkovPerClassFold:
         self.model.save(out, delim=self.cfg.field_delim)
         return JobResult("markovStateTransitionModel",
                          {"Basic:Records": self.rows}, [out], self.model)
+
+    # ----------------------------------------------- merge algebra ops
+    def merge(self, other: "_MarkovPerClassFold") -> "_MarkovPerClassFold":
+        """Shard-merge: per-class bigram counts are additive
+        (MarkovStateTransitionModel.merge)."""
+        self.model.merge(other.model)
+        self.rows += other.rows
+        return self
+
+    def state_dict(self) -> Dict[str, object]:
+        meta = {"rows": self.rows, "states": self.model.states,
+                "class_labels": self.model.class_labels}
+        return {"meta": np.array(json.dumps(meta)),
+                "counts": self.model.counts}
+
+    def load_state(self, state: Dict[str, object]) -> None:
+        meta = json.loads(str(state["meta"]))
+        if meta["states"] != self.model.states \
+                or meta["class_labels"] != self.model.class_labels:
+            raise ValueError(
+                "checkpointed markov states/class labels do not match "
+                "the job config")
+        arr = np.asarray(state["counts"], np.float64)
+        if arr.shape != self.model.counts.shape:
+            raise ValueError(
+                f"checkpointed markov counts shape {arr.shape} does not "
+                f"match {self.model.counts.shape}")
+        self.model.counts = arr
+        self.rows = int(meta["rows"])
 
 
 def _cache_budget(cfg: JobConfig) -> int:
@@ -479,29 +644,90 @@ class _MinerScanFold:
                 spill_cache=spill,
                 cache_budget_bytes=_cache_budget(cfg))
         self._sink = self.src.scan_consumer()
+        self._sealed = False
+        self._shards: List["_MinerScanFold"] = []
 
     def consume(self, data: bytes) -> None:
         self._sink.consume(data)
 
+    def _seal(self) -> None:
+        """Finish the pass-1 scan exactly once (commits the spill cache;
+        idempotent so merge() and finish() compose in any order)."""
+        if not self._sealed:
+            self._sink.finish()
+            self._sealed = True
+
+    def _n_rows(self) -> int:
+        return (self.src.n_trans if self.job == "frequentItemsApriori"
+                else self.src.n_rows)
+
     def finish(self, output: str) -> JobResult:
-        self._sink.finish()
-        levels = self.miner.mine_stream(self.src)
+        self._seal()
+        srcs = [self.src] + [f.src for f in self._shards]
+        levels = (self.miner.mine_stream(self.src) if len(srcs) == 1
+                  else self.miner.mine_stream_merged(srcs))
+        n_rows = self._n_rows() + sum(f._n_rows() for f in self._shards)
         if self.job == "frequentItemsApriori":
-            n_rows = self.src.n_trans
             counters = {"Apriori:MaxLength": len(levels),
                         **throughput_counters(
                             n_rows, time.perf_counter() - self.t0),
                         **_cache_counters(self.src)}
             outs = _write_apriori_outputs(self.cfg, output, levels)
         else:
-            n_rows = self.src.n_rows
             counters = {"GSP:MaxLength": max(levels) if levels else 0,
                         **throughput_counters(
                             n_rows, time.perf_counter() - self.t0),
                         **_cache_counters(self.src)}
             outs = _write_gsp_outputs(self.cfg, output, levels)
-        self.src.close()
+        for src in srcs:
+            src.close()
         return JobResult(self.job, counters, outs, levels)
+
+    # ----------------------------------------------- merge algebra ops
+    def merge(self, other: "_MinerScanFold") -> "_MinerScanFold":
+        """Shard-merge: seal both shards' pass-1 scans and keep the
+        shard sources side by side; finish() then drives the miner's
+        sharded per-k driver (mine_stream_merged), which counts every
+        candidate per shard through the one _stream_support fold and
+        sums supports via the registered support-merge
+        (models.association.merge_support_counts)."""
+        if other.job != self.job:
+            raise ValueError(
+                f"cannot merge {other.job!r} fold into {self.job!r}")
+        self._seal()
+        other._seal()
+        self._shards.append(other)
+        self._shards.extend(other._shards)
+        other._shards = []
+        return self
+
+    def state_dict(self) -> Dict[str, object]:
+        if self._shards:
+            raise ValueError(
+                "checkpoint a miner fold before merging shards into it")
+        src = self.src
+        meta = {"job": self.job, "vocab": list(src.vocab),
+                "n": self._n_rows(), "sealed": self._sealed,
+                "t_max": getattr(src, "t_max", None)}
+        return {"meta": np.array(json.dumps(meta)),
+                "counts": np.asarray(src._scan_counts, np.int64)}
+
+    def load_state(self, state: Dict[str, object]) -> None:
+        meta = json.loads(str(state["meta"]))
+        if meta["job"] != self.job:
+            raise ValueError(
+                f"checkpointed {meta['job']!r} state for a {self.job!r} "
+                f"fold")
+        src = self.src
+        src.restore_scan_state(meta["vocab"], state["counts"])
+        if self.job == "frequentItemsApriori":
+            src.n_trans = int(meta["n"])
+        else:
+            src.n_rows = int(meta["n"])
+            src.t_max = max(int(meta["t_max"] or 1), 1)
+        if meta["sealed"]:
+            self._sink.finish()
+            self._sealed = True
 
 
 def _apriori_fold(cfg, inputs, schema=None):
@@ -512,22 +738,84 @@ def _gsp_fold(cfg, inputs, schema=None):
     return _MinerScanFold(cfg, inputs, "candidateGenerationWithSelfJoin")
 
 
-#: canonical job name -> (scan kind, fold factory(cfg, inputs, schema)).
-#: "dataset" folds consume schema-parsed Dataset chunks; "bytes" folds
-#: consume raw byte blocks (sequence-shaped corpora).
-_STREAM_FOLDS: Dict[str, Tuple[str, Callable]] = {
-    "bayesianDistr": ("dataset", _NBDistrFold),
-    "mutualInformation": ("dataset", _MutualInfoFold),
-    "fisherDiscriminant": ("dataset", _FisherFold),
-    "markovStateTransitionModel": ("bytes", _MarkovPerClassFold),
-    "frequentItemsApriori": ("bytes", _apriori_fold),
-    "candidateGenerationWithSelfJoin": ("bytes", _gsp_fold),
+def _merge_folds(a, b):
+    """Default merge_states op: every registered fold sink implements
+    the in-place additive merge contract."""
+    return a.merge(b)
+
+
+@dataclass(frozen=True)
+class StreamFoldOps:
+    """One streamed job's fold-sink registration: the scan kind, the
+    sink factory, and the MERGE ALGEBRA ops that make its carry a
+    mergeable, serializable fold state —
+    ``merge_states(fold(A), fold(B)).finish() == fold(A++B).finish()``
+    byte-identically, and ``restore_state(serialize_state(fold))``
+    resumes a mid-scan carry to the same bytes. graftlint --merge
+    (analysis/merge.py) proves both properties mechanically every
+    round; the multi-host NB merge (tests/test_multihost.py) and the
+    incremental/resumable-scan work build on the same ops.
+
+    ``kind``: "dataset" folds consume schema-parsed Dataset chunks;
+    "bytes" folds consume raw byte blocks (sequence-shaped corpora).
+    ``factory(cfg, inputs, schema)`` builds the sink; ``merge_states``
+    folds one sink's carry into another (default: ``a.merge(b)``)."""
+
+    kind: str
+    factory: Callable
+    merge_states: Callable = _merge_folds
+
+    def serialize_state(self, fold) -> bytes:
+        """Checkpoint a fold's carry: an npz of the fold's
+        ``state_dict()`` — numpy arrays plus one JSON ``meta`` entry,
+        no pickle (a checkpoint must be loadable by a DIFFERENT process
+        with no trust in the writer)."""
+        buf = io.BytesIO()
+        np.savez(buf, **fold.state_dict())
+        return buf.getvalue()
+
+    def restore_state(self, cfg: JobConfig, inputs: Sequence[str],
+                      blob: bytes, schema=None):
+        """Rebuild a fold sink from a checkpoint: a FRESH factory sink
+        (same config surface a resumed process would construct) with
+        the serialized carry loaded into it, ready to consume the
+        remaining chunks."""
+        if schema is None and self.kind == "dataset":
+            schema = _schema(cfg)
+        fold = self.factory(cfg, list(inputs), schema)
+        with np.load(io.BytesIO(blob), allow_pickle=False) as z:
+            state = {k: z[k] for k in z.files}
+        fold.load_state(state)
+        return fold
+
+
+#: canonical job name -> StreamFoldOps (see the dataclass above)
+_STREAM_FOLDS: Dict[str, StreamFoldOps] = {
+    "bayesianDistr": StreamFoldOps("dataset", _NBDistrFold),
+    "mutualInformation": StreamFoldOps("dataset", _MutualInfoFold),
+    "fisherDiscriminant": StreamFoldOps("dataset", _FisherFold),
+    "markovStateTransitionModel": StreamFoldOps("bytes",
+                                                _MarkovPerClassFold),
+    "frequentItemsApriori": StreamFoldOps("bytes", _apriori_fold),
+    "candidateGenerationWithSelfJoin": StreamFoldOps("bytes", _gsp_fold),
 }
 
 
 def stream_fold_names() -> List[str]:
     """Jobs the scan-sharing executor can fuse."""
     return sorted(_STREAM_FOLDS)
+
+
+def stream_fold_ops(job: str) -> StreamFoldOps:
+    """The registered fold-sink ops of a streamed job (accepts
+    aliases) — the public handle the merge auditor, the multi-host
+    merge path and the future resumable-scan driver all share."""
+    canonical = _REGISTRY[job][0] if job in _REGISTRY else job
+    if canonical not in _STREAM_FOLDS:
+        raise KeyError(
+            f"job {job!r} has no registered stream fold; streamed jobs: "
+            f"{', '.join(stream_fold_names())}")
+    return _STREAM_FOLDS[canonical]
 
 
 def run_shared(specs: Sequence[Tuple[str, object, str]],
@@ -555,7 +843,8 @@ def run_shared(specs: Sequence[Tuple[str, object, str]],
             raise ValueError(
                 f"job {name!r} is not shared-scan capable; fusable jobs: "
                 f"{', '.join(stream_fold_names())}")
-        kind, factory = _STREAM_FOLDS[canonical]
+        ops = _STREAM_FOLDS[canonical]
+        kind, factory = ops.kind, ops.factory
         if any(canonical == b[0] for b in built):
             raise ValueError(
                 f"job {canonical!r} appears twice in one shared scan")

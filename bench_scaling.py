@@ -27,15 +27,17 @@ import tempfile
 
 def graftlint_tripwire() -> dict:
     """Run the graftlint CLI (--json) over the package, the --ir
-    manifest audit, the --flow concurrency/invariance audit AND the
-    --mem footprint audit, failing the bench on any non-allowlisted
-    finding, stale baseline entry, trace error, a distributed family
-    whose collective payload drifted off the scaling.py analytic model,
-    a streamed fold kernel whose output bytes moved with the chunk
-    layout, or a streamed job whose measured peak RSS left the memory
-    model's tolerance band — hazard/traffic/determinism/footprint
-    regressions surface here every round, not at the next 100M-row run.
-    The round's memory manifest (the job server's admission oracle) is
+    manifest audit, the --flow concurrency/invariance audit, the
+    --mem footprint audit AND the --merge shard-merge/resume audit,
+    failing the bench on any non-allowlisted finding, stale baseline
+    entry, trace error, a distributed family whose collective payload
+    drifted off the scaling.py analytic model, a streamed fold kernel
+    whose output bytes moved with the chunk layout, a streamed job
+    whose measured peak RSS left the memory model's tolerance band, or
+    a fold state whose shard merge / checkpoint resume drifted a byte —
+    hazard/traffic/determinism/footprint/merge-algebra regressions
+    surface here every round, not at the next 100M-row run. The
+    round's memory manifest (the job server's admission oracle) is
     re-derived and written next to the STREAM_SCALE_*.json records."""
     import os
     import subprocess
@@ -88,6 +90,17 @@ def graftlint_tripwire() -> dict:
         raise RuntimeError(
             f"footprint audit regression: {len(fp)} streamed jobs "
             f"audited, out-of-band={unbanded}")
+    merge_rep = run(["--merge"], "--merge")
+    ma = merge_rep["merge_audit"]
+    unmerged = [r["kernel"] for r in ma if not r["merge_validated"]]
+    # same >= 8 floor: every streamed fold kernel (solo + fused) must
+    # re-prove its shard-merge + checkpoint-resume byte-identity per
+    # round — the standing gate the resumable-scan and multi-host
+    # streaming work build on
+    if unmerged or len(ma) < 8:
+        raise RuntimeError(
+            f"shard-merge audit regression: {len(ma)} streamed kernels "
+            f"audited, drifted={unmerged}")
     # re-derive the admission oracle and pin it next to the scale
     # records so the job-server work consumes a fresh artifact, not a
     # stale hand-written one
@@ -107,6 +120,9 @@ def graftlint_tripwire() -> dict:
             "mem_findings": 0,
             "mem_allowlisted": mem_rep["suppressed"],
             "footprint_jobs_validated": len(fp),
+            "merge_findings": 0,
+            "merge_allowlisted": merge_rep["suppressed"],
+            "merge_kernels_validated": len(ma),
             "memory_manifest": "MEMORY_MANIFEST.json"}
 
 

@@ -64,6 +64,48 @@ class TestMutualInformation:
     def test_mifs_first_pick_is_mim_best(self, mia):
         assert mia.mifs()[0][0] == mia.mim()[0][0]
 
+    def test_merge_of_split_fits_equals_whole(self, churn, mia):
+        """The additive merge algebra (graftlint --merge's contract):
+        merging two partial add()s over a split of the corpus yields
+        the same count tables — and therefore identical MI statistics —
+        as one analyzer over the whole corpus."""
+        a = generate_churn(1800, seed=17)
+        b = generate_churn(1200, seed=18)
+        p1, p2 = MutualInformationAnalyzer(), MutualInformationAnalyzer()
+        p1.add(a)
+        p2.add(b)
+        whole = MutualInformationAnalyzer()
+        whole.add(a)
+        whole.add(b)
+        p1.merge(p2)
+        assert p1.n == whole.n == 3000
+        for i in range(len(whole.fields)):
+            np.testing.assert_array_equal(p1._fc[i], whole._fc[i])
+        for key, tbl in whole._pair.items():
+            np.testing.assert_array_equal(p1._pair[key], tbl)
+        p1.finalize()
+        whole.finalize()
+        np.testing.assert_array_equal(p1.feature_class_mi,
+                                      whole.feature_class_mi)
+        np.testing.assert_array_equal(p1.pair_class_mi, whole.pair_class_mi)
+
+    def test_merge_handles_empty_and_rejects_mismatch(self, churn):
+        full = MutualInformationAnalyzer()
+        full.add(churn)
+        n = full.n
+        full.merge(MutualInformationAnalyzer())      # empty other: no-op
+        assert full.n == n
+        empty = MutualInformationAnalyzer()
+        empty.merge(full)                            # empty self adopts
+        assert empty.n == n
+        bad = MutualInformationAnalyzer()
+        bad.add(churn)
+        bad.fields = bad.fields[:-1]
+        bad._fc = bad._fc[:-1]
+        bad.bins = bad.bins[:-1]
+        with pytest.raises(ValueError, match="cannot merge"):
+            full.merge(bad)
+
 
 class TestCorrelations:
     def test_cramer_perfect_association(self, churn):

@@ -51,6 +51,26 @@ class TestTransitionModel:
         np.testing.assert_allclose(mat.sum(axis=1), 1000, atol=3)
         assert (np.diag(mat) > 600).all()
 
+    def test_merge_matches_concatenated_fit(self):
+        """The additive merge algebra (graftlint --merge's contract):
+        merging two partial fits' counts equals fitting A ++ B."""
+        a = [["A", "B", "B", "C"], ["B", "A"]]
+        b = [["C", "C", "A"], ["A", "A", "B"]]
+        whole = MarkovStateTransitionModel(STATES).fit(a).fit(b)
+        m1 = MarkovStateTransitionModel(STATES).fit(a)
+        m2 = MarkovStateTransitionModel(STATES).fit(b)
+        np.testing.assert_array_equal(m1.merge(m2).counts, whole.counts)
+
+    def test_merge_rejects_mismatched_models(self):
+        m = MarkovStateTransitionModel(STATES)
+        with pytest.raises(ValueError, match="cannot merge"):
+            m.merge(MarkovStateTransitionModel(["A", "B"]))
+        with pytest.raises(ValueError, match="cannot merge"):
+            m.merge(MarkovStateTransitionModel(STATES, scale=500))
+        with pytest.raises(ValueError, match="cannot merge"):
+            m.merge(MarkovStateTransitionModel(STATES,
+                                               class_labels=["x", "y"]))
+
     def test_file_roundtrip(self, sticky_trans, tmp_path):
         seqs = chain_sequences(sticky_trans, 100, 20, seed=2)
         m = MarkovStateTransitionModel(

@@ -8,9 +8,16 @@ the coordination service, `host_csv_byte_range` hands each process a
 disjoint byte range under the LineRecordReader boundary contract,
 `CsvBlockReader(byte_range=...)` streams it, and `global_rows` assembles
 a globally row-sharded array whose shards live on different processes.
-The NB sufficient statistics folded per split merge additively
-(`NaiveBayesModel.merge` — the reducer algebra) to EXACTLY the
-single-process whole-file counts.
+
+The cross-process count merge goes through the REGISTERED fold-state
+algebra (runner.stream_fold_ops("bayesianDistr")): each worker folds its
+split through the registry's fold sink, serializes the carry with the
+registered ``serialize_state`` op, and the parent restores both carries
+and merges them with ``merge_states`` — the SAME ops the graftlint
+--merge auditor validates every round, so the multi-host path and the
+audited path can never drift apart. The merged model equals the
+single-process whole-file fit EXACTLY, and the merged fold's finished
+model file is byte-identical to the single-process runner job's.
 
 Honest limitation, pinned here so nobody re-discovers it: jaxlib's CPU
 backend refuses *compiled multiprocess computations* ("Multiprocess
@@ -18,7 +25,7 @@ computations aren't implemented on the CPU backend"), so the cross-host
 collective itself needs real TPU/GPU transport. Everything up to it —
 distributed init, per-host splits, global array assembly, shard
 placement — is asserted multi-process below; the count merge crosses
-processes through the additive model algebra instead.
+processes through the serialized fold states instead.
 """
 
 import os
@@ -51,7 +58,7 @@ assert len(jax.devices()) == 2 and len(jax.local_devices()) == 1
 
 from avenir_tpu.core.schema import FeatureSchema
 from avenir_tpu.core.stream import CsvBlockReader
-from avenir_tpu.models.naive_bayes import NaiveBayesModel
+from avenir_tpu.runner import _job_cfg, stream_fold_ops
 
 schema = FeatureSchema.from_file(schema_path)
 lo, hi = multihost.host_csv_byte_range(csv)
@@ -60,29 +67,32 @@ assert 0 <= lo <= hi <= size
 # the two splits tile the file exactly (contiguous per process)
 assert (lo == 0) == (proc_id == 0) and (hi == size) == (proc_id == 1)
 
-model = NaiveBayesModel.empty(schema)
-rows = 0
+# fold THIS host's split through the REGISTERED fold sink — the same
+# factory/serialize ops the graftlint --merge auditor proves each round
+ops = stream_fold_ops("bayesianDistr")
+_name, _prefix, cfg = _job_cfg(
+    "bayesianDistr", {"bad.feature.schema.file.path": schema_path})
+fold = ops.factory(cfg, [csv], schema)
 for chunk in CsvBlockReader(csv, schema, block_bytes=4096,
                             byte_range=(lo, hi)):
-    codes, _ = chunk.feature_codes(model.binned_fields)
-    model.accumulate(codes, chunk.labels(),
-                     chunk.feature_matrix(model.cont_fields), defer=True)
-    rows += len(chunk)
-model.flush()
+    fold.consume(chunk)
+state = ops.serialize_state(fold)
+with open(out + ".state", "wb") as fh:
+    fh.write(state)
 
 # assemble a genuinely multi-process global array: one row per host
 # (equal shards), sharded across the two processes' devices
+fold.model.flush()
 mesh = multihost.global_mesh()
-local = np.concatenate([model.post_counts.ravel(),
-                        model.class_counts.ravel()]).astype(np.float32)
+local = np.concatenate([fold.model.post_counts.ravel(),
+                        fold.model.class_counts.ravel()]).astype(np.float32)
 arr = multihost.global_rows(mesh, local[None, :])
 assert arr.shape == (2, local.shape[0])
 assert len(arr.addressable_shards) == 1              # only OUR row is local
 assert {d.process_index for d in arr.sharding.device_set} == {0, 1}
 
-np.savez(out, rows=rows, post=model.post_counts,
-         cls=model.class_counts, split=np.array([lo, hi]))
-print("OK", proc_id, rows, flush=True)
+np.savez(out, rows=fold.rows, split=np.array([lo, hi]))
+print("OK", proc_id, fold.rows, flush=True)
 """
 
 
@@ -110,7 +120,7 @@ def _free_port() -> int:
     return port
 
 
-def test_two_process_split_ingest_matches_single_process(corpus):
+def test_two_process_split_ingest_merges_via_registered_ops(corpus):
     coord = f"127.0.0.1:{_free_port()}"
     env = dict(os.environ)
     env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
@@ -130,25 +140,51 @@ def test_two_process_split_ingest_matches_single_process(corpus):
         stdout, _ = proc.communicate(timeout=180)
         assert proc.returncode == 0, stdout[-2000:]
         assert "OK" in stdout, stdout[-2000:]
-        results.append(np.load(out))
+        results.append((np.load(out), open(out + ".state", "rb").read()))
 
     # splits are disjoint, contiguous, and tile the file
-    (lo0, hi0), (lo1, hi1) = results[0]["split"], results[1]["split"]
+    (lo0, hi0), (lo1, hi1) = results[0][0]["split"], results[1][0]["split"]
     assert lo0 == 0 and hi0 == lo1 and hi1 == os.path.getsize(corpus["csv"])
 
     # per-split row counts partition the corpus, both splits non-trivial
-    rows = [int(r["rows"]) for r in results]
+    rows = [int(r["rows"]) for r, _s in results]
     assert sum(rows) == 1200 and min(rows) > 0
 
-    # the reducer algebra: split-fold counts sum EXACTLY to the
-    # single-process whole-file sufficient statistics
+    # the registered merge algebra crosses the process boundary: restore
+    # both workers' serialized fold states and merge them through the
+    # SAME merge_states op the graftlint --merge auditor validates
     from avenir_tpu.core.dataset import Dataset
+    from avenir_tpu.core.schema import FeatureSchema
     from avenir_tpu.data import churn_schema
     from avenir_tpu.models.naive_bayes import NaiveBayesModel
+    from avenir_tpu.runner import _job_cfg, run_job, stream_fold_ops
 
+    ops = stream_fold_ops("bayesianDistr")
+    conf = {"bad.feature.schema.file.path": corpus["schema"]}
+    folds = []
+    for _r, state in results:
+        _name, _prefix, cfg = _job_cfg("bayesianDistr", dict(conf))
+        folds.append(ops.restore_state(
+            cfg, [corpus["csv"]], state,
+            schema=FeatureSchema.from_file(corpus["schema"])))
+    merged = ops.merge_states(folds[0], folds[1])
+    assert merged.rows == 1200
+
+    # merged sufficient statistics == the single-process whole-file fit
     whole = NaiveBayesModel.fit(
         Dataset.from_csv(corpus["csv"], churn_schema()))
-    np.testing.assert_array_equal(
-        results[0]["post"] + results[1]["post"], whole.post_counts)
-    np.testing.assert_array_equal(
-        results[0]["cls"] + results[1]["cls"], whole.class_counts)
+    merged.model.flush()
+    np.testing.assert_array_equal(merged.model.post_counts,
+                                  whole.post_counts)
+    np.testing.assert_array_equal(merged.model.class_counts,
+                                  whole.class_counts)
+
+    # and the FINISHED artifact is byte-identical to the registered
+    # runner job over the whole file — the full merge-algebra contract,
+    # not just equal in-memory counts
+    single_out = os.path.join(corpus["dir"], "single_nb.txt")
+    run_job("bayesianDistr", dict(conf), [corpus["csv"]], single_out)
+    merged_out = os.path.join(corpus["dir"], "merged_nb.txt")
+    merged.finish(merged_out)
+    with open(single_out, "rb") as fa, open(merged_out, "rb") as fb:
+        assert fa.read() == fb.read()

@@ -76,6 +76,25 @@ class TestFisher:
         acc = (pred == elearn.labels()).mean()
         assert acc > 0.8
 
+    def test_merge_matches_sequential_accumulate(self, elearn):
+        """The additive merge algebra (graftlint --merge's contract):
+        merging two partial moment accumulations equals accumulating
+        both chunks into one discriminant, bit for bit."""
+        whole = FisherDiscriminant().accumulate(elearn).accumulate(elearn)
+        whole.finalize()
+        a = FisherDiscriminant().accumulate(elearn)
+        b = FisherDiscriminant().accumulate(elearn)
+        merged = a.merge(b).finalize()
+        assert merged.boundaries == whole.boundaries
+        assert merged.means == whole.means
+        # empty-side semantics: no-op one way, adoption the other
+        fresh = FisherDiscriminant()
+        fresh.merge(FisherDiscriminant())
+        assert fresh._cnt is None
+        adopted = FisherDiscriminant().merge(
+            FisherDiscriminant().accumulate(elearn))
+        assert adopted._cnt is not None
+
 
 class TestClustering:
     @pytest.fixture(scope="class")

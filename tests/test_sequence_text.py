@@ -116,6 +116,31 @@ class TestGSPMiner:
         assert ("a", "c") in got[2]
 
 
+class TestGSPSupportMerge:
+    def test_sharded_mine_stream_matches_single_scan(self, tmp_path):
+        """merge(fold(shard_A), fold(shard_B)) == fold(A ++ B) for GSP:
+        the sharded driver sums per-candidate supports via the
+        registered support-merge and reproduces the one-source streamed
+        scan exactly (same keys, same support floats)."""
+        from avenir_tpu.models.sequence import StreamingSequenceSource
+
+        rows = [["s%d" % i] + s for i, s in enumerate(SEQS * 10)]
+        full = tmp_path / "full.csv"
+        full.write_text("\n".join(",".join(r) for r in rows) + "\n")
+        cut = len(rows) // 2
+        a, b = tmp_path / "a.csv", tmp_path / "b.csv"
+        a.write_text("\n".join(",".join(r) for r in rows[:cut]) + "\n")
+        b.write_text("\n".join(",".join(r) for r in rows[cut:]) + "\n")
+
+        single = GSPMiner(0.3, 3).mine_stream(
+            StreamingSequenceSource([str(full)], spill_cache=False))
+        merged = GSPMiner(0.3, 3).mine_stream_merged([
+            StreamingSequenceSource([str(a)], spill_cache=False),
+            StreamingSequenceSource([str(b)], spill_cache=False)])
+        assert {k: dict(sorted(v.items())) for k, v in merged.items()} \
+            == {k: dict(sorted(v.items())) for k, v in single.items()}
+
+
 class TestPositionalCluster:
     def test_dense_burst_scores_high(self):
         # events bunched at t=100..110, sparse elsewhere

@@ -24,8 +24,15 @@ Writes one JSON line per job and a summary to STREAM_SCALE_r05.json
 previously recorded jobs). Works on CPU (pins the platform; the point is
 ingest scale, not device speed — bench.py measures the TPU fold rates).
 
+The summary also carries the two streaming-correctness audit columns —
+chunk-invariance (graftlint --flow) and shard-merge/resume (graftlint
+--merge) status, as validated/total strings — so every scale record
+states whether the folds it measured are still deterministic AND still
+a merge algebra. --no-audits skips them (they add a couple of minutes
+of proxy-scale runs next to an hours-long 100M anchor).
+
 Usage: python tools/stream_scale_check.py [--rows N_MILLION] [--extra]
-                                          [--fused]
+                                          [--fused] [--no-audits]
 """
 
 import json
@@ -121,6 +128,29 @@ def run_child(job, conf, inp, out):
     assert line["peak_rss_mb"] < RSS_LIMIT_MB, \
         f"{job} RSS {line['peak_rss_mb']}MB not O(block)"
     return line
+
+
+def audit_status(mode: str) -> str:
+    """"validated/total" of one graftlint streaming audit (--flow
+    chunk-invariance or --merge shard-merge/resume), run in a child so
+    this process stays jax-free; "unavailable (...)" instead of a raise
+    because a broken auditor must not block recording a finished
+    100M-row measurement — the bench tripwire is the hard gate."""
+    key, flag = (("invariance_audit", "--flow") if mode == "invariance"
+                 else ("merge_audit", "--merge"))
+    verdict = ("invariance_validated" if mode == "invariance"
+               else "merge_validated")
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.join("tools", "graftlint.py"),
+             flag, "--json"],
+            capture_output=True, text=True, timeout=1800,
+            env=dict(os.environ, AVENIR_SKIP_DEVICE_PROBE="1"))
+        rows = json.loads(proc.stdout)[key]
+        ok = sum(1 for r in rows if r[verdict])
+        return f"{ok}/{len(rows)}"
+    except Exception as e:                        # noqa: BLE001
+        return f"unavailable ({type(e).__name__})"
 
 
 def main():
@@ -255,6 +285,16 @@ def main():
         if isinstance(line, dict) and "mem_model_delta_pct" in line}
     if "sharedScan" in results:
         summary["shared_scan_speedup"] = results["sharedScan"]["speedup"]
+    # the two streaming-correctness columns, side by side: the folds the
+    # numbers above measured are chunk-layout-invariant AND a merge
+    # algebra (shard-merge + checkpoint-resume byte-identical)
+    if "--no-audits" not in sys.argv:
+        summary["invariance_audit"] = audit_status("invariance")
+        summary["merge_audit"] = audit_status("merge")
+        merged.update({"invariance_audit": summary["invariance_audit"],
+                       "merge_audit": summary["merge_audit"]})
+        with open(RECORD, "w") as fh:
+            json.dump(merged, fh, indent=1)
     print(json.dumps(summary))
     return 0
 
