@@ -386,9 +386,10 @@ def merge_rule_ids() -> List[str]:
 # shard-merge / resume auditor
 # --------------------------------------------------------------------------
 def _job_contexts(spec, ctx: dict, block_mb: float) -> List[tuple]:
-    """[(job, cfg, ops)] for every fold the spec registers, conf values
-    formatted against the prepared corpus ctx exactly like
-    manifest._job_runner does."""
+    """[(job, prefix, props, cfg, ops)] for every fold the spec
+    registers, conf values formatted against the prepared corpus ctx
+    exactly like manifest._job_runner does. `props` is the raw prefixed
+    dict the incremental leg re-feeds to runner.run_incremental."""
     from avenir_tpu.runner import _job_cfg, stream_fold_ops
 
     if not getattr(spec, "fold_specs", ()):
@@ -401,8 +402,9 @@ def _job_contexts(spec, ctx: dict, block_mb: float) -> List[tuple]:
                  for k, v in conf.items()}
         props[f"{prefix}.stream.block.size.mb"] = repr(float(block_mb))
         canonical, _prefix, cfg = _job_cfg(job, props)
-        out.append((canonical, cfg, stream_fold_ops(canonical)))
-    kinds = {ops.kind for _j, _c, ops in out}
+        out.append((canonical, prefix, props, cfg,
+                    stream_fold_ops(canonical)))
+    kinds = {ops.kind for _j, _p, _pr, _c, ops in out}
     if len(kinds) != 1:
         raise MergeAuditError(f"{spec.name}: mixed fold kinds {kinds}")
     return out
@@ -434,15 +436,29 @@ def _drive(jobs_ctx: List[tuple], paths: Sequence[str], schema) -> list:
     fan-out the fused runner uses — returning the fed folds."""
     from avenir_tpu.core.stream import SharedScan
 
-    kind = jobs_ctx[0][2].kind
+    kind = jobs_ctx[0][-1].kind
     folds = [ops.factory(cfg, list(paths), schema)
-             for _job, cfg, ops in jobs_ctx]
-    chunks = _chunk_list(kind, jobs_ctx[0][1], paths, schema)
+             for _job, _pfx, _props, cfg, ops in jobs_ctx]
+    chunks = _chunk_list(kind, jobs_ctx[0][3], paths, schema)
     scan = SharedScan(iter(chunks))
     for fold in folds:
         scan.add_sink(fold)
     scan.run()
     return folds
+
+
+def _tagged_outputs(job: str, outputs: Sequence[str], out: str,
+                    multi: bool) -> List[bytes]:
+    """Name-tagged artifact blobs of one job's output files — the same
+    rendering _job_runner/_finish_artifact use, so every leg of the
+    audit compares byte-for-byte against spec.run() baselines."""
+    blobs = []
+    for p in sorted(outputs):
+        rel = os.path.relpath(p, out)
+        tag = f"{job}:{rel}" if multi else rel
+        with open(p, "rb") as fh:
+            blobs.append(tag.encode() + b"\0" + fh.read())
+    return blobs
 
 
 def _finish_artifact(jobs_ctx: List[tuple], folds: list, out_base: str
@@ -453,14 +469,10 @@ def _finish_artifact(jobs_ctx: List[tuple], folds: list, out_base: str
     byte-for-byte."""
     multi = len(jobs_ctx) > 1
     blobs = []
-    for (job, _cfg, _ops), fold in zip(jobs_ctx, folds):
+    for (job, _pfx, _props, _cfg, _ops), fold in zip(jobs_ctx, folds):
         out = f"{out_base}_{job}"
         res = fold.finish(out)
-        for p in sorted(res.outputs):
-            rel = os.path.relpath(p, out)
-            tag = f"{job}:{rel}" if multi else rel
-            with open(p, "rb") as fh:
-                blobs.append(tag.encode() + b"\0" + fh.read())
+        blobs.extend(_tagged_outputs(job, res.outputs, out, multi))
     return b"\n".join(blobs)
 
 
@@ -483,21 +495,100 @@ def _shard_files(workdir: str, blocks: List[bytes], P: int, tag: str,
     return paths
 
 
+class _AuditInterrupt(Exception):
+    """Injected mid-scan kill of the incremental leg's append run."""
+
+
+def _incremental_leg(workdir: str, jobs_ctx: List[tuple],
+                     blocks: List[bytes], baseline: bytes) -> dict:
+    """(d) incremental + crash-resume leg, through the REAL driver
+    (runner.run_incremental): cold-scan a PREFIX corpus (writing the
+    final fold-state checkpoint + block fingerprints), append the
+    remaining blocks, and re-run — the driver must restore the carry,
+    fold only the delta blocks, and reproduce the cold full scan's
+    bytes. The append run is additionally killed right after its first
+    MID-SCAN checkpoint (the core.incremental._checkpoint_hook) and
+    re-run, so a genuine mid-corpus kill-and-resume crosses the auditor
+    every round. Fused entries drive each registered job's driver
+    separately (the delta-scan driver is per-job; fusion stays a
+    SharedScan concern)."""
+    from avenir_tpu.core import incremental as incr
+    from avenir_tpu.runner import run_incremental
+
+    grow = os.path.join(workdir, "grow.csv")
+    half = max(1, len(blocks) // 2)
+    with open(grow, "wb") as fh:
+        fh.write(b"".join(blocks[:half]))
+
+    multi = len(jobs_ctx) > 1
+
+    def run_all(tag: str):
+        blobs: List[bytes] = []
+        results = []
+        for job, prefix, props, _cfg, _ops in jobs_ctx:
+            out = os.path.join(workdir, f"incr_{tag}_{job}")
+            p = dict(props)
+            # checkpoint every block so the kill probe has a mid-delta
+            # watermark to die at (and resume from)
+            p[f"{prefix}.stream.checkpoint.interval.mb"] = "0.00001"
+            res = run_incremental(
+                job, p, [grow], out,
+                state_dir=os.path.join(workdir, f"incr_state_{job}"))
+            results.append(res)
+            blobs.extend(_tagged_outputs(job, res.outputs, out, multi))
+        return b"\n".join(blobs), results
+
+    run_all("cold")                       # seeds the checkpoints
+    with open(grow, "ab") as fh:
+        fh.write(b"".join(blocks[half:]))
+
+    def interrupter(meta: dict) -> None:
+        if not meta.get("complete"):
+            raise _AuditInterrupt()
+
+    prev = incr._checkpoint_hook
+    incr._checkpoint_hook = interrupter
+    interrupted = False
+    try:
+        run_all("kill")                   # dies after one delta block
+    except _AuditInterrupt:
+        interrupted = True
+    finally:
+        incr._checkpoint_hook = prev
+
+    art, results = run_all("resume")
+    # min across the entry's jobs: EVERY registered driver (fused
+    # entries run one per job) must have restored a carry and skipped
+    # its prefix, or the verdict gate fails — a single job regressing
+    # to always-cold cannot hide behind its sibling's counters
+    cs = [r.counters for r in results]
+    return {
+        "blocks": len(blocks), "prefix_blocks": half,
+        "hit_blocks": min(int(c["Cache:HitBlocks"]) for c in cs),
+        "delta_blocks": min(int(c["Cache:DeltaBlocks"]) for c in cs),
+        "skipped_bytes": min(int(c["Resume:SkippedBytes"]) for c in cs),
+        "resume_interrupted": interrupted,
+        "byte_identical": art == baseline,
+    }
+
+
 def audit_merge(spec, shard_counts: Sequence[int] = AUDIT_SHARDS,
                 block_mb: float = AUDIT_BLOCK_MB
                 ) -> Tuple[dict, Optional[Finding]]:
     """Prove one stream entry's fold state is a merge algebra: shard
     folds merge to the cold full scan's bytes at every P, a mid-scan
-    checkpoint resumes to the same bytes, and the overlap probe records
-    the family's idempotency contract. Returns (audit row, finding or
-    None); a kernel that fails to RUN raises :class:`MergeAuditError`."""
+    checkpoint resumes to the same bytes, the overlap probe records
+    the family's idempotency contract, and the incremental leg
+    re-proves append-refresh + crash-resume byte-identity through the
+    real delta-scan driver. Returns (audit row, finding or None); a
+    kernel that fails to RUN raises :class:`MergeAuditError`."""
     from avenir_tpu.core.stream import iter_byte_blocks
 
     workdir = tempfile.mkdtemp(prefix=f"graftlint_merge_{spec.name}_")
     try:
         ctx = spec.prepare(workdir)
         jobs_ctx = _job_contexts(spec, ctx, block_mb)
-        kind = jobs_ctx[0][2].kind
+        kind = jobs_ctx[0][-1].kind
         baseline = spec.run(ctx, block_mb)
 
         block_bytes = max(int(block_mb * (1 << 20)), 64)
@@ -507,6 +598,7 @@ def audit_merge(spec, shard_counts: Sequence[int] = AUDIT_SHARDS,
         shard_rows: List[dict] = []
         checkpoint: Optional[dict] = None
         overlap: Optional[dict] = None
+        incremental: Optional[dict] = None
         if enough:
             for P in shard_counts:
                 shards = _shard_files(workdir, blocks, P, "m")
@@ -517,7 +609,7 @@ def audit_merge(spec, shard_counts: Sequence[int] = AUDIT_SHARDS,
                 merged = folds[0]
                 for nxt in folds[1:]:
                     merged = [ops.merge_states(a, b)
-                              for (_j, _c, ops), a, b
+                              for (_j, _p, _pr, _c, ops), a, b
                               in zip(jobs_ctx, merged, nxt)]
                 art = _finish_artifact(
                     jobs_ctx, merged, os.path.join(workdir, f"merge{P}"))
@@ -529,18 +621,20 @@ def audit_merge(spec, shard_counts: Sequence[int] = AUDIT_SHARDS,
             # (b) checkpoint mid-scan: serialize after ~half the chunks,
             # restore into FRESH folds, finish, compare
             schema = _load_schema(ctx)
-            chunks = _chunk_list(kind, jobs_ctx[0][1], [ctx["csv"]], schema)
+            chunks = _chunk_list(kind, jobs_ctx[0][3], [ctx["csv"]], schema)
             half = max(1, len(chunks) // 2)
             folds = [ops.factory(cfg, [ctx["csv"]], schema)
-                     for _j, cfg, ops in jobs_ctx]
+                     for _j, _p, _pr, cfg, ops in jobs_ctx]
             for chunk in chunks[:half]:
                 for fold in folds:
                     fold.consume(chunk)
             states = [ops.serialize_state(fold)
-                      for (_j, _c, ops), fold in zip(jobs_ctx, folds)]
+                      for (_j, _p, _pr, _c, ops), fold
+                      in zip(jobs_ctx, folds)]
             restored = [ops.restore_state(cfg, [ctx["csv"]], blob,
                                           schema=schema)
-                        for (_j, cfg, ops), blob in zip(jobs_ctx, states)]
+                        for (_j, _p, _pr, cfg, ops), blob
+                        in zip(jobs_ctx, states)]
             for chunk in chunks[half:]:
                 for fold in restored:
                     fold.consume(chunk)
@@ -561,7 +655,7 @@ def audit_merge(spec, shard_counts: Sequence[int] = AUDIT_SHARDS,
             folds = [_drive(jobs_ctx, [shard], _load_schema(ctx))
                      for shard in shards]
             merged = [ops.merge_states(a, b)
-                      for (_j, _c, ops), a, b
+                      for (_j, _p, _pr, _c, ops), a, b
                       in zip(jobs_ctx, folds[0], folds[1])]
             ov_art = _finish_artifact(jobs_ctx, merged,
                                       os.path.join(workdir, "overlap"))
@@ -570,6 +664,10 @@ def audit_merge(spec, shard_counts: Sequence[int] = AUDIT_SHARDS,
                 "contract": ("non-idempotent" if ov_art != baseline
                              else "overlap-insensitive"),
             }
+
+            # (d) incremental delta-scan + crash-resume, real driver
+            incremental = _incremental_leg(workdir, jobs_ctx, blocks,
+                                           baseline)
     except MergeAuditError:
         raise
     except Exception as e:
@@ -580,17 +678,23 @@ def audit_merge(spec, shard_counts: Sequence[int] = AUDIT_SHARDS,
 
     ok = enough and all(r["byte_identical"] for r in shard_rows) \
         and checkpoint is not None and checkpoint["byte_identical"]
+    incr_ok = (incremental is not None
+               and incremental["byte_identical"]
+               and incremental["resume_interrupted"]
+               and incremental["skipped_bytes"] > 0)
     row = {
         "kernel": spec.name,
-        "jobs": [j for j, _c, _o in jobs_ctx],
+        "jobs": [j for j, _p, _pr, _c, _o in jobs_ctx],
         "block_mb": float(block_mb),
         "shards": shard_rows,
         "checkpoint": checkpoint,
         "overlap": overlap,
+        "incremental": incremental,
         "merge_validated": ok,
+        "incremental_validated": incr_ok,
     }
     finding = None
-    if not ok:
+    if not ok or not incr_ok:
         if not enough:
             why = (f"corpus cut into only {len(blocks)} blocks at "
                    f"{block_mb:g}MB — too few for P={max(shard_counts)} "
@@ -600,6 +704,8 @@ def audit_merge(spec, shard_counts: Sequence[int] = AUDIT_SHARDS,
                    if not r["byte_identical"]]
             if not checkpoint["byte_identical"]:
                 bad.append("checkpoint-resume")
+            if not incr_ok:
+                bad.append("incremental-append/resume")
             why = f"output bytes drifted under: {', '.join(bad)}"
         finding = Finding(
             spec.path, spec.line, MERGE_AUDIT_RULE,

@@ -1,0 +1,216 @@
+"""Incremental delta-scan state: content block fingerprints + atomic
+fold-state checkpoints.
+
+A production corpus is append-mostly, but every streamed job used to
+re-scan from byte 0 — re-ingesting 100M unchanged rows IS the cost once
+folds are vectorized (the framework-overhead thesis of arXiv:1811.04875
+/ arXiv:1309.0215). The fold-state merge algebra is proven
+(``merge(fold(A), fold(B)) == fold(A++B)`` byte-identically, graftlint
+--merge, 8/8 per round), so an append-refresh only needs driver state:
+
+- **Block fingerprints** — every byte block a scan folds is recorded as
+  ``(offset, length, content hash)``. Two files agreeing on a
+  fingerprint PREFIX agree byte-for-byte on the covered range, so an
+  appended CSV invalidates nothing and an in-place edit invalidates
+  exactly the blocks from the edit on. This replaces whole-file
+  ``size+mtime_ns`` validity wherever a delta matters (the incremental
+  runner here; the encoded-block cache's per-source segments in
+  native/ingest.py).
+- **Checkpoints** — a scan's carry (``StreamFoldOps.serialize_state``
+  npz bytes) plus a JSON manifest naming the covered watermark and the
+  fingerprints behind it, written atomically so a torn checkpoint can
+  never commit. ``runner.run_incremental`` restores the newest
+  checkpoint, folds only the blocks past the watermark, and re-emits
+  the artifact — the same mechanism serves both the append-refresh
+  (watermark = end of the previous corpus) and mid-corpus crash resume
+  (watermark = the last periodic checkpoint before the kill).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+from typing import Dict, Optional, Sequence, Tuple
+
+#: fingerprint hash: sha1. Chosen by MEASURED throughput — the hash is
+#: the incremental driver's per-refresh floor (the whole unchanged
+#: prefix re-hashes before a carry restores), and on this host sha1
+#: streams ~2.5x faster than blake2b (~1.2GB/s vs ~0.5GB/s) while crc32
+#: is both slower and 32-bit. 160 bits is collision-safe at any corpus
+#: size this repo targets; the table stays ~60 bytes per 64MB block.
+_HASH = hashlib.sha1
+
+#: Audit/test hook: when set, called with the checkpoint meta dict right
+#: after every COMMITTED checkpoint write (the core.stream._produce_hook
+#: pattern). The merge auditor's crash-resume leg installs an
+#: interrupter here to abort a scan right after a mid-scan checkpoint;
+#: the crash tests install an os._exit to simulate a hard kill.
+#: Production leaves it None.
+_checkpoint_hook = None
+
+
+def block_hash(data: bytes) -> str:
+    return _HASH(data).hexdigest()
+
+
+def block_fingerprint(offset: int, data: bytes) -> Dict[str, object]:
+    """The per-block validity unit of every delta scan: absolute file
+    offset, byte length and content hash of one line-aligned block."""
+    return {"offset": int(offset), "length": len(data),
+            "hash": block_hash(data)}
+
+
+def _fp_reads(path: str, fps: Sequence[dict]):
+    """Sequential reads of the recorded block lengths — the producer
+    half of verified_prefix, run in a prefetch thread so disk read and
+    hashing overlap (hashlib releases the GIL for large buffers; the
+    hash is the incremental driver's per-refresh floor, so halving its
+    wall time is a direct speedup of every append-refresh)."""
+    with open(path, "rb") as fh:
+        fh.seek(int(fps[0]["offset"]))
+        for fp in fps:
+            yield fh.read(int(fp["length"]))
+
+
+def verified_prefix(path: str, fps: Sequence[dict]) -> Tuple[int, int]:
+    """(n_blocks, covered_end_offset) of the longest recorded-fingerprint
+    prefix that still content-matches `path`.
+
+    Offsets must tile gap-free from the first recorded offset and every
+    block's bytes must re-hash to the recorded value — one sequential
+    read of the covered range (prefetched, so IO overlaps the hash), no
+    parse. Verification stops at the first mismatch: an in-place edit
+    invalidates everything from the edited block on, while a pure
+    append invalidates nothing (appended bytes sit past the last
+    recorded block's end)."""
+    from avenir_tpu.core.stream import prefetched
+
+    n = 0
+    covered = 0
+    if not fps:
+        return 0, 0
+    try:
+        size = os.path.getsize(path)
+        expect = int(fps[0]["offset"])
+        feed = prefetched(_fp_reads(path, fps), depth=2)
+        try:
+            for fp, data in zip(fps, feed):
+                off, length = int(fp["offset"]), int(fp["length"])
+                # geometry first: the reader streams assuming contiguity,
+                # so a gap means the bytes it handed over are untrusted
+                if off != expect or off + length > size:
+                    break
+                if len(data) != length or block_hash(data) != fp["hash"]:
+                    break
+                expect = off + length
+                n += 1
+                covered = expect
+        finally:
+            feed.close()
+    except OSError:
+        return 0, 0
+    return n, covered
+
+
+def ends_at_newline(path: str, offset: int) -> bool:
+    """True when a watermark at `offset` sits on a line boundary (the
+    byte before it is ``\\n``, or it is the start of the file). A
+    recorded coverage whose final block does NOT end at a newline came
+    from a corpus whose last line had no terminator — the already-folded
+    row and any appended bytes form ONE line, so resuming past the
+    watermark would silently skip the row's continuation. Delta gates
+    (run_incremental's restore plan, EncodedBlockCache.source_delta)
+    treat a grown file behind a mid-line watermark as unusable: cold
+    re-scan, never a spliced row."""
+    if offset <= 0:
+        return True
+    try:
+        with open(path, "rb") as fh:
+            fh.seek(offset - 1)
+            return fh.read(1) == b"\n"
+    except OSError:
+        return False
+
+
+class CheckpointStore:
+    """Atomic on-disk checkpoint of one incremental scan: a carry blob
+    next to a JSON manifest, under a per-(job, corpus) state directory.
+
+    Write protocol — a torn checkpoint must NEVER commit (the standing
+    cache/checkpoint contract): the carry blob lands first under a
+    unique name (write to ``.tmp``, rename), then the manifest — which
+    records the carry's file name, byte length and content hash —
+    replaces the previous one the same way. A killed process leaves
+    either the previous consistent pair or the new one on disk, and
+    ``load()`` re-verifies the referenced blob's length and hash,
+    returning None for anything missing, truncated or unparsable — the
+    driver then falls back to a cold scan instead of resuming from
+    (and committing) a wrong carry. No fsync: the hash-verified load is
+    what makes a torn pair a DETECTED cold-fallback rather than a wrong
+    resume, so the only cost of an unflushed page at power loss is a
+    re-scan — while an fsync per checkpoint was measured at ~0.2s, a
+    per-refresh floor the delta-scan driver cannot afford. Superseded
+    carry files are removed only after the new manifest is in place."""
+
+    MANIFEST = "MANIFEST.json"
+
+    def __init__(self, state_dir: str):
+        self.dir = state_dir
+        os.makedirs(state_dir, exist_ok=True)
+
+    def _write_atomic(self, path: str, payload: bytes) -> None:
+        tmp = path + ".tmp"
+        with open(tmp, "wb") as fh:
+            fh.write(payload)
+        os.replace(tmp, path)
+
+    def save(self, meta: dict, blob: bytes) -> dict:
+        """Commit one checkpoint; returns the manifest actually written
+        (meta plus the carry bookkeeping fields)."""
+        token = f"{int(meta.get('seq', 0)):06d}_{block_hash(blob)[:8]}"
+        carry = f"carry_{token}.npz"
+        meta = dict(meta, carry_file=carry, carry_bytes=len(blob),
+                    carry_hash=block_hash(blob))
+        self._write_atomic(os.path.join(self.dir, carry), blob)
+        self._write_atomic(os.path.join(self.dir, self.MANIFEST),
+                           json.dumps(meta, indent=1).encode())
+        for name in os.listdir(self.dir):
+            if (name.startswith("carry_") and name != carry) \
+                    or name.endswith(".tmp"):
+                try:
+                    os.remove(os.path.join(self.dir, name))
+                except OSError:
+                    pass
+        return meta
+
+    def load(self) -> Optional[Tuple[dict, bytes]]:
+        """(manifest, carry blob) of the newest committed checkpoint, or
+        None when there is none — or when what is on disk is torn
+        (missing/short/corrupt carry, unparsable manifest). A None here
+        is the cold-scan fallback signal, never an error."""
+        try:
+            with open(os.path.join(self.dir, self.MANIFEST), "rb") as fh:
+                meta = json.loads(fh.read().decode())
+            with open(os.path.join(self.dir, str(meta["carry_file"])),
+                      "rb") as fh:
+                blob = fh.read()
+            if len(blob) != int(meta["carry_bytes"]) \
+                    or block_hash(blob) != meta["carry_hash"]:
+                return None
+            return meta, blob
+        except (OSError, ValueError, KeyError):
+            return None
+
+    def clear(self) -> None:
+        try:
+            names = os.listdir(self.dir)
+        except OSError:
+            return
+        for name in names:
+            if name == self.MANIFEST or name.startswith("carry_") \
+                    or name.endswith(".tmp"):
+                try:
+                    os.remove(os.path.join(self.dir, name))
+                except OSError:
+                    pass

@@ -121,11 +121,16 @@ _bytes_hook = None
 
 def _item_nbytes(item) -> int:
     """Accountable byte size of a produced item: RAW byte blocks only —
-    parsed/encoded items (Datasets, padded pages, packed bitsets) are
-    priced by the footprint model's own per-job terms, so counting them
-    here would double-book them against the raw-block term."""
+    bare, or (offset, block) pairs from iter_byte_blocks' with_offsets
+    mode (the delta-scan feeds). Parsed/encoded items (Datasets, padded
+    pages, packed bitsets) are priced by the footprint model's own
+    per-job terms, so counting them here would double-book them against
+    the raw-block term."""
     if isinstance(item, (bytes, bytearray, memoryview)):
         return len(item)
+    if isinstance(item, tuple) and len(item) == 2 \
+            and isinstance(item[1], (bytes, bytearray, memoryview)):
+        return len(item[1])
     return 0
 
 #: consumer-side poll granularity: bounds how long a pull can block
@@ -342,8 +347,8 @@ def stream_job_inputs(cfg, inputs: Iterable[str], schema: FeatureSchema,
 
 def iter_byte_blocks(path: str,
                      block_bytes: int = DEFAULT_BLOCK_BYTES,
-                     byte_range: Optional[Tuple[int, int]] = None
-                     ) -> Iterator[bytes]:
+                     byte_range: Optional[Tuple[int, int]] = None,
+                     with_offsets: bool = False) -> Iterator:
     """Yield ~block_bytes raw byte blocks cut at line boundaries — the
     zero-copy feed for native block consumers (seq_encode): no decode,
     no per-line Python strings.
@@ -353,7 +358,38 @@ def iter_byte_blocks(path: str,
     starting mid-line skips past its first newline (the previous split
     owns that line) and owns every line that STARTS before `end`, so
     disjoint ranges covering [0, size) yield every line exactly once —
-    multi-host ingest for the sequence jobs."""
+    multi-host ingest for the sequence jobs.
+
+    with_offsets=True yields (offset, block) pairs instead, where
+    `offset` is the ABSOLUTE file offset of the block's first byte, and
+    whitespace-only blocks are yielded too so consecutive blocks tile
+    the covered range gap-free — the delta-scan drivers (the incremental
+    runner, the encoded-block cache's per-block fingerprints) account
+    for every covered byte; consumers skip folding blank blocks
+    themselves (folds treat them as zero rows anyway). The default mode
+    keeps the historical contract: bare blocks, blanks dropped."""
+    blocks = _offset_byte_blocks(path, block_bytes, byte_range)
+    if with_offsets:
+        return blocks
+    return _blank_filtered(blocks)
+
+
+def _blank_filtered(blocks: Iterator[Tuple[int, bytes]]) -> Iterator[bytes]:
+    nonblank = _NONWS.search   # no-copy emptiness check (strip() copies)
+    try:
+        for _off, blk in blocks:
+            if nonblank(blk):
+                yield blk
+    finally:
+        blocks.close()          # abandonment closes the file promptly
+
+
+def _offset_byte_blocks(path: str, block_bytes: int,
+                        byte_range: Optional[Tuple[int, int]]
+                        ) -> Iterator[Tuple[int, bytes]]:
+    """(absolute offset, block) pairs tiling the byte range gap-free —
+    the one copy of the split-boundary block cutter behind both
+    iter_byte_blocks modes."""
     if not os.path.exists(path):
         raise FileNotFoundError(f"no such input file: {path!r}")
     if block_bytes < 1:
@@ -365,13 +401,13 @@ def iter_byte_blocks(path: str,
     size = os.path.getsize(path)
     start, end = byte_range if byte_range else (0, size)
     end = min(end, size)
-    nonblank = _NONWS.search   # no-copy emptiness check (strip() copies)
     with open(path, "rb") as fh:
         if start > 0:
             fh.seek(start - 1)
             if fh.read(1) != b"\n":
                 fh.readline()
         pos = fh.tell()
+        emit = pos               # offset of the next unemitted byte
         carry = b""
         while pos < end:
             block = fh.read(block_bytes)
@@ -397,10 +433,8 @@ def iter_byte_blocks(path: str,
                         data += extra
                         nl = data.find(b"\n", off)
                     cut = (nl + 1) if nl >= 0 else len(data)
-                out = data[:cut]
-                if nonblank(out):
-                    yield out
-                break
+                yield emit, data[:cut]
+                return
             # carry never contains a newline, so the cut within `block`
             # is the cut within carry+block — splice with ONE copy
             # (join reads the memoryview; no intermediate slice bytes)
@@ -411,10 +445,17 @@ def iter_byte_blocks(path: str,
             out = (b"".join((carry, memoryview(block)[:cut + 1]))
                    if carry else block[:cut + 1])
             carry = block[cut + 1:]
-            if nonblank(out):
-                yield out
-        if carry and nonblank(carry):
-            yield carry
+            yield emit, out
+            emit += len(out)
+        if carry:
+            yield emit, carry
+
+
+def is_blank_block(data: bytes) -> bool:
+    """True when a raw byte block holds no non-whitespace byte — the
+    no-copy check delta-scan drivers use to skip folding the blank
+    blocks that with_offsets mode must still account for."""
+    return _NONWS.search(data) is None
 
 
 def iter_line_blocks(path: str,

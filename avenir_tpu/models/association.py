@@ -29,6 +29,8 @@ frequent-itemset state between rounds stays as a plain file via save/load
 
 from __future__ import annotations
 
+import os
+
 from dataclasses import dataclass, field
 from functools import partial
 from itertools import combinations
@@ -351,11 +353,12 @@ class StreamingTransactionSource(SpillScanMixin):
                 r, c = self._apply_mask(row_of, codes)
                 yield from pages(r, c, n)
 
-        def parse_pages(path):
+        def parse_pages(path, byte_range=None):
             from avenir_tpu.core.stream import iter_byte_blocks
 
             for data in prefetched(
-                    iter_byte_blocks(path, self.block_bytes), depth=1):
+                    iter_byte_blocks(path, self.block_bytes, byte_range),
+                    depth=1):
                 # cannot be None: availability + 1-byte delim checked
                 codes, offsets = seq_encode_native(
                     data, self.delim, self.vocab)
@@ -376,9 +379,22 @@ class StreamingTransactionSource(SpillScanMixin):
             return
         if native_seq_ready(self.delim):
             for si, path in enumerate(self.paths):
-                if self._cache is not None \
-                        and self._cache.source_valid(si):
+                if self._cache is None:
+                    yield from parse_pages(path)
+                    continue
+                if self._cache.source_valid(si):
                     yield from replay_pages(self._cache.blocks(si))
+                    continue
+                delta = self._cache.source_delta(si)
+                if delta is not None:
+                    # appended source: the committed blocks still
+                    # content-match the file's prefix (per-block
+                    # fingerprints) — replay them and re-parse only the
+                    # appended tail instead of the whole file
+                    yield from replay_pages(
+                        self._cache.blocks(si, prefix=True))
+                    yield from parse_pages(
+                        path, (delta, os.path.getsize(path)))
                 else:
                     yield from parse_pages(path)
             return

@@ -22,6 +22,8 @@ compiled pass with [N, C] state.
 
 from __future__ import annotations
 
+import os
+
 from dataclasses import dataclass
 from functools import partial
 from typing import Dict, Iterable, List, Optional, Sequence, Tuple
@@ -352,11 +354,12 @@ class StreamingSequenceSource(SpillScanMixin):
                 pos = cs[valid] - 1 - base[rows_v]
                 yield from pages(rows_v, pos, enc_all[valid], n)
 
-        def parse_pages(path):
+        def parse_pages(path, byte_range=None):
             from avenir_tpu.core.stream import iter_byte_blocks, prefetched
 
             for data in prefetched(
-                    iter_byte_blocks(path, self.block_bytes), depth=1):
+                    iter_byte_blocks(path, self.block_bytes, byte_range),
+                    depth=1):
                 codes, offsets = seq_encode_native(
                     data, self.delim, self.vocab)
                 n = offsets.shape[0] - 1
@@ -396,9 +399,21 @@ class StreamingSequenceSource(SpillScanMixin):
             # per-source mix: sources whose segment the cache's byte
             # budget evicted re-parse natively, survivors keep replaying
             for si, path in enumerate(self.paths):
-                if self._cache is not None \
-                        and self._cache.source_valid(si):
+                if self._cache is None:
+                    yield from parse_pages(path)
+                    continue
+                if self._cache.source_valid(si):
                     yield from replay_pages(self._cache.blocks(si))
+                    continue
+                delta = self._cache.source_delta(si)
+                if delta is not None:
+                    # appended source: committed blocks still content-
+                    # match the file's prefix (per-block fingerprints) —
+                    # replay them, re-parse only the appended tail
+                    yield from replay_pages(
+                        self._cache.blocks(si, prefix=True))
+                    yield from parse_pages(
+                        path, (delta, os.path.getsize(path)))
                 else:
                     yield from parse_pages(path)
             return

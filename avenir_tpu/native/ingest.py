@@ -385,16 +385,28 @@ class EncodedBlockCache:
     ``blocks(i)``), so a tight budget degrades throughput, never
     correctness.
 
-    Invalidation contract: the cache fingerprints its source files
-    (path, size, mtime_ns) at begin() and re-verifies at commit() and
-    before every replay — a source that changed invalidates the cache
-    and consumers fall back to the re-parse path. The cache directory is
-    owned by this object (a tempdir unless `cache_dir` is given) and is
-    removed on close()/GC; it is a within-job spill, not a cross-run
-    artifact store."""
+    Invalidation contract: validity is PER BLOCK, not per file. The
+    own-read scan records a content fingerprint (offset + length +
+    blake2b hash, ``note_block``) for every raw block it encodes; at
+    replay time a source whose quick (path, size, mtime_ns) snapshot
+    moved is re-proven by re-hashing the recorded ranges (memoized per
+    file snapshot). An APPENDED source therefore stays replayable —
+    its committed blocks still content-match the file's prefix
+    (``source_delta`` hands consumers the byte offset where coverage
+    ends, and only the tail re-parses) — while an in-place edit, or a
+    writer that never saw raw blocks (the shared-scan external feed
+    records no fingerprints), falls back to the whole-file snapshot
+    gate and the full re-parse path. commit() still refuses a source
+    that changed at all while the scan ran: a torn cache never commits.
+    The cache directory is owned by this object (a tempdir unless
+    `cache_dir` is given) and is removed on close()/GC; it is a
+    within-job spill, not a cross-run artifact store."""
 
     #: segment key of the combined (source-unattributed) write stream
     _COMBINED = None
+
+    #: sentinel: no segment can serve the requested source
+    _NO_SEGMENT = object()
 
     def __init__(self, sources: Sequence[str],
                  cache_dir: Optional[str] = None,
@@ -415,6 +427,8 @@ class EncodedBlockCache:
         self._last_replay: dict = {}      # segment key -> replay clock
         self._replay_clock = 0
         self._fingerprint = None
+        self._block_fps: dict = {}        # segment key -> [(off, len, hash)]
+        self._delta_memo: dict = {}       # (src, size, mtime) -> end | None
         self._committed = False
         self.n_blocks = 0
         self.evicted_bytes = 0
@@ -449,9 +463,30 @@ class EncodedBlockCache:
         self._seg_bytes = {}
         self._evicted = set()
         self._last_replay = {}
+        self._block_fps = {}
+        self._delta_memo = {}
         self._cur = self._COMBINED
         self.n_blocks = 0
         self.evicted_bytes = 0
+
+    def note_block(self, offset: int, data: bytes) -> None:
+        """Record the CONTENT fingerprint (offset + length + hash) of one
+        raw byte block of the currently-attributed source, whether or
+        not the block spills any payload (blank blocks cover bytes but
+        add no rows). Per-block fingerprints are what turn an appended
+        source from a total invalidation into a delta: the committed
+        blocks still content-match the file's prefix, so replay serves
+        them and only the appended tail re-parses (source_delta).
+        Writers that cannot see raw blocks — the shared-scan external
+        feed — simply never call this and keep the whole-file gate."""
+        from avenir_tpu.core.incremental import block_hash
+
+        if self._fingerprint is None:
+            raise RuntimeError("note_block() before begin()")
+        if self._committed:
+            raise RuntimeError("note_block() after commit()")
+        self._block_fps.setdefault(self._cur, []).append(
+            (int(offset), len(data), block_hash(data)))
 
     def set_source(self, index: int) -> None:
         """Attribute subsequent add_block() calls to source `index` —
@@ -573,9 +608,99 @@ class EncodedBlockCache:
         self._committed = False
 
     # ------------------------------------------------------------ replay
+    def _segment_key(self, index: int):
+        """Segment key serving source `index` (its own segment, or the
+        combined one when it is the only source), else _NO_SEGMENT."""
+        if index in self._seg_order:
+            return index
+        if self._COMBINED in self._seg_order and len(self.sources) == 1 \
+                and index == 0:
+            return self._COMBINED
+        return self._NO_SEGMENT
+
+    def _content_coverage(self, index: int) -> Optional[int]:
+        """Byte offset up to which source `index`'s recorded per-block
+        fingerprints still content-match the file, re-proven by hashing
+        the recorded ranges (memoized per (size, mtime_ns) snapshot so
+        per-k replay passes verify once, not once per pass). None when
+        no fingerprints were recorded, the serving segment is evicted
+        or absent, or ANY recorded block mismatches — coverage is
+        all-or-nothing: the cache replays every committed block of a
+        source or none of them."""
+        if not self._committed:
+            return None
+        key = self._segment_key(index)
+        if key is self._NO_SEGMENT or key in self._evicted \
+                or not os.path.exists(self._seg_path(key)):
+            return None
+        fps = self._block_fps.get(key)
+        if not fps:
+            return None
+        path = self.sources[index]
+        try:
+            st = os.stat(path)
+        except OSError:
+            return None
+        memo = (index, st.st_size, st.st_mtime_ns)
+        if memo not in self._delta_memo:
+            from avenir_tpu.core.incremental import verified_prefix
+
+            n, covered = verified_prefix(
+                path, [{"offset": o, "length": ln, "hash": h}
+                       for o, ln, h in fps])
+            self._delta_memo[memo] = covered if n == len(fps) else None
+        return self._delta_memo[memo]
+
+    def source_delta(self, index: int) -> Optional[int]:
+        """Byte offset at which source `index`'s cached coverage ends,
+        when its committed blocks are still a verified content PREFIX of
+        the current file — the appended-source replay gate: consumers
+        replay ``blocks(index, prefix=True)`` and re-parse only
+        ``[delta, size)``. None when the prefix itself no longer matches
+        (an in-place edit), the segment was evicted, the writer recorded
+        no fingerprints (external shared-scan feeds), or the coverage
+        ends MID-LINE on a grown file (the scanned corpus' last line had
+        no terminator, so the appended bytes extend an already-encoded
+        row — splicing a tail re-parse there would split one line into
+        two)."""
+        cov = self._content_coverage(index)
+        if cov is None:
+            return None
+        path = self.sources[index]
+        try:
+            size = os.path.getsize(path)
+        except OSError:
+            return None
+        if cov < size:
+            from avenir_tpu.core.incremental import ends_at_newline
+
+            if not ends_at_newline(path, cov):
+                return None
+        return cov
+
+    def _source_unchanged(self, index: int) -> bool:
+        rec = self._fingerprint[index]
+        path = self.sources[index]
+        try:
+            st = os.stat(path)
+            cur = (path, st.st_size, st.st_mtime_ns)
+        except OSError:
+            cur = (path, -1, -1)
+        if cur == rec:
+            return True
+        # mtime-only churn (touch, copy-back) must not torch the cache:
+        # the per-block content fingerprints re-prove the bytes; full
+        # validity needs them to cover the file END TO END
+        cov = self._content_coverage(index)
+        return cov is not None and cov == cur[1]
+
     def _fingerprint_ok(self) -> bool:
-        return (self._committed
-                and self._fingerprint == self._current_fingerprint())
+        if not self._committed or self._fingerprint is None:
+            return False
+        if self._fingerprint == self._current_fingerprint():
+            return True
+        return all(self._source_unchanged(i)
+                   for i in range(len(self.sources)))
 
     @property
     def valid(self) -> bool:
@@ -588,20 +713,19 @@ class EncodedBlockCache:
                         for k in self._seg_order))
 
     def source_valid(self, index: int) -> bool:
-        """True when source `index`'s blocks can replay: its own segment
-        survives, or the cache wrote one combined segment for a single
-        source. A multi-source combined segment cannot split, so it
-        replays only through the all-or-nothing `valid` gate."""
+        """True when source `index`'s blocks can replay IN FULL (the
+        file is covered end to end): its own segment survives, or the
+        cache wrote one combined segment for a single source. A multi-
+        source combined segment cannot split, so it replays only through
+        the all-or-nothing `valid` gate. An appended source fails this
+        gate but keeps the prefix gate: see source_delta()."""
         if not self._fingerprint_ok():
             return False
-        if index in self._seg_order:
-            return (index not in self._evicted
-                    and os.path.exists(self._seg_path(index)))
-        if self._COMBINED in self._seg_order and len(self.sources) == 1 \
-                and index == 0:
-            return (self._COMBINED not in self._evicted
-                    and os.path.exists(self._seg_path(self._COMBINED)))
-        return False
+        key = self._segment_key(index)
+        if key is self._NO_SEGMENT:
+            return False
+        return (key not in self._evicted
+                and os.path.exists(self._seg_path(key)))
 
     def _read_segment(self, key):
         import struct
@@ -627,14 +751,20 @@ class EncodedBlockCache:
         self._replay_clock += 1
         self._last_replay[key] = self._replay_clock
 
-    def blocks(self, source: Optional[int] = None):
+    def blocks(self, source: Optional[int] = None, prefix: bool = False):
         """Yield (counts int32 [n_rows], codes int32 [n_tokens]) per
         cached block — all segments in write order by default, one
-        source's segment with `source=i`. Raises RuntimeError when the
-        requested scope is not replayable — callers check `valid` /
-        `source_valid(i)` and fall back to the re-parse path."""
+        source's segment with `source=i`. With ``prefix=True`` the
+        per-source gate relaxes from full coverage to the verified-
+        content-prefix gate (source_delta): the appended-source replay,
+        where the caller re-parses the tail itself. Raises RuntimeError
+        when the requested scope is not replayable — callers check
+        `valid` / `source_valid(i)` / `source_delta(i)` and fall back
+        to the re-parse path."""
         if source is not None:
-            if not self.source_valid(source):
+            ok = self.source_valid(source) or (
+                prefix and self.source_delta(source) is not None)
+            if not ok:
                 raise RuntimeError(
                     f"encoded-block segment for source {source} is "
                     f"stale, evicted or absent")
@@ -736,16 +866,24 @@ class SpillScanMixin:
         through _scan_block, then seal. Blocks attribute to per-source
         cache segments so a budget eviction drops whole sources, not the
         whole cache (the SharedScan feed below cannot attribute and
-        writes one combined segment)."""
+        writes one combined segment), and every block's content
+        fingerprint is recorded (note_block) so an appended source later
+        replays its committed prefix and re-parses only the tail."""
         from avenir_tpu.core.stream import iter_byte_blocks, prefetched
 
         self._scan_begin()
         for si, path in enumerate(self.paths):
             if self._cache is not None:
                 self._cache.set_source(si)
-            for data in prefetched(iter_byte_blocks(path, self.block_bytes),
-                                   depth=1):
-                self._scan_block(data)
+                for off, data in prefetched(
+                        iter_byte_blocks(path, self.block_bytes,
+                                         with_offsets=True), depth=1):
+                    self._cache.note_block(off, data)
+                    self._scan_block(data)
+            else:
+                for data in prefetched(
+                        iter_byte_blocks(path, self.block_bytes), depth=1):
+                    self._scan_block(data)
         return self._scan_finish()
 
     def scan_consumer(self):

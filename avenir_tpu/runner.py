@@ -131,9 +131,17 @@ def _add_mem_counters(canonical: str, cfg: JobConfig,
                       inputs: Sequence[str], res: JobResult) -> None:
     """Attach the memory-oracle counters to a streamed job's result.
     Advisory by contract: a failure to PREDICT must never fail a job
-    that already ran, so any error here drops the counters silently."""
+    that already ran, so any error here drops the counters silently.
+
+    Every streamed result also carries the delta-scan accounting triple
+    next to the Mem:*/Cache:* counters — run_incremental fills the real
+    numbers before this runs; a plain (cold) run keeps the zeros, so
+    every streamed JobResult speaks one counter schema."""
     if canonical not in _STREAM_FOLDS:
         return
+    res.counters.setdefault("Cache:HitBlocks", 0.0)
+    res.counters.setdefault("Cache:DeltaBlocks", 0.0)
+    res.counters.setdefault("Resume:SkippedBytes", 0.0)
     try:
         import resource
 
@@ -146,14 +154,19 @@ def _add_mem_counters(canonical: str, cfg: JobConfig,
         # exact for the one-job-per-process scale anchors, an upper
         # bound inside long-lived processes
         rss = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss * 1024
-        block = int(cfg.get_float("stream.block.size.mb", 64.0) * (1 << 20))
-        stats = corpus_stats(paths, delim=cfg.field_delim_regex)
-        schema = None
-        schema_path = cfg.get("feature.schema.file.path")
-        if schema_path:
-            schema = FeatureSchema.from_file(schema_path)
-        est = footprint_model(canonical, block, schema, stats)
-        res.counters["Mem:PredictedPeakBytes"] = float(est.total_bytes)
+        if "Mem:PredictedPeakBytes" not in res.counters:
+            # run_incremental already priced the scan (its checkpoint
+            # advisory) and pre-set the counter — don't re-sample the
+            # corpus for the same number
+            block = int(cfg.get_float("stream.block.size.mb", 64.0)
+                        * (1 << 20))
+            stats = corpus_stats(paths, delim=cfg.field_delim_regex)
+            schema = None
+            schema_path = cfg.get("feature.schema.file.path")
+            if schema_path:
+                schema = FeatureSchema.from_file(schema_path)
+            est = footprint_model(canonical, block, schema, stats)
+            res.counters["Mem:PredictedPeakBytes"] = float(est.total_bytes)
         res.counters["Mem:PeakRSS"] = float(rss)
     except Exception:
         pass
@@ -809,7 +822,8 @@ def stream_fold_names() -> List[str]:
 def stream_fold_ops(job: str) -> StreamFoldOps:
     """The registered fold-sink ops of a streamed job (accepts
     aliases) — the public handle the merge auditor, the multi-host
-    merge path and the future resumable-scan driver all share."""
+    merge path and the incremental delta-scan driver
+    (:func:`run_incremental`) all share."""
     canonical = _REGISTRY[job][0] if job in _REGISTRY else job
     if canonical not in _STREAM_FOLDS:
         raise KeyError(
@@ -890,6 +904,233 @@ def run_shared(specs: Sequence[Tuple[str, object, str]],
             cfg for c, _k, cfg, _f, _o in built if c == canonical),
             inputs, results[canonical])
     return results
+
+
+# ====================================================== incremental driver
+def _incremental_state_dir(cfg: JobConfig, canonical: str,
+                           inputs: Sequence[str]) -> str:
+    """Where a job's delta-scan state (block fingerprints + fold-carry
+    checkpoints) lives across runs: `stream.incremental.state.dir` when
+    configured, else a `.avenir_incremental/<job>_<corpus digest>`
+    directory next to the first input — deterministic per (job, input
+    set), so a rerun of the same job over the same corpus finds its own
+    state and two jobs over one corpus never collide."""
+    import hashlib
+
+    explicit = cfg.get("stream.incremental.state.dir")
+    if explicit:
+        return explicit
+    digest = hashlib.blake2b(
+        "\0".join([canonical] + [os.path.abspath(p) for p in inputs])
+        .encode(), digest_size=8).hexdigest()
+    base = os.path.dirname(os.path.abspath(inputs[0]))
+    return os.path.join(base, ".avenir_incremental",
+                        f"{canonical}_{digest}")
+
+
+def _conf_digest(cfg: JobConfig) -> str:
+    """Content digest of the configuration a checkpoint's carry was
+    folded under: every prefixed property (minus the state-dir key,
+    which only names WHERE the checkpoint lives) plus the schema file's
+    bytes when one is configured. A restored carry must have parsed its
+    prefix under the same view of the corpus the delta will be parsed
+    under — any conf or schema-content change invalidates the
+    checkpoint. Deliberately conservative: a changed block size or
+    checkpoint interval also re-scans cold (folds are proven
+    chunk-invariant, but a rare cold refresh is cheaper than reasoning
+    about which keys are view-affecting as the conf surface grows)."""
+    import hashlib
+
+    h = hashlib.sha1()
+    for k in sorted(cfg.props):
+        if "incremental.state.dir" in k:
+            continue
+        h.update(f"{k}={cfg.props[k]}\n".encode())
+    schema_path = cfg.get("feature.schema.file.path")
+    if schema_path:
+        try:
+            with open(schema_path, "rb") as fh:
+                h.update(fh.read())
+        except OSError:
+            h.update(b"<unreadable schema>")
+    return h.hexdigest()
+
+
+def run_incremental(name: str, conf, inputs: Sequence[str],
+                    output: str = "",
+                    state_dir: Optional[str] = None) -> JobResult:
+    """Run a streamed job INCREMENTALLY: restore the last serialized
+    fold carry, fold only the byte blocks past its watermark, and
+    re-emit the artifact — O(delta) instead of O(corpus) for an
+    append-mostly corpus, byte-identical to a cold full scan by the
+    proven fold-state merge algebra (graftlint --merge re-proves it
+    every round).
+
+    Mechanism: a per-(job, corpus) CheckpointStore
+    (core.incremental, see `state_dir` / the
+    `stream.incremental.state.dir` key) holds the newest carry
+    (StreamFoldOps.serialize_state npz) plus the content fingerprints
+    (offset + length + hash) of every block it covers. On entry the
+    recorded fingerprints are re-verified against the current files:
+    a verified prefix restores the carry and skips its bytes; anything
+    else — a torn/truncated checkpoint, an in-place edit, a different
+    input list — falls back to a cold scan (never to a wrong artifact).
+    While scanning, the carry is re-checkpointed every
+    `stream.checkpoint.interval.mb` (atomic write; a torn checkpoint
+    never commits), so a killed scan resumes mid-corpus from its last
+    watermark instead of byte 0. The final checkpoint (complete=True)
+    is what the next append-refresh restores.
+
+    The result carries the delta accounting next to the usual stream
+    counters: Cache:HitBlocks (restored, fingerprint-verified blocks),
+    Cache:DeltaBlocks (blocks folded this run) and Resume:SkippedBytes
+    (bytes the restored carry covered)."""
+    from avenir_tpu.core import incremental as incr
+    from avenir_tpu.core.stream import (is_blank_block, iter_byte_blocks,
+                                        prefetched)
+
+    canonical, _prefix, cfg = _job_cfg(name, conf)
+    ops = stream_fold_ops(canonical)
+    inputs = [str(p) for p in inputs]
+    abs_inputs = [os.path.abspath(p) for p in inputs]
+    block = int(cfg.get_float("stream.block.size.mb", 64.0) * (1 << 20))
+    interval = int(cfg.get_float("stream.checkpoint.interval.mb", 256.0)
+                   * (1 << 20))
+    schema = _schema(cfg) if ops.kind == "dataset" else None
+    delim = cfg.field_delim_regex
+    conf_digest = _conf_digest(cfg)
+    store = incr.CheckpointStore(
+        state_dir or _incremental_state_dir(cfg, canonical, inputs))
+
+    # ------------------------------------------------------ restore plan
+    fold = None
+    watermarks = [0] * len(inputs)
+    fps: List[list] = [[] for _ in inputs]
+    hit_blocks = 0
+    skipped = 0
+    seq = 0
+    loaded = store.load()
+    if loaded is not None:
+        meta, blob = loaded
+        seq = int(meta.get("seq", 0))
+        old_inputs = [str(p) for p in meta.get("inputs", [])]
+        # the recorded input list must be a PREFIX of the current one
+        # (append-only at the corpus level too: new source files fold
+        # wholly, like appended bytes); any other change — including a
+        # conf or schema-content change, which would parse the delta
+        # under a different view than the restored prefix — is a cold
+        # scan
+        usable = (meta.get("format") == 1
+                  and meta.get("job") == canonical
+                  and meta.get("conf_digest") == conf_digest
+                  and old_inputs == abs_inputs[:len(old_inputs)])
+        if usable:
+            wm, kept = [], []
+            for path, src_fps in zip(inputs, meta.get("fingerprints", [])):
+                n, covered = incr.verified_prefix(path, src_fps)
+                if n != len(src_fps):
+                    usable = False      # stale: an in-place edit — cold
+                    break
+                if covered < os.path.getsize(path) \
+                        and not incr.ends_at_newline(path, covered):
+                    # the corpus' last line had no terminator, so the
+                    # appended bytes EXTEND the already-folded row —
+                    # resuming would skip its continuation: cold scan
+                    usable = False
+                    break
+                wm.append(covered)
+                kept.append(list(src_fps))
+            if usable:
+                try:
+                    fold = ops.restore_state(cfg, inputs, blob,
+                                             schema=schema)
+                except Exception:
+                    fold = None         # unloadable carry: cold scan
+            if fold is not None:
+                watermarks[:len(wm)] = wm
+                fps[:len(kept)] = kept
+                hit_blocks = sum(len(x) for x in kept)
+                skipped = sum(wm)
+    if fold is None:
+        watermarks = [0] * len(inputs)
+        fps = [[] for _ in inputs]
+        hit_blocks = 0
+        skipped = 0
+        fold = ops.factory(cfg, inputs, schema)
+
+    # the checkpoint footprint is priced against the graftlint-mem
+    # analytic model (advisory: the oracle the job-server admission
+    # layer consumes; a failure to predict never fails the scan)
+    predicted = None
+    try:
+        from avenir_tpu.analysis.mem import corpus_stats, footprint_model
+
+        stats = corpus_stats([p for p in inputs if os.path.exists(p)],
+                             delim=delim)
+        predicted = int(footprint_model(canonical, block, schema,
+                                        stats).total_bytes)
+    except Exception:
+        pass
+
+    def checkpoint(complete: bool) -> None:
+        nonlocal seq
+        seq += 1
+        blob = ops.serialize_state(fold)
+        meta = {"format": 1, "job": canonical, "seq": seq,
+                "conf_digest": conf_digest,
+                "inputs": abs_inputs, "block_bytes": block,
+                "watermarks": list(watermarks), "fingerprints": fps,
+                "complete": complete,
+                "predicted_peak_bytes": predicted}
+        saved = store.save(meta, blob)
+        hook = incr._checkpoint_hook
+        if hook is not None:
+            hook(saved)
+
+    # ------------------------------------------------------- delta fold
+    delta_blocks = 0
+    since_ckpt = 0
+    for si, path in enumerate(inputs):
+        size = os.path.getsize(path)
+        start = watermarks[si]
+        if start >= size:
+            continue
+        feed = prefetched(iter_byte_blocks(path, block,
+                                           byte_range=(start, size),
+                                           with_offsets=True), depth=1)
+        try:
+            for off, data in feed:
+                if not is_blank_block(data):
+                    if ops.kind == "dataset":
+                        fold.consume(Dataset.from_csv(data, schema,
+                                                      delim=delim))
+                    else:
+                        fold.consume(data)
+                fps[si].append(incr.block_fingerprint(off, data))
+                watermarks[si] = off + len(data)
+                delta_blocks += 1
+                since_ckpt += len(data)
+                if since_ckpt >= interval:
+                    checkpoint(complete=False)
+                    since_ckpt = 0
+        finally:
+            feed.close()
+    # the final (complete) checkpoint is what the next append restores;
+    # it is written BEFORE finish() so the carry never reflects a
+    # finished/sealed fold
+    checkpoint(complete=True)
+
+    if output:
+        parent = os.path.dirname(os.path.abspath(output))
+        os.makedirs(parent, exist_ok=True)
+    res = fold.finish(output)
+    res.counters["Cache:HitBlocks"] = float(hit_blocks)
+    res.counters["Cache:DeltaBlocks"] = float(delta_blocks)
+    res.counters["Resume:SkippedBytes"] = float(skipped)
+    if predicted is not None:
+        res.counters["Mem:PredictedPeakBytes"] = float(predicted)
+    _add_mem_counters(canonical, cfg, inputs, res)
+    return res
 
 
 # =================================================================== bayesian
@@ -2544,6 +2785,10 @@ def run_from_cli(argv: Sequence[str]) -> JobResult:
     ap.add_argument("jobname", help="job name or reference Tool class")
     ap.add_argument("--conf", required=False, default=None,
                     help="properties file (the -Dconf.path analog)")
+    ap.add_argument("--incremental", action="store_true",
+                    help="delta-scan a streamed job: restore the last "
+                         "fold-state checkpoint and fold only appended "
+                         "blocks (run_incremental)")
     ap.add_argument("paths", nargs="*", help="input paths... output path")
     # intermixed: `jobname --conf props IN OUT` splits the positionals
     # around the optional, which plain parse_args cannot reassemble
@@ -2563,7 +2808,8 @@ def run_from_cli(argv: Sequence[str]) -> JobResult:
     short = args.jobname.rsplit(".", 1)[-1]
     name = args.jobname if args.jobname in _REGISTRY else short[0].lower() + short[1:]
     inputs, output = args.paths[:-1], args.paths[-1]
-    res = run_job(name, props, inputs, output)
+    runner = run_incremental if args.incremental else run_job
+    res = runner(name, props, inputs, output)
     print(json.dumps({"job": res.name, "counters": res.counters,
                       "outputs": res.outputs}))
     return res

@@ -101,6 +101,16 @@ def graftlint_tripwire() -> dict:
         raise RuntimeError(
             f"shard-merge audit regression: {len(ma)} streamed kernels "
             f"audited, drifted={unmerged}")
+    # the delta-scan driver's leg of the same audit: append a tail to a
+    # prefix corpus, run the real incremental driver (with a mid-delta
+    # kill + resume), assert byte-identity vs the cold full scan — 8/8
+    # incremental_validated every round
+    unincr = [r["kernel"] for r in ma
+              if not r.get("incremental_validated")]
+    if unincr:
+        raise RuntimeError(
+            f"incremental-scan audit regression: append/resume output "
+            f"drifted for {unincr}")
     # re-derive the admission oracle and pin it next to the scale
     # records so the job-server work consumes a fresh artifact, not a
     # stale hand-written one
@@ -123,6 +133,7 @@ def graftlint_tripwire() -> dict:
             "merge_findings": 0,
             "merge_allowlisted": merge_rep["suppressed"],
             "merge_kernels_validated": len(ma),
+            "incremental_kernels_validated": len(ma) - len(unincr),
             "memory_manifest": "MEMORY_MANIFEST.json"}
 
 
@@ -214,6 +225,80 @@ def miner_tripwire(rows: int = 20_000) -> dict:
                               "cache_bytes": cache_bytes,
                               "csv_bytes": csv_bytes}
         return out
+    finally:
+        shutil.rmtree(d, ignore_errors=True)
+
+
+def incremental_tripwire(rows: int = 10_000_000, floor: float = 5.0) -> dict:
+    """Delta-scan perf tripwire: after a ~1% append, run_incremental
+    must reproduce the cold full re-scan's bytes while beating its wall
+    time by `floor`x — the O(delta) claim of the incremental driver,
+    re-proven at proxy scale every bench round (tools/stream_scale_check
+    --incremental records the 10M/100M-row anchor; the merge auditor's
+    incremental leg proves byte-identity on every family).
+
+    Method: one cold pass through the driver seeds the fold-state
+    checkpoint + block fingerprints (and warms the jit caches for both
+    timed sides), then a 1% append, then the timed cold re-scan
+    (run_job) vs the timed incremental refresh (run_incremental)."""
+    import os
+    import shutil
+    import time
+
+    from avenir_tpu.data import churn_schema, generate_churn
+    from avenir_tpu.runner import run_incremental, run_job
+
+    d = tempfile.mkdtemp(prefix="avenir_incr_tripwire_")
+    try:
+        blob = generate_churn(100_000, seed=21, as_csv=True)
+        csv = os.path.join(d, "churn.csv")
+        with open(csv, "w") as fh:
+            for _ in range(max(rows // 100_000, 1)):
+                fh.write(blob)
+        schema = os.path.join(d, "churn.json")
+        churn_schema().save(schema)
+        conf = {"mut.feature.schema.file.path": schema,
+                "mut.mutual.info.score.algorithms":
+                    "mutual.info.maximization"}
+        state = os.path.join(d, "state")
+        run_incremental("mutualInformation", conf, [csv],
+                        os.path.join(d, "out_seed.txt"), state_dir=state)
+        appended = max(rows // 100, 1_000)
+        with open(csv, "a") as fh:
+            fh.write(generate_churn(appended, seed=22, as_csv=True))
+        t0 = time.perf_counter()
+        cold = run_job("mutualInformation", conf, [csv],
+                       os.path.join(d, "out_cold.txt"))
+        t_cold = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        incr = run_incremental("mutualInformation", conf, [csv],
+                               os.path.join(d, "out_incr.txt"),
+                               state_dir=state)
+        t_incr = time.perf_counter() - t0
+        with open(cold.outputs[0], "rb") as fa, \
+                open(incr.outputs[0], "rb") as fb:
+            if fa.read() != fb.read():
+                raise RuntimeError(
+                    "incremental refresh output drifted from the cold "
+                    "full re-scan — the delta fold is wrong, not slow")
+        if incr.counters.get("Resume:SkippedBytes", 0) <= 0 \
+                or incr.counters.get("Cache:HitBlocks", 0) <= 0:
+            raise RuntimeError(
+                "incremental refresh did not restore a checkpoint / skip "
+                "the unchanged prefix (it re-scanned cold)")
+        speedup = t_cold / max(t_incr, 1e-9)
+        if speedup < floor:
+            raise RuntimeError(
+                f"incremental refresh only {speedup:.2f}x faster than "
+                f"the cold re-scan (floor {floor}x) — the O(delta) "
+                f"append path regressed")
+        return {"speedup": round(speedup, 2), "floor": floor,
+                "t_cold_s": round(t_cold, 2),
+                "t_incremental_s": round(t_incr, 2),
+                "rows": rows, "appended_rows": appended,
+                "skipped_bytes": int(incr.counters["Resume:SkippedBytes"]),
+                "delta_blocks": int(incr.counters["Cache:DeltaBlocks"]),
+                "outputs_byte_identical": True}
     finally:
         shutil.rmtree(d, ignore_errors=True)
 
@@ -339,6 +424,12 @@ def main(n_devices: int = 8, quick: bool = False):
     line["miner_tripwire"] = miner_tripwire(4_000 if quick else 20_000)
     line["shared_scan_tripwire"] = shared_scan_tripwire(
         6_000 if quick else 30_000)
+    # quick mode shrinks the corpus below where the fixed per-run costs
+    # (checkpoint IO, footprint advisory) amortize, so the floor relaxes;
+    # the real >=5x gate runs at the 10M-row proxy every full round
+    line["incremental_tripwire"] = (
+        incremental_tripwire(100_000, floor=1.3) if quick
+        else incremental_tripwire())
     line["graftlint"] = graftlint_tripwire()
     print(json.dumps(line))
 

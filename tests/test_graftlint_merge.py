@@ -62,6 +62,14 @@ def test_merge_gate_clean_and_all_stream_kernels_validated():
         # must record that contract for the straggler designs
         assert row["overlap"]["contract"] in ("non-idempotent",
                                               "overlap-insensitive"), row
+        # the incremental leg ran through the REAL delta-scan driver:
+        # append byte-identity, a genuine mid-delta kill, and a resume
+        # that actually skipped the restored prefix
+        assert row["incremental_validated"], row
+        inc = row["incremental"]
+        assert inc["byte_identical"] and inc["resume_interrupted"], row
+        assert inc["skipped_bytes"] > 0 and inc["hit_blocks"] > 0, row
+        assert 1 <= inc["prefix_blocks"] < inc["blocks"], row
 
 
 def test_every_stream_entry_carries_fold_specs():
@@ -300,7 +308,9 @@ def test_auditor_flags_a_corpus_too_small_to_shard(tmp_path):
         jobs=spec.jobs, fold_specs=spec.fold_specs)
     row, finding = audit_merge(tiny)
     assert row["merge_validated"] is False
+    assert row["incremental_validated"] is False
     assert row["shards"] == [] and row["checkpoint"] is None
+    assert row["incremental"] is None
     assert finding is not None and finding.rule == MERGE_AUDIT_RULE
     assert "too small" in finding.message
 

@@ -9,8 +9,11 @@
    prefetched() feed (worker cancelled AND joined, the PR-4 _Prefetcher
    guarantee): no wedged or leaked producer thread.
 3. Cache — cold build / warm replay identity / invalidation when a
-   source file changes (size+mtime fingerprint), at both the
-   EncodedBlockCache level and the miner-source level.
+   source file changes, at both the EncodedBlockCache level and the
+   miner-source level. Validity is per-block (content fingerprints):
+   an APPENDED source replays its committed prefix and re-parses only
+   the tail (source_delta); an in-place edit, or a writer that never
+   recorded fingerprints, invalidates the whole source as before.
 """
 
 import os
@@ -339,6 +342,109 @@ def test_miner_source_replays_warm_and_invalidates_on_change(tmp_path):
     src2.close()
 
 
+def test_miner_cache_appended_source_replays_prefix(tmp_path):
+    """Per-block fingerprints: an append no longer invalidates the whole
+    cached source — the committed blocks replay (prefix gate) and only
+    the appended tail re-parses; the per-k counting still sees every
+    current row. An mtime-only touch keeps even the full-coverage
+    gate."""
+    from avenir_tpu.models.association import StreamingTransactionSource
+
+    csv = _seq(tmp_path, rows=400)
+    src = StreamingTransactionSource([csv], skip_field_count=2,
+                                     block_bytes=2048)
+    src.scan_items()
+    cache = src._cache
+    assert cache is not None and cache.valid
+    old_size = os.path.getsize(csv)
+    # mtime churn without a content change: content fingerprints re-prove
+    # the bytes, the cache stays fully valid
+    os.utime(csv, (10 ** 9, 10 ** 9))
+    assert cache.valid and cache.source_valid(0)
+    # append: full-coverage gates drop, the prefix gate holds
+    with open(csv, "a") as fh:
+        fh.write("cX,T,L,L,L,L,L,L\n")
+    assert not cache.valid and not cache.source_valid(0)
+    assert cache.source_delta(0) == old_size
+    replays_before = cache.replays
+    src.mask_items(range(len(src.vocab)))
+    rows_seen = sum(int(mh.any(axis=1).sum())
+                    for mh in src._dense_chunks(8192))
+    assert rows_seen == 401              # prefix replayed + tail parsed
+    assert cache.replays > replays_before
+    # in-place edit: the prefix gate drops too — full re-parse
+    data = bytearray(open(csv, "rb").read())
+    data[0] = ord("X")
+    open(csv, "wb").write(bytes(data))
+    assert cache.source_delta(0) is None
+    src.close()
+
+
+def test_cache_blocks_prefix_gate_contract(tmp_path):
+    """blocks(i, prefix=True) serves an appended source and refuses an
+    edited one; the fingerprint-free direct-write path (no note_block)
+    never gains the prefix gate."""
+    src_file = tmp_path / "corpus.csv"
+    src_file.write_text("a,b,c\n" * 50)
+    cache = EncodedBlockCache([str(src_file)],
+                              cache_dir=str(tmp_path / "c"),
+                              byte_budget=1 << 20)
+    cache.begin()
+    cache.set_source(0)
+    data = src_file.read_bytes()
+    cache.note_block(0, data)
+    cache.add_block(np.array([3], np.int64), np.array([0, 1, 2], np.int32))
+    assert cache.commit()
+    with open(src_file, "a") as fh:
+        fh.write("d,e,f\n")
+    assert not cache.source_valid(0)
+    assert cache.source_delta(0) == len(data)
+    got = list(cache.blocks(0, prefix=True))
+    assert len(got) == 1
+    # without prefix=True the appended source still refuses
+    with pytest.raises(RuntimeError):
+        list(cache.blocks(0))
+    # a writer that recorded no fingerprints has no prefix gate
+    cache2 = EncodedBlockCache([str(src_file)],
+                               cache_dir=str(tmp_path / "c2"),
+                               byte_budget=1 << 20)
+    cache2.begin()
+    cache2.add_block(np.array([1], np.int64), np.array([0], np.int32))
+    assert cache2.commit()
+    with open(src_file, "a") as fh:
+        fh.write("g,h,i\n")
+    assert cache2.source_delta(0) is None
+    cache.close()
+    cache2.close()
+
+
+def test_cache_prefix_gate_refuses_midline_coverage(tmp_path):
+    """An appended source whose scanned bytes ended WITHOUT a trailing
+    newline keeps full-coverage replay while unchanged, but has no
+    prefix gate once it grows: the appended bytes extend the last
+    encoded row, so splicing cached replay with a tail re-parse would
+    split one line into two."""
+    src_file = tmp_path / "corpus.csv"
+    src_file.write_bytes(b"a,b,c\n" * 50 + b"x,y,z")   # no terminator
+    cache = EncodedBlockCache([str(src_file)],
+                              cache_dir=str(tmp_path / "c"),
+                              byte_budget=1 << 20)
+    cache.begin()
+    cache.set_source(0)
+    data = src_file.read_bytes()
+    cache.note_block(0, data)
+    cache.add_block(np.array([3], np.int64), np.array([0, 1, 2], np.int32))
+    assert cache.commit()
+    # unchanged: mid-line END of a fully-covered file is fine
+    assert cache.source_valid(0)
+    assert cache.source_delta(0) == len(data)
+    with open(src_file, "ab") as fh:
+        fh.write(b",w\nq,r,s\n")            # the last row grew a tail
+    assert not cache.source_valid(0)
+    assert cache.source_delta(0) is None    # full re-parse, no splice
+    cache.close()
+
+
 def test_gsp_source_replay_matches_reparse(tmp_path):
     from avenir_tpu.models.sequence import GSPMiner, StreamingSequenceSource
 
@@ -351,6 +457,27 @@ def test_gsp_source_replay_matches_reparse(tmp_path):
     assert m.mine_stream(s1) == m.mine_stream(s2)
     assert s1.cache_replays >= 1 and s2.cache_replays == 0
     s1.close()
+    # appended source: the prefix replays from the cache, the tail
+    # re-parses, and the padded chunks match a cache-less source's
+    s3 = StreamingSequenceSource([csv], skip_field_count=2,
+                                 block_bytes=2048)
+    s3.scan()
+    old = os.path.getsize(csv)
+    with open(csv, "a") as fh:
+        fh.write("cX,T,L,M,H,L,M,H\n")
+    assert s3._cache.source_delta(0) == old
+    s4 = StreamingSequenceSource([csv], skip_field_count=2,
+                                 block_bytes=2048, spill_cache=False)
+    s4.scan()
+    s3.mask_tokens(range(len(s3.vocab)))
+    s4.mask_tokens(range(len(s4.vocab)))
+    a = [blk for blk in s3.chunks(1024)]
+    b = [blk for blk in s4.chunks(1024)]
+    assert sum(int((blk >= 0).any(axis=1).sum()) for blk in a) \
+        == sum(int((blk >= 0).any(axis=1).sum()) for blk in b) == 401
+    assert s3.cache_replays >= 1
+    s3.close()
+    s4.close()
 
 
 # ------------------------------------------------------ auditor coverage

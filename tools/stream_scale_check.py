@@ -19,6 +19,17 @@ CSV scans) and then FUSED through runner.run_shared (ONE scan, three fold
 sinks), recording the speedup ratio and asserting the fused outputs are
 byte-identical to the sequential ones.
 
+With --incremental, additionally measures the delta-scan driver: a copy
+of the churn corpus is cold-seeded through runner.run_incremental (block
+fingerprints + final fold-state checkpoint), ~1% of rows are appended,
+and the incremental refresh is timed against a cold full re-scan of the
+appended file — byte-identity asserted, speedup recorded as the
+incremental anchor of the round's STREAM_SCALE record. Both sides run
+in a fresh child process, so ~8s of interpreter+jit startup is priced
+into each: the anchor is meaningful at the 10M/100M-row scales this
+tool exists for (bench_scaling.incremental_tripwire is the in-process
+>=5x gate at the 10M proxy).
+
 Writes one JSON line per job and a summary to STREAM_SCALE_r05.json
 (merged into any existing records, so a partial re-run never erases
 previously recorded jobs). Works on CPU (pins the platform; the point is
@@ -32,7 +43,8 @@ a merge algebra. --no-audits skips them (they add a couple of minutes
 of proxy-scale runs next to an hours-long 100M anchor).
 
 Usage: python tools/stream_scale_check.py [--rows N_MILLION] [--extra]
-                                          [--fused] [--no-audits]
+                                          [--fused] [--incremental]
+                                          [--no-audits]
 """
 
 import json
@@ -94,6 +106,25 @@ print(json.dumps({"job": "sharedScan", "jobs": sorted(res),
 '''
 
 
+_CHILD_INCR = r'''
+import json, os, resource, sys, time
+sys.path.insert(0, ".")
+import jax
+jax.config.update("jax_platforms", "cpu")
+from avenir_tpu.runner import run_incremental
+
+job, conf_json, inp, out, state = sys.argv[1:6]
+t0 = time.perf_counter()
+res = run_incremental(job, json.loads(conf_json), [inp], out,
+                      state_dir=state)
+dt = time.perf_counter() - t0
+rss = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / 1024.0
+print(json.dumps({"job": job, "seconds": round(dt, 1),
+                  "peak_rss_mb": round(rss, 1),
+                  "counters": res.counters, "outputs": res.outputs}))
+'''
+
+
 def ensure_file(path, blob, reps):
     want = len(blob.encode()) * reps
     if os.path.exists(path) and os.path.getsize(path) == want:
@@ -104,10 +135,13 @@ def ensure_file(path, blob, reps):
     os.replace(path + ".tmp", path)
 
 
-def run_child(job, conf, inp, out):
+def run_child(job, conf, inp, out, incremental_state=None):
     env = dict(os.environ, AVENIR_SKIP_DEVICE_PROBE="1")
-    proc = subprocess.run([sys.executable, "-c", _CHILD, job,
-                           json.dumps(conf), inp, out],
+    argv = ([sys.executable, "-c", _CHILD_INCR, job, json.dumps(conf),
+             inp, out, incremental_state] if incremental_state
+            else [sys.executable, "-c", _CHILD, job, json.dumps(conf),
+                  inp, out])
+    proc = subprocess.run(argv,
                           capture_output=True, text=True, timeout=7200,
                           env=env)
     if proc.returncode != 0:
@@ -256,6 +290,50 @@ def main():
         fused["speedup"] = round(seq_s / fused["seconds"], 2)
         fused["outputs_byte_identical"] = True
         results["sharedScan"] = fused
+    if "--incremental" in sys.argv:
+        # delta-scan anchor: cold-seed the driver's state on a COPY of
+        # the churn corpus (the shared cached corpus file must keep its
+        # exact size for ensure_file), append ~1% of rows, then time
+        # incremental refresh vs cold full re-scan — byte-identical
+        import shutil
+
+        base = CHURN_CSV.replace(".csv", "_incr.csv")
+        shutil.copyfile(CHURN_CSV, base)
+        state = f"/tmp/avenir_scale_incr_state_{ROWS_M}m"
+        shutil.rmtree(state, ignore_errors=True)
+        conf = {"mut.feature.schema.file.path": schema_path,
+                "mut.mutual.info.score.algorithms":
+                    "mutual.info.maximization"}
+        seed = run_child("mutualInformation", conf, base,
+                         "/tmp/avenir_scale_incr_seed.txt",
+                         incremental_state=state)
+        from avenir_tpu.data import generate_churn as _gen
+
+        append_blob = _gen(100_000, seed=10, as_csv=True)
+        with open(base, "a") as fh:
+            for _ in range(max(ROWS_M // 10, 1)):   # ~1% of the corpus
+                fh.write(append_blob)
+        cold = run_child("mutualInformation", conf, base,
+                         "/tmp/avenir_scale_incr_cold.txt")
+        incr = run_child("mutualInformation", conf, base,
+                         "/tmp/avenir_scale_incr_refresh.txt",
+                         incremental_state=state)
+        with open("/tmp/avenir_scale_incr_cold.txt", "rb") as fa, \
+                open("/tmp/avenir_scale_incr_refresh.txt", "rb") as fb:
+            assert fa.read() == fb.read(), \
+                "incremental refresh output != cold full re-scan"
+        results["incremental"] = {
+            "seed_seconds": seed["seconds"],
+            "cold_seconds": cold["seconds"],
+            "incremental_seconds": incr["seconds"],
+            "speedup": round(cold["seconds"]
+                             / max(incr["seconds"], 0.1), 2),
+            "skipped_bytes": incr["counters"].get("Resume:SkippedBytes"),
+            "hit_blocks": incr["counters"].get("Cache:HitBlocks"),
+            "delta_blocks": incr["counters"].get("Cache:DeltaBlocks"),
+            "outputs_byte_identical": True,
+        }
+        os.remove(base)
     merged = {}
     if os.path.exists(RECORD):
         try:
@@ -285,6 +363,10 @@ def main():
         if isinstance(line, dict) and "mem_model_delta_pct" in line}
     if "sharedScan" in results:
         summary["shared_scan_speedup"] = results["sharedScan"]["speedup"]
+    # the incremental-speedup column: O(delta) refresh vs O(corpus)
+    # re-scan after a ~1% append, byte-identity already asserted above
+    if "incremental" in results:
+        summary["incremental_speedup"] = results["incremental"]["speedup"]
     # the two streaming-correctness columns, side by side: the folds the
     # numbers above measured are chunk-layout-invariant AND a merge
     # algebra (shard-merge + checkpoint-resume byte-identical)
