@@ -569,6 +569,80 @@ def _incremental_leg(workdir: str, jobs_ctx: List[tuple],
         "skipped_bytes": min(int(c["Resume:SkippedBytes"]) for c in cs),
         "resume_interrupted": interrupted,
         "byte_identical": art == baseline,
+        "fused": _fused_incremental_leg(workdir, jobs_ctx, blocks,
+                                        baseline),
+    }
+
+
+def _fused_incremental_leg(workdir: str, jobs_ctx: List[tuple],
+                           blocks: List[bytes], baseline: bytes) -> dict:
+    """(e) FUSED incremental leg, through the batched delta-scan driver
+    (runner.run_incremental_shared — the job server's refresh path):
+    cold-seed ALL the entry's jobs' checkpoints with one fused call
+    over a prefix corpus, append the remaining blocks, kill the fused
+    refresh right after its first mid-delta checkpoint, and re-run —
+    every job must restore its carry, the group must fold the delta
+    through ONE SharedScan, and the finished artifacts must reproduce
+    the cold full scan's bytes. Single-job entries run the same driver
+    with a one-spec group, so the fused path is proven on all 8
+    streamed kernels every round, not just the two fused entries."""
+    from avenir_tpu.core import incremental as incr
+    from avenir_tpu.runner import run_incremental_shared
+
+    grow = os.path.join(workdir, "grow_fused.csv")
+    half = max(1, len(blocks) // 2)
+    with open(grow, "wb") as fh:
+        fh.write(b"".join(blocks[:half]))
+    multi = len(jobs_ctx) > 1
+    state_dirs = {job: os.path.join(workdir, f"fincr_state_{job}")
+                  for job, _p, _pr, _c, _o in jobs_ctx}
+
+    def run_fused(tag: str):
+        specs = []
+        for job, prefix, props, _cfg, _ops in jobs_ctx:
+            p = dict(props)
+            # checkpoint every block so the kill probe has a mid-delta
+            # watermark to die at (and resume from)
+            p[f"{prefix}.stream.checkpoint.interval.mb"] = "0.00001"
+            specs.append((job, p, os.path.join(workdir,
+                                               f"fincr_{tag}_{job}")))
+        shared = run_incremental_shared(specs, [grow],
+                                        state_dirs=state_dirs)
+        blobs: List[bytes] = []
+        for job, _prefix, _props, _cfg, _ops in jobs_ctx:
+            res = shared[job]
+            blobs.extend(_tagged_outputs(
+                job, res.outputs, os.path.join(workdir,
+                                               f"fincr_{tag}_{job}"),
+                multi))
+        return b"\n".join(blobs), [shared[j] for j, *_ in jobs_ctx]
+
+    run_fused("cold")                     # seeds every job's checkpoint
+    with open(grow, "ab") as fh:
+        fh.write(b"".join(blocks[half:]))
+
+    def interrupter(meta: dict) -> None:
+        if not meta.get("complete"):
+            raise _AuditInterrupt()
+
+    prev = incr._checkpoint_hook
+    incr._checkpoint_hook = interrupter
+    interrupted = False
+    try:
+        run_fused("kill")                 # dies after one delta block
+    except _AuditInterrupt:
+        interrupted = True
+    finally:
+        incr._checkpoint_hook = prev
+
+    art, results = run_fused("resume")
+    cs = [r.counters for r in results]
+    return {
+        "jobs": len(jobs_ctx),
+        "hit_blocks": min(int(c["Cache:HitBlocks"]) for c in cs),
+        "skipped_bytes": min(int(c["Resume:SkippedBytes"]) for c in cs),
+        "resume_interrupted": interrupted,
+        "byte_identical": art == baseline,
     }
 
 
@@ -678,10 +752,18 @@ def audit_merge(spec, shard_counts: Sequence[int] = AUDIT_SHARDS,
 
     ok = enough and all(r["byte_identical"] for r in shard_rows) \
         and checkpoint is not None and checkpoint["byte_identical"]
+    fused = incremental.get("fused") if incremental else None
     incr_ok = (incremental is not None
                and incremental["byte_identical"]
                and incremental["resume_interrupted"]
-               and incremental["skipped_bytes"] > 0)
+               and incremental["skipped_bytes"] > 0
+               # the fused (batched) refresh driver must reproduce the
+               # same bytes with a restored carry per job — the job
+               # server's refresh path is gated here every round
+               and fused is not None
+               and fused["byte_identical"]
+               and fused["resume_interrupted"]
+               and fused["skipped_bytes"] > 0)
     row = {
         "kernel": spec.name,
         "jobs": [j for j, _p, _pr, _c, _o in jobs_ctx],
@@ -705,7 +787,12 @@ def audit_merge(spec, shard_counts: Sequence[int] = AUDIT_SHARDS,
             if not checkpoint["byte_identical"]:
                 bad.append("checkpoint-resume")
             if not incr_ok:
-                bad.append("incremental-append/resume")
+                solo_ok = (incremental is not None
+                           and incremental["byte_identical"]
+                           and incremental["resume_interrupted"]
+                           and incremental["skipped_bytes"] > 0)
+                bad.append("fused-incremental-append/resume" if solo_ok
+                           else "incremental-append/resume")
             why = f"output bytes drifted under: {', '.join(bad)}"
         finding = Finding(
             spec.path, spec.line, MERGE_AUDIT_RULE,

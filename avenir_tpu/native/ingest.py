@@ -943,6 +943,26 @@ class SpillScanMixin:
         """Completed encoded-block replay passes (bench tripwire hook)."""
         return self._cache.replays if self._cache is not None else 0
 
+    def cache_ready(self) -> bool:
+        """True when the pass-1 spill cache is committed and EVERY
+        source's segment can still replay in full (the cache's own
+        content gates) — the warm-replay precondition the resident job
+        server checks before serving a repeat mining request from this
+        source with zero CSV parses. Any corpus change fails the gate:
+        a warm hit can never serve stale discovery counts."""
+        c = self._cache
+        if c is None or self._item_counts is None:
+            return False
+        return all(c.source_valid(i) for i in range(len(self.paths)))
+
+    def cache_evict_to(self, byte_budget: int) -> int:
+        """Trim the spill toward `byte_budget` through the cache's own
+        segment eviction (``EncodedBlockCache.evict_to``); returns the
+        bytes evicted, 0 when the cache is off — the handle the job
+        server's warm-state budget enforcement consumes."""
+        return (self._cache.evict_to(byte_budget)
+                if self._cache is not None else 0)
+
     @property
     def cache_nbytes(self) -> int:
         """On-disk size of the encoded-block spill cache (0 when off)."""

@@ -659,6 +659,11 @@ class _MinerScanFold:
         self._sink = self.src.scan_consumer()
         self._sealed = False
         self._shards: List["_MinerScanFold"] = []
+        # the job server's warm-state layer sets this (via run_shared's
+        # fold_hook) to ADOPT the still-open source — and its committed
+        # encoded-block cache — after finish(), so a repeat mining
+        # request replays encoded blocks instead of re-parsing CSV
+        self.keep_sources = False
 
     def consume(self, data: bytes) -> None:
         self._sink.consume(data)
@@ -692,8 +697,9 @@ class _MinerScanFold:
                             n_rows, time.perf_counter() - self.t0),
                         **_cache_counters(self.src)}
             outs = _write_gsp_outputs(self.cfg, output, levels)
-        for src in srcs:
-            src.close()
+        if not self.keep_sources:
+            for src in srcs:
+                src.close()
         return JobResult(self.job, counters, outs, levels)
 
     # ----------------------------------------------- merge algebra ops
@@ -833,7 +839,8 @@ def stream_fold_ops(job: str) -> StreamFoldOps:
 
 
 def run_shared(specs: Sequence[Tuple[str, object, str]],
-               inputs: Sequence[str]) -> Dict[str, JobResult]:
+               inputs: Sequence[str],
+               fold_hook: Optional[Callable] = None) -> Dict[str, JobResult]:
     """Run N registered jobs over the SAME inputs with ONE scan.
 
     `specs` is a sequence of (job name, conf, output path); every job
@@ -843,7 +850,13 @@ def run_shared(specs: Sequence[Tuple[str, object, str]],
     still reads its own prefixed config and writes its own outputs;
     results come back keyed by canonical job name, byte-identical to
     running the jobs one scan each (the existing run_job path stays as
-    the fallback and as the equivalence oracle)."""
+    the fallback and as the equivalence oracle).
+
+    `fold_hook(canonical, fold)`, when given, is called with each fold
+    sink right after construction — the job server's warm-state tap
+    (e.g. setting a miner fold's ``keep_sources`` so the server can pin
+    its encoded-block cache after the run). Purely observational: it
+    must not consume chunks."""
     from avenir_tpu.core.schema import FeatureSchema as _FS
     from avenir_tpu.core.stream import (SharedScan, stream_job_byte_blocks,
                                         stream_job_inputs)
@@ -891,6 +904,8 @@ def run_shared(specs: Sequence[Tuple[str, object, str]],
     folds = []
     for canonical, _kind, cfg, factory, output in built:
         fold = factory(cfg, list(inputs), schema)
+        if fold_hook is not None:
+            fold_hook(canonical, fold)
         folds.append((canonical, fold, output))
         scan.add_sink(fold)
     scan.run()
@@ -904,6 +919,59 @@ def run_shared(specs: Sequence[Tuple[str, object, str]],
             cfg for c, _k, cfg, _f, _o in built if c == canonical),
             inputs, results[canonical])
     return results
+
+
+def run_warm_miner(name: str, conf, inputs: Sequence[str], output: str,
+                   src) -> JobResult:
+    """Serve a multi-pass miner from a WARM, already-scanned streaming
+    source: pass 1 is already folded (``scan_items``/``scan`` memoize
+    the discovery counts) and every per-k pass replays the source's
+    committed encoded-block cache, so an unchanged corpus serves with
+    ZERO CSV parses — the job server's pinned-cache fast path.
+
+    The caller owns ``src`` and its validity (the server checks the
+    cache's per-block content gate, ``SpillScanMixin.cache_ready``,
+    before routing here); this function never closes it. Mining
+    parameters come from the REQUEST's conf — pass 1 does not depend on
+    them, so one warm source serves any thresholds. Output files are
+    byte-identical to the cold runner path: same miner, same per-k
+    device folds, same writers (the warm path only skips re-deriving
+    state the source already memoizes); throughput counters price the
+    mining wall time alone, which is the point."""
+    canonical, _prefix, cfg = _job_cfg(name, conf)
+    t0 = time.perf_counter()
+    if canonical == "frequentItemsApriori":
+        from avenir_tpu.models.association import FrequentItemsApriori
+
+        miner = FrequentItemsApriori(
+            support_threshold=cfg.assert_float("support.threshold"),
+            max_length=cfg.get_int("item.set.length", 3),
+            emit_trans_id=cfg.get_bool("emit.trans.id", False))
+        levels = miner.mine_stream(src)
+        counters = {"Apriori:MaxLength": len(levels),
+                    **throughput_counters(src.n_trans,
+                                          time.perf_counter() - t0),
+                    **_cache_counters(src)}
+        outs = _write_apriori_outputs(cfg, output, levels)
+    elif canonical == "candidateGenerationWithSelfJoin":
+        from avenir_tpu.models.sequence import GSPMiner
+
+        miner = GSPMiner(
+            support_threshold=cfg.assert_float("support.threshold"),
+            max_length=cfg.get_int("item.set.length", 3))
+        levels = miner.mine_stream(src)
+        counters = {"GSP:MaxLength": max(levels) if levels else 0,
+                    **throughput_counters(src.n_rows,
+                                          time.perf_counter() - t0),
+                    **_cache_counters(src)}
+        outs = _write_gsp_outputs(cfg, output, levels)
+    else:
+        raise ValueError(
+            f"job {name!r} has no warm-source path; warm-servable jobs: "
+            f"frequentItemsApriori, candidateGenerationWithSelfJoin")
+    res = JobResult(canonical, counters, outs, levels)
+    _add_mem_counters(canonical, cfg, inputs, res)
+    return res
 
 
 # ====================================================== incremental driver
@@ -956,6 +1024,166 @@ def _conf_digest(cfg: JobConfig) -> str:
     return h.hexdigest()
 
 
+class _IncrementalPlan:
+    """One job's restore plan + delta-fold state — the per-job half of
+    an incremental run, shared by the solo driver (:func:`run_incremental`)
+    and the fused one (:func:`run_incremental_shared`) so the two can
+    never disagree on restore gating or checkpoint layout."""
+
+    def __init__(self, canonical: str, cfg: JobConfig, ops: StreamFoldOps,
+                 inputs: List[str], output: str, schema, store,
+                 conf_digest: str):
+        self.canonical = canonical
+        self.cfg = cfg
+        self.ops = ops
+        self.inputs = inputs
+        self.abs_inputs = [os.path.abspath(p) for p in inputs]
+        self.output = output
+        self.schema = schema
+        self.store = store
+        self.conf_digest = conf_digest
+        self.block = int(cfg.get_float("stream.block.size.mb", 64.0)
+                         * (1 << 20))
+        self.interval = int(
+            cfg.get_float("stream.checkpoint.interval.mb", 256.0)
+            * (1 << 20))
+        self.delim = cfg.field_delim_regex
+        self.fold = None
+        self.watermarks = [0] * len(inputs)
+        self.fps: List[list] = [[] for _ in inputs]
+        self.hit_blocks = 0
+        self.skipped = 0
+        self.seq = 0
+        self.delta_blocks = 0
+        self.since_ckpt = 0
+        self.predicted: Optional[int] = None
+
+
+def _prepare_incremental(canonical: str, cfg: JobConfig, inputs: List[str],
+                         output: str, state_dir: Optional[str],
+                         schema=None) -> _IncrementalPlan:
+    """Build one job's restore plan: load the newest checkpoint, verify
+    its recorded fingerprints against the current files, and restore
+    the carry when — and only when — the covered prefix still content-
+    matches; anything else (torn/truncated checkpoint, in-place edit,
+    changed job/conf/inputs, mid-line watermark on a grown file,
+    unloadable carry) leaves a fresh cold fold. `schema` lets the fused
+    driver hand every plan ONE schema object (the run_shared contract);
+    the solo driver loads the job's own."""
+    from avenir_tpu.core import incremental as incr
+
+    ops = stream_fold_ops(canonical)
+    if schema is None and ops.kind == "dataset":
+        schema = _schema(cfg)
+    conf_digest = _conf_digest(cfg)
+    store = incr.CheckpointStore(
+        state_dir or _incremental_state_dir(cfg, canonical, inputs))
+    plan = _IncrementalPlan(canonical, cfg, ops, inputs, output, schema,
+                            store, conf_digest)
+
+    loaded = store.load()
+    if loaded is not None:
+        meta, blob = loaded
+        plan.seq = int(meta.get("seq", 0))
+        old_inputs = [str(p) for p in meta.get("inputs", [])]
+        # the recorded input list must be a PREFIX of the current one
+        # (append-only at the corpus level too: new source files fold
+        # wholly, like appended bytes); any other change — including a
+        # conf or schema-content change, which would parse the delta
+        # under a different view than the restored prefix — is a cold
+        # scan
+        usable = (meta.get("format") == 1
+                  and meta.get("job") == canonical
+                  and meta.get("conf_digest") == conf_digest
+                  and old_inputs == plan.abs_inputs[:len(old_inputs)])
+        fold = None
+        if usable:
+            wm, kept = [], []
+            for path, src_fps in zip(inputs, meta.get("fingerprints", [])):
+                n, covered = incr.verified_prefix(path, src_fps)
+                if n != len(src_fps):
+                    usable = False      # stale: an in-place edit — cold
+                    break
+                if covered < os.path.getsize(path) \
+                        and not incr.ends_at_newline(path, covered):
+                    # the corpus' last line had no terminator, so the
+                    # appended bytes EXTEND the already-folded row —
+                    # resuming would skip its continuation: cold scan
+                    usable = False
+                    break
+                wm.append(covered)
+                kept.append(list(src_fps))
+            if usable:
+                try:
+                    fold = ops.restore_state(cfg, inputs, blob,
+                                             schema=schema)
+                except Exception:
+                    fold = None         # unloadable carry: cold scan
+            if fold is not None:
+                plan.fold = fold
+                plan.watermarks[:len(wm)] = wm
+                plan.fps[:len(kept)] = kept
+                plan.hit_blocks = sum(len(x) for x in kept)
+                plan.skipped = sum(wm)
+    if plan.fold is None:
+        plan.watermarks = [0] * len(inputs)
+        plan.fps = [[] for _ in inputs]
+        plan.hit_blocks = 0
+        plan.skipped = 0
+        plan.fold = ops.factory(cfg, inputs, schema)
+
+    # the checkpoint footprint is priced against the graftlint-mem
+    # analytic model (advisory: the oracle the job-server admission
+    # layer consumes; a failure to predict never fails the scan)
+    try:
+        from avenir_tpu.analysis.mem import corpus_stats, footprint_model
+
+        stats = corpus_stats([p for p in inputs if os.path.exists(p)],
+                             delim=plan.delim)
+        plan.predicted = int(footprint_model(canonical, plan.block, schema,
+                                             stats).total_bytes)
+    except Exception:
+        pass
+    return plan
+
+
+def _plan_checkpoint(plan: _IncrementalPlan, complete: bool) -> None:
+    """Commit one atomic checkpoint of a plan's carry + fingerprints."""
+    from avenir_tpu.core import incremental as incr
+
+    plan.seq += 1
+    blob = plan.ops.serialize_state(plan.fold)
+    meta = {"format": 1, "job": plan.canonical, "seq": plan.seq,
+            "conf_digest": plan.conf_digest,
+            "inputs": plan.abs_inputs, "block_bytes": plan.block,
+            "watermarks": list(plan.watermarks),
+            "fingerprints": plan.fps,
+            "complete": complete,
+            "predicted_peak_bytes": plan.predicted}
+    saved = plan.store.save(meta, blob)
+    hook = incr._checkpoint_hook
+    if hook is not None:
+        hook(saved)
+
+
+def _plan_finish(plan: _IncrementalPlan) -> JobResult:
+    """Final (complete) checkpoint — written BEFORE finish() so the
+    carry never reflects a finished/sealed fold — then the artifact and
+    the delta-accounting counters."""
+    _plan_checkpoint(plan, complete=True)
+    if plan.output:
+        parent = os.path.dirname(os.path.abspath(plan.output))
+        os.makedirs(parent, exist_ok=True)
+    res = plan.fold.finish(plan.output)
+    res.counters["Cache:HitBlocks"] = float(plan.hit_blocks)
+    res.counters["Cache:DeltaBlocks"] = float(plan.delta_blocks)
+    res.counters["Resume:SkippedBytes"] = float(plan.skipped)
+    if plan.predicted is not None:
+        res.counters["Mem:PredictedPeakBytes"] = float(plan.predicted)
+    _add_mem_counters(plan.canonical, plan.cfg, plan.inputs, res)
+    return res
+
+
 def run_incremental(name: str, conf, inputs: Sequence[str],
                     output: str = "",
                     state_dir: Optional[str] = None) -> JobResult:
@@ -990,147 +1218,166 @@ def run_incremental(name: str, conf, inputs: Sequence[str],
                                         prefetched)
 
     canonical, _prefix, cfg = _job_cfg(name, conf)
-    ops = stream_fold_ops(canonical)
     inputs = [str(p) for p in inputs]
-    abs_inputs = [os.path.abspath(p) for p in inputs]
-    block = int(cfg.get_float("stream.block.size.mb", 64.0) * (1 << 20))
-    interval = int(cfg.get_float("stream.checkpoint.interval.mb", 256.0)
-                   * (1 << 20))
-    schema = _schema(cfg) if ops.kind == "dataset" else None
-    delim = cfg.field_delim_regex
-    conf_digest = _conf_digest(cfg)
-    store = incr.CheckpointStore(
-        state_dir or _incremental_state_dir(cfg, canonical, inputs))
-
-    # ------------------------------------------------------ restore plan
-    fold = None
-    watermarks = [0] * len(inputs)
-    fps: List[list] = [[] for _ in inputs]
-    hit_blocks = 0
-    skipped = 0
-    seq = 0
-    loaded = store.load()
-    if loaded is not None:
-        meta, blob = loaded
-        seq = int(meta.get("seq", 0))
-        old_inputs = [str(p) for p in meta.get("inputs", [])]
-        # the recorded input list must be a PREFIX of the current one
-        # (append-only at the corpus level too: new source files fold
-        # wholly, like appended bytes); any other change — including a
-        # conf or schema-content change, which would parse the delta
-        # under a different view than the restored prefix — is a cold
-        # scan
-        usable = (meta.get("format") == 1
-                  and meta.get("job") == canonical
-                  and meta.get("conf_digest") == conf_digest
-                  and old_inputs == abs_inputs[:len(old_inputs)])
-        if usable:
-            wm, kept = [], []
-            for path, src_fps in zip(inputs, meta.get("fingerprints", [])):
-                n, covered = incr.verified_prefix(path, src_fps)
-                if n != len(src_fps):
-                    usable = False      # stale: an in-place edit — cold
-                    break
-                if covered < os.path.getsize(path) \
-                        and not incr.ends_at_newline(path, covered):
-                    # the corpus' last line had no terminator, so the
-                    # appended bytes EXTEND the already-folded row —
-                    # resuming would skip its continuation: cold scan
-                    usable = False
-                    break
-                wm.append(covered)
-                kept.append(list(src_fps))
-            if usable:
-                try:
-                    fold = ops.restore_state(cfg, inputs, blob,
-                                             schema=schema)
-                except Exception:
-                    fold = None         # unloadable carry: cold scan
-            if fold is not None:
-                watermarks[:len(wm)] = wm
-                fps[:len(kept)] = kept
-                hit_blocks = sum(len(x) for x in kept)
-                skipped = sum(wm)
-    if fold is None:
-        watermarks = [0] * len(inputs)
-        fps = [[] for _ in inputs]
-        hit_blocks = 0
-        skipped = 0
-        fold = ops.factory(cfg, inputs, schema)
-
-    # the checkpoint footprint is priced against the graftlint-mem
-    # analytic model (advisory: the oracle the job-server admission
-    # layer consumes; a failure to predict never fails the scan)
-    predicted = None
-    try:
-        from avenir_tpu.analysis.mem import corpus_stats, footprint_model
-
-        stats = corpus_stats([p for p in inputs if os.path.exists(p)],
-                             delim=delim)
-        predicted = int(footprint_model(canonical, block, schema,
-                                        stats).total_bytes)
-    except Exception:
-        pass
-
-    def checkpoint(complete: bool) -> None:
-        nonlocal seq
-        seq += 1
-        blob = ops.serialize_state(fold)
-        meta = {"format": 1, "job": canonical, "seq": seq,
-                "conf_digest": conf_digest,
-                "inputs": abs_inputs, "block_bytes": block,
-                "watermarks": list(watermarks), "fingerprints": fps,
-                "complete": complete,
-                "predicted_peak_bytes": predicted}
-        saved = store.save(meta, blob)
-        hook = incr._checkpoint_hook
-        if hook is not None:
-            hook(saved)
+    plan = _prepare_incremental(canonical, cfg, inputs, output, state_dir)
 
     # ------------------------------------------------------- delta fold
-    delta_blocks = 0
-    since_ckpt = 0
     for si, path in enumerate(inputs):
         size = os.path.getsize(path)
-        start = watermarks[si]
+        start = plan.watermarks[si]
         if start >= size:
             continue
-        feed = prefetched(iter_byte_blocks(path, block,
+        feed = prefetched(iter_byte_blocks(path, plan.block,
                                            byte_range=(start, size),
                                            with_offsets=True), depth=1)
         try:
             for off, data in feed:
                 if not is_blank_block(data):
-                    if ops.kind == "dataset":
-                        fold.consume(Dataset.from_csv(data, schema,
-                                                      delim=delim))
+                    if plan.ops.kind == "dataset":
+                        plan.fold.consume(Dataset.from_csv(
+                            data, plan.schema, delim=plan.delim))
                     else:
-                        fold.consume(data)
-                fps[si].append(incr.block_fingerprint(off, data))
-                watermarks[si] = off + len(data)
-                delta_blocks += 1
-                since_ckpt += len(data)
-                if since_ckpt >= interval:
-                    checkpoint(complete=False)
-                    since_ckpt = 0
+                        plan.fold.consume(data)
+                plan.fps[si].append(incr.block_fingerprint(off, data))
+                plan.watermarks[si] = off + len(data)
+                plan.delta_blocks += 1
+                plan.since_ckpt += len(data)
+                if plan.since_ckpt >= plan.interval:
+                    _plan_checkpoint(plan, complete=False)
+                    plan.since_ckpt = 0
         finally:
             feed.close()
-    # the final (complete) checkpoint is what the next append restores;
-    # it is written BEFORE finish() so the carry never reflects a
-    # finished/sealed fold
-    checkpoint(complete=True)
+    return _plan_finish(plan)
 
-    if output:
-        parent = os.path.dirname(os.path.abspath(output))
-        os.makedirs(parent, exist_ok=True)
-    res = fold.finish(output)
-    res.counters["Cache:HitBlocks"] = float(hit_blocks)
-    res.counters["Cache:DeltaBlocks"] = float(delta_blocks)
-    res.counters["Resume:SkippedBytes"] = float(skipped)
-    if predicted is not None:
-        res.counters["Mem:PredictedPeakBytes"] = float(predicted)
-    _add_mem_counters(canonical, cfg, inputs, res)
-    return res
+
+def run_incremental_shared(specs: Sequence[Tuple[str, object, str]],
+                           inputs: Sequence[str],
+                           state_dirs: Optional[Dict[str, str]] = None
+                           ) -> Dict[str, JobResult]:
+    """Refresh N streamed jobs over the SAME appended corpus with ONE
+    delta scan: each job restores its own checkpointed carry
+    (:func:`_prepare_incremental`, the exact solo restore gate), and
+    jobs whose verified watermarks agree fold the appended blocks
+    through one ``SharedScan`` pass — N refreshes, one disk read + one
+    parse of the delta. Jobs whose watermarks differ (one was seeded at
+    a different corpus size, one fell back to a cold scan) group
+    separately and still run, so fusion is an optimization, never a
+    correctness gate. Results are byte-identical to running
+    :func:`run_incremental` per job — the merge auditor's
+    fused-incremental leg re-proves this every round.
+
+    `specs` is (job name, conf, output) like :func:`run_shared`, with
+    the same compatibility contract (one scan kind, one block size, one
+    delimiter, one schema file); `state_dirs` optionally maps canonical
+    job names to checkpoint dirs (the job server's managed store) —
+    unmapped jobs use their per-(job, corpus) default."""
+    from avenir_tpu.core import incremental as incr
+    from avenir_tpu.core.stream import (SharedScan, is_blank_block,
+                                        iter_byte_blocks, prefetched)
+
+    if not specs:
+        return {}
+    inputs = [str(p) for p in inputs]
+    built = []
+    for name, conf, output in specs:
+        canonical, _prefix, cfg = _job_cfg(name, conf)
+        ops = stream_fold_ops(canonical)
+        if any(canonical == b[0] for b in built):
+            raise ValueError(
+                f"job {canonical!r} appears twice in one shared refresh")
+        built.append((canonical, cfg, ops, output))
+    kinds = {ops.kind for _c, _cfg, ops, _o in built}
+    if len(kinds) != 1:
+        raise ValueError(f"cannot fuse refreshes of mixed scan kinds "
+                         f"{kinds}")
+    kind = kinds.pop()
+    blocks = {cfg.get_float("stream.block.size.mb", 64.0)
+              for _c, cfg, _o2, _o in built}
+    if len(blocks) != 1:
+        raise ValueError(
+            f"fused refreshes disagree on stream.block.size.mb: {blocks}")
+    delims = {cfg.field_delim_regex for _c, cfg, _o2, _o in built}
+    if len(delims) != 1:
+        raise ValueError(
+            f"fused refreshes disagree on field delimiter: {delims}")
+    delim = delims.pop()
+    schema = None
+    if kind == "dataset":
+        spaths = {cfg.assert_get("feature.schema.file.path")
+                  for _c, cfg, _o2, _o in built}
+        if len(spaths) != 1:
+            raise ValueError(
+                f"fused refreshes disagree on the schema file: {spaths}")
+        schema = FeatureSchema.from_file(spaths.pop())
+
+    plans = []
+    for canonical, cfg, ops, output in built:
+        sd = (state_dirs or {}).get(canonical)
+        plans.append(_prepare_incremental(canonical, cfg, inputs, output,
+                                          sd, schema=schema))
+    block = plans[0].block
+
+    # one SharedScan per watermark group: every plan restored to the
+    # same coverage folds the same delta blocks from one read + parse
+    groups: Dict[tuple, List[_IncrementalPlan]] = {}
+    for plan in plans:
+        groups.setdefault(tuple(plan.watermarks), []).append(plan)
+
+    def delta_feed(group: List[_IncrementalPlan]):
+        """(source index, offset, raw block, parsed-once payload) past
+        the group's common watermark; payload is None for blank blocks
+        (folds skip them, fingerprints still cover them)."""
+        for si, path in enumerate(inputs):
+            size = os.path.getsize(path)
+            start = group[0].watermarks[si]
+            if start >= size:
+                continue
+            feed = prefetched(iter_byte_blocks(path, block,
+                                               byte_range=(start, size),
+                                               with_offsets=True), depth=1)
+            try:
+                for off, data in feed:
+                    payload = None
+                    if not is_blank_block(data):
+                        payload = (Dataset.from_csv(data, schema,
+                                                    delim=delim)
+                                   if kind == "dataset" else data)
+                    yield si, off, data, payload
+            finally:
+                feed.close()
+
+    def fold_sink(plan: _IncrementalPlan):
+        def consume(item) -> None:
+            payload = item[3]
+            if payload is not None:
+                plan.fold.consume(payload)
+        return consume
+
+    def bookkeeper(group: List[_IncrementalPlan]):
+        # runs AFTER the folds (sink order), so an interval checkpoint
+        # serializes carries that already folded the current block —
+        # the solo driver's exact ordering
+        def consume(item) -> None:
+            si, off, data, _payload = item
+            fp = incr.block_fingerprint(off, data)
+            for plan in group:
+                plan.fps[si].append(fp)
+                plan.watermarks[si] = off + len(data)
+                plan.delta_blocks += 1
+                plan.since_ckpt += len(data)
+                if plan.since_ckpt >= plan.interval:
+                    _plan_checkpoint(plan, complete=False)
+                    plan.since_ckpt = 0
+        return consume
+
+    for group in groups.values():
+        scan = SharedScan(delta_feed(group))
+        for plan in group:
+            scan.add_sink(fold_sink(plan))
+        scan.add_sink(bookkeeper(group))
+        scan.run()
+
+    return {plan.canonical: _plan_finish(plan) for plan in plans}
 
 
 # =================================================================== bayesian
@@ -2778,8 +3025,21 @@ class Pipeline:
 
 def run_from_cli(argv: Sequence[str]) -> JobResult:
     """`python -m avenir_tpu <jobName> --conf <props> IN... OUT` — the
-    `hadoop jar avenir.jar <class> -Dconf.path=<props> IN OUT` surface."""
+    `hadoop jar avenir.jar <class> -Dconf.path=<props> IN OUT` surface.
+
+    `python -m avenir_tpu serve ...` instead starts the resident
+    multi-tenant job server over a stdin/filesystem request spool
+    (avenir_tpu.server.spool — batched shared scans, warm caches,
+    byte-budget admission; no network dependency)."""
     import argparse
+
+    if argv and argv[0] == "serve":
+        from avenir_tpu.server.spool import serve_main
+
+        rc = serve_main(list(argv[1:]))
+        if rc:
+            sys.exit(rc)
+        return JobResult("serve")
 
     ap = argparse.ArgumentParser(prog="avenir_tpu")
     ap.add_argument("jobname", help="job name or reference Tool class")

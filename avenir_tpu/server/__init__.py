@@ -1,0 +1,22 @@
+"""Resident analytics job server: the analytics-as-a-service surface.
+
+The repo's jobs used to be batch invocations — every request paid
+process startup, jit compile and a full corpus scan. The server keeps
+ONE resident process accepting concurrent submissions and makes them
+fast by sharing work: a batching scheduler groups compatible requests
+into one SharedScan pass (``runner.run_shared`` /
+``runner.run_incremental_shared``), a warm-state layer pins compiled
+executables, encoded-block caches and fold-state checkpoints across
+requests, and an admission controller prices every request in bytes
+(graftlint-mem's footprint model) before it runs so the process never
+breaches its RSS budget. See docs/DESIGN.md "The job server".
+"""
+
+from avenir_tpu.server.jobserver import (AdmissionError, JobRequest,
+                                         JobServer, ServerClosed, Ticket,
+                                         compat_key, price_request_bytes)
+from avenir_tpu.server.spool import serve_main, serve_spool, serve_stream
+
+__all__ = ["AdmissionError", "JobRequest", "JobServer", "ServerClosed",
+           "Ticket", "compat_key", "price_request_bytes", "serve_main",
+           "serve_spool", "serve_stream"]

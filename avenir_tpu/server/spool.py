@@ -1,0 +1,221 @@
+"""Request spool: drive a resident JobServer with no network in the loop.
+
+Two hermetic transports, both JSON request objects with the
+:class:`~avenir_tpu.server.jobserver.JobRequest` fields
+(``{"job", "conf", "inputs", "output", "tenant", "priority", "mode"}``):
+
+- **stream** — JSON lines on an input stream (stdin for the CLI), one
+  result JSON line per request on the output stream, in submission
+  order. EOF drains and exits: ``echo '{...}' | python -m avenir_tpu
+  serve --stdin`` is a complete hermetic session, which is how tier-1
+  drives the server end to end.
+- **spool directory** — tenants atomically drop ``*.json`` request
+  files into ``<spool>/in/`` (write elsewhere + rename, the usual
+  maildir discipline); the server claims each by renaming it into
+  ``<spool>/work/``, serves it, and writes the result to
+  ``<spool>/out/<name>``. ``--once`` processes what is spooled, drains
+  and exits; without it the loop polls until the process is signalled.
+
+The CLI: ``python -m avenir_tpu serve [--stdin | --spool DIR] [--once]
+[--budget-mb N] [--workers N] [--warm-budget-mb N] [--state-root DIR]``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+from typing import Dict, List, Optional, Tuple
+
+from avenir_tpu.server.jobserver import (DEFAULT_BUDGET_BYTES,
+                                         DEFAULT_WARM_BUDGET_BYTES,
+                                         JobRequest, JobServer, Ticket)
+
+#: spool poll granularity (seconds)
+_SPOOL_POLL_SECS = 0.1
+
+
+def request_from_json(obj: Dict) -> JobRequest:
+    """A JobRequest from one spool/stream JSON object; unknown fields
+    are rejected so a typo'd key fails loudly instead of silently
+    running with a default."""
+    known = {"job", "conf", "inputs", "output", "tenant", "priority",
+             "mode", "state_dir", "req_id"}
+    extra = set(obj) - known
+    if extra:
+        raise ValueError(f"unknown request field(s): {sorted(extra)}")
+    kwargs = dict(obj)
+    kwargs.setdefault("conf", {})
+    kwargs.setdefault("output", "")
+    return JobRequest(**kwargs)
+
+
+def result_to_json(ticket: Ticket) -> Dict:
+    """The served (or failed) ticket as one result JSON object."""
+    out = {"req_id": ticket.request.req_id,
+           "tenant": ticket.request.tenant,
+           "job": ticket.request.job}
+    try:
+        res = ticket.result(timeout=0)
+        out.update({"ok": True, "name": res.name,
+                    "counters": res.counters, "outputs": res.outputs})
+    except BaseException as exc:  # noqa: BLE001 — the result IS the report
+        out.update({"ok": False, "error": f"{type(exc).__name__}: {exc}"})
+    return out
+
+
+def serve_stream(server: JobServer, in_stream, out_stream,
+                 drain_timeout: float = 86_400.0) -> int:
+    """JSON-lines transport: submit every request line, drain, emit one
+    result line per request in submission order. Returns the count of
+    failed requests (the CLI exit code). The drain bound defaults to a
+    day, not the server's 5-minute test-scale default — a session over
+    a real corpus legitimately runs for many minutes, and a timeout
+    here cancels every in-flight request."""
+    tickets: List[Ticket] = []
+    for line in in_stream:
+        line = line.strip()
+        if not line or line.startswith("#"):
+            continue
+        try:
+            tickets.append(server.submit(request_from_json(
+                json.loads(line))))
+        except Exception as exc:  # noqa: BLE001 — reported in-band
+            failed = Ticket(JobRequest(job="<unparsed>", conf={},
+                                       inputs=[], output=""))
+            failed._complete(error=exc)
+            tickets.append(failed)
+    server.drain(timeout=drain_timeout)
+    failures = 0
+    for ticket in tickets:
+        row = result_to_json(ticket)
+        failures += 0 if row["ok"] else 1
+        out_stream.write(json.dumps(row) + "\n")
+    out_stream.flush()
+    return failures
+
+
+def spool_dirs(spool: str) -> Tuple[str, str, str]:
+    """(in, work, out) subdirectories of a spool root, created."""
+    paths = tuple(os.path.join(spool, d) for d in ("in", "work", "out"))
+    for p in paths:
+        os.makedirs(p, exist_ok=True)
+    return paths
+
+
+def _claim(in_dir: str, work_dir: str) -> List[Tuple[str, str]]:
+    """Atomically claim every spooled request file: (name, work path)
+    pairs. A rename that loses a race (another claimer, a writer still
+    renaming in) is skipped, never an error."""
+    claimed = []
+    try:
+        names = sorted(os.listdir(in_dir))
+    except OSError:
+        return []
+    for name in names:
+        if not name.endswith(".json"):
+            continue
+        src = os.path.join(in_dir, name)
+        dst = os.path.join(work_dir, name)
+        try:
+            os.replace(src, dst)
+        except OSError:
+            continue
+        claimed.append((name, dst))
+    return claimed
+
+
+def serve_spool(server: JobServer, spool: str, once: bool = False,
+                should_stop=None) -> int:
+    """Filesystem-spool transport (module docstring). Runs in the
+    CALLER's thread — the server owns all worker threads — polling the
+    in/ directory, submitting claims, and writing each completed
+    ticket's result file as it finishes. Returns the failed-request
+    count accumulated over the session."""
+    in_dir, work_dir, out_dir = spool_dirs(spool)
+    pending: List[Tuple[str, Ticket]] = []
+    failures = 0
+    while True:
+        for name, work_path in _claim(in_dir, work_dir):
+            try:
+                with open(work_path) as fh:
+                    req = request_from_json(json.load(fh))
+                pending.append((name, server.submit(req)))
+            except Exception as exc:  # noqa: BLE001 — reported in-band
+                failed = Ticket(JobRequest(job="<unparsed>", conf={},
+                                           inputs=[], output=""))
+                failed._complete(error=exc)
+                pending.append((name, failed))
+        still = []
+        for name, ticket in pending:
+            if not ticket.done:
+                still.append((name, ticket))
+                continue
+            row = result_to_json(ticket)
+            failures += 0 if row["ok"] else 1
+            tmp = os.path.join(out_dir, name + ".tmp")
+            with open(tmp, "w") as fh:
+                json.dump(row, fh, indent=1)
+            os.replace(tmp, os.path.join(out_dir, name))
+            try:
+                os.remove(os.path.join(work_dir, name))
+            except OSError:
+                pass
+        pending = still
+        # only *.json files count as spooled work: a stray temp or dotfile
+        # in in/ must not keep --once alive forever
+        try:
+            spooled = any(n.endswith(".json") for n in os.listdir(in_dir))
+        except OSError:
+            spooled = False
+        drained = not pending and not spooled
+        if once and drained:
+            return failures
+        if should_stop is not None and should_stop() and drained:
+            return failures
+        time.sleep(_SPOOL_POLL_SECS)
+
+
+def serve_main(argv) -> int:
+    """`python -m avenir_tpu serve ...` — build the server from flags,
+    run one transport session, shut down cleanly."""
+    import argparse
+
+    ap = argparse.ArgumentParser(prog="avenir_tpu serve")
+    group = ap.add_mutually_exclusive_group(required=True)
+    group.add_argument("--stdin", action="store_true",
+                       help="JSON-lines requests on stdin, results on "
+                            "stdout; EOF drains and exits")
+    group.add_argument("--spool", default=None,
+                       help="spool directory: requests in <dir>/in, "
+                            "results in <dir>/out")
+    ap.add_argument("--once", action="store_true",
+                    help="spool mode: serve what is spooled, drain, exit")
+    ap.add_argument("--budget-mb", type=float,
+                    default=DEFAULT_BUDGET_BYTES / (1 << 20),
+                    help="admission RSS ceiling (default 3072)")
+    ap.add_argument("--workers", type=int, default=2)
+    ap.add_argument("--warm-budget-mb", type=float,
+                    default=DEFAULT_WARM_BUDGET_BYTES / (1 << 20),
+                    help="pinned encoded-block cache budget (default 256)")
+    ap.add_argument("--state-root", default=None,
+                    help="managed incremental-checkpoint root (default: "
+                         "a per-session temp dir)")
+    args = ap.parse_args(argv)
+    server = JobServer(budget_bytes=int(args.budget_mb * (1 << 20)),
+                       workers=args.workers,
+                       warm_budget_bytes=int(
+                           args.warm_budget_mb * (1 << 20)),
+                       state_root=args.state_root)
+    server.start()
+    try:
+        if args.stdin:
+            failures = serve_stream(server, sys.stdin, sys.stdout)
+        else:
+            failures = serve_spool(server, args.spool, once=args.once)
+    finally:
+        server.shutdown()
+    print(json.dumps({"server": "done", "failed": failures,
+                      "stats": server.stats()}), file=sys.stderr)
+    return 1 if failures else 0

@@ -70,6 +70,13 @@ def test_merge_gate_clean_and_all_stream_kernels_validated():
         assert inc["byte_identical"] and inc["resume_interrupted"], row
         assert inc["skipped_bytes"] > 0 and inc["hit_blocks"] > 0, row
         assert 1 <= inc["prefix_blocks"] < inc["blocks"], row
+        # the FUSED leg ran through the batched delta-scan driver
+        # (run_incremental_shared, the job server's refresh path):
+        # same append/kill/resume sequence, every job's carry restored
+        fused = inc["fused"]
+        assert fused["byte_identical"] and fused["resume_interrupted"], row
+        assert fused["skipped_bytes"] > 0, row
+        assert fused["jobs"] == len(row["jobs"]), row
 
 
 def test_every_stream_entry_carries_fold_specs():

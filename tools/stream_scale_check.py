@@ -42,9 +42,18 @@ states whether the folds it measured are still deterministic AND still
 a merge algebra. --no-audits skips them (they add a couple of minutes
 of proxy-scale runs next to an hours-long 100M anchor).
 
+With --server, additionally measures the resident job server: the same
+3-tenant mixed-kind open-loop load as bench_scaling.server_tripwire
+(churn profilers + sequence jobs + one duplicate request), served by an
+in-process JobServer vs sequential one-job-at-a-time execution, in a
+fresh child — recording jobs/min both ways, the speedup, p50/p99 queue
+wait, and the per-request Server:* counters (Server:QueueWaitMs /
+Server:BatchSize / Server:CompileHits / Server:AdmissionHeldMs) the
+served JobResults carry.
+
 Usage: python tools/stream_scale_check.py [--rows N_MILLION] [--extra]
                                           [--fused] [--incremental]
-                                          [--no-audits]
+                                          [--server] [--no-audits]
 """
 
 import json
@@ -122,6 +131,86 @@ rss = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / 1024.0
 print(json.dumps({"job": job, "seconds": round(dt, 1),
                   "peak_rss_mb": round(rss, 1),
                   "counters": res.counters, "outputs": res.outputs}))
+'''
+
+
+_CHILD_SERVER = r'''
+import json, os, resource, sys, time
+sys.path.insert(0, ".")
+import jax
+jax.config.update("jax_platforms", "cpu")
+from avenir_tpu.analysis.mem import _RssSampler
+from avenir_tpu.runner import run_job
+from avenir_tpu.server import JobRequest, JobServer
+from bench_scaling import server_load
+
+churn, seq, schema, outdir = sys.argv[1:5]
+# the ONE canonical load table — the anchor must measure exactly the
+# load bench_scaling.server_tripwire gates
+load = server_load(churn, seq, schema)
+# jit warmup on a newline-aligned head slice of each corpus so neither
+# phase pays first-compile costs (the bench tripwire's own protocol)
+warm_dir = os.path.join(outdir, "warm")
+os.makedirs(warm_dir, exist_ok=True)
+warm = {}
+for corpus in {c for _t, _j, _cf, c, _tag in load}:
+    with open(corpus, "rb") as fh:
+        blob = fh.read(1 << 18)
+    dst = os.path.join(warm_dir, os.path.basename(corpus))
+    with open(dst, "wb") as fh:
+        fh.write(blob[:blob.rfind(b"\n") + 1])
+    warm[corpus] = dst
+seen = set()
+for _tenant, job, cf, corpus, tag in load:
+    key = (job, json.dumps(cf, sort_keys=True))
+    if key not in seen:
+        seen.add(key)
+        run_job(job, cf, [warm[corpus]], os.path.join(warm_dir, f"w_{tag}"))
+# served phase FIRST, its RSS sampled in isolation: the sequential twin
+# is deliberately unbudgeted and CPython RSS is sticky, so running it
+# first would attribute ITS peak to the admission-controlled server
+server = JobServer(state_root=os.path.join(outdir, "state"))
+tickets = {tag: server.submit(JobRequest(
+               job, cf, [corpus], os.path.join(outdir, f"srv_{tag}"),
+               tenant=tenant))
+           for tenant, job, cf, corpus, tag in load}
+t0 = time.perf_counter()
+with _RssSampler() as sampler:
+    server.start()
+    server.drain(timeout=7200)
+t_srv = time.perf_counter() - t0
+served = {tag: t.result(timeout=60) for tag, t in tickets.items()}
+stats = server.stats()
+server.shutdown()
+t0 = time.perf_counter()
+for tenant, job, cf, corpus, tag in load:
+    run_job(job, cf, [corpus], os.path.join(outdir, f"seq_{tag}"))
+t_seq = time.perf_counter() - t0
+for tag, res in served.items():
+    for pa in sorted(res.outputs):
+        rel = os.path.relpath(pa, os.path.join(outdir, f"srv_{tag}"))
+        pb = os.path.join(outdir, f"seq_{tag}")
+        pb = pb if rel == "." else os.path.join(pb, rel)
+        assert open(pa, "rb").read() == open(pb, "rb").read(), (pa, pb)
+rss = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / 1024.0
+waits = sorted(r.counters["Server:QueueWaitMs"] for r in served.values())
+print(json.dumps({
+    "job": "jobServer", "requests": len(load),
+    "sequential_seconds": round(t_seq, 1),
+    "served_seconds": round(t_srv, 1),
+    "jobs_per_min_sequential": round(len(load) / (t_seq / 60.0), 2),
+    "jobs_per_min_served": round(len(load) / (t_srv / 60.0), 2),
+    "speedup": round(t_seq / max(t_srv, 1e-9), 2),
+    "p50_queue_wait_ms": waits[len(waits) // 2],
+    "p99_queue_wait_ms": waits[-1],
+    "peak_rss_mb": round(rss, 1),
+    "server_peak_rss_mb": round(sampler.peak_rss / (1 << 20), 1),
+    "outputs_byte_identical": True,
+    "server_counters": {tag: {k: v for k, v in r.counters.items()
+                              if k.startswith("Server:")}
+                        for tag, r in served.items()},
+    "stats": {k: v for k, v in stats.items() if v},
+}))
 '''
 
 
@@ -334,6 +423,32 @@ def main():
             "outputs_byte_identical": True,
         }
         os.remove(base)
+    if "--server" in sys.argv:
+        # resident-server anchor: the 3-tenant mixed-kind open-loop
+        # load served by an in-process JobServer vs one-job-at-a-time,
+        # in a fresh child (so both sides price the same startup), with
+        # byte-identity asserted per served artifact and the Server:*
+        # counters recorded per request
+        outdir = f"/tmp/avenir_scale_server_{ROWS_M}m"
+        import shutil
+
+        shutil.rmtree(outdir, ignore_errors=True)
+        os.makedirs(outdir, exist_ok=True)
+        env = dict(os.environ, AVENIR_SKIP_DEVICE_PROBE="1")
+        proc = subprocess.run(
+            [sys.executable, "-c", _CHILD_SERVER,
+             CHURN_CSV, SEQ_CSV, schema_path, outdir],
+            capture_output=True, text=True, timeout=7200, env=env)
+        if proc.returncode != 0:
+            raise RuntimeError(f"server load failed: {proc.stderr[-800:]}")
+        line = json.loads(proc.stdout.strip().splitlines()[-1])
+        print(json.dumps(line), flush=True)
+        # the served phase is the admission-controlled one; the lifetime
+        # peak_rss_mb (also recorded) includes the unbudgeted sequential
+        # twin and would assert the wrong phase
+        assert line["server_peak_rss_mb"] < RSS_LIMIT_MB, \
+            f"server RSS {line['server_peak_rss_mb']}MB not admission-bounded"
+        results["jobServer"] = line
     merged = {}
     if os.path.exists(RECORD):
         try:
@@ -367,6 +482,14 @@ def main():
     # re-scan after a ~1% append, byte-identity already asserted above
     if "incremental" in results:
         summary["incremental_speedup"] = results["incremental"]["speedup"]
+    # the served-jobs/min column: batched multi-tenant serving vs
+    # one-job-at-a-time, plus the served requests' Server:* counters
+    if "jobServer" in results:
+        summary["server_speedup"] = results["jobServer"]["speedup"]
+        summary["server_jobs_per_min"] = \
+            results["jobServer"]["jobs_per_min_served"]
+        summary["server_p99_queue_wait_ms"] = \
+            results["jobServer"]["p99_queue_wait_ms"]
     # the two streaming-correctness columns, side by side: the folds the
     # numbers above measured are chunk-layout-invariant AND a merge
     # algebra (shard-merge + checkpoint-resume byte-identical)
