@@ -40,6 +40,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from avenir_tpu import obs as _obs
 from avenir_tpu.native.ingest import SpillScanMixin
 
 
@@ -702,8 +703,12 @@ class FrequentItemsApriori:
             cand_ids, src.masked_width, c_pad))
         counts_d = jnp.zeros(c_pad, jnp.int32)
         for packed in double_buffered(src.packed_chunks(self.block)):
+            # host-side span: the donated fold dispatches async, so the
+            # duration is dispatch+transfer time, not device occupancy
+            t0 = _obs.now()
             counts_d = bitset_fold_counts(
                 counts_d, jnp.asarray(packed), cand_d)
+            _obs.record("stream.fold", t0, sink="apriori_support")
         return np.asarray(counts_d, np.int64)
 
     def mine_stream_merged(self, sources: Sequence[StreamingTransactionSource]

@@ -32,6 +32,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from avenir_tpu import obs as _obs
 from avenir_tpu.native.ingest import SpillScanMixin
 
 
@@ -562,8 +563,12 @@ class GSPMiner:
         cand_d, kv = self._cand_arrays(cands, src.token_code, c_pad)
         counts_d = jnp.zeros(c_pad, jnp.int32)
         for blk in double_buffered(src.chunks(self.block)):
+            # host-side span: the donated fold dispatches async, so the
+            # duration is dispatch+transfer time, not device occupancy
+            t0 = _obs.now()
             counts_d = _subseq_fold_kernel(
                 counts_d, jnp.asarray(blk), cand_d, kv)
+            _obs.record("stream.fold", t0, sink="gsp_support")
         return np.asarray(counts_d, np.int64)
 
     def mine_stream_merged(self, sources: Sequence[StreamingSequenceSource]

@@ -16,6 +16,8 @@ from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from avenir_tpu import obs as _obs
+
 _DIR = os.path.dirname(os.path.abspath(__file__))
 _SRC = os.path.join(_DIR, "csv_ingest.cpp")
 _LIB = os.path.join(_DIR, "libcsv_ingest.so")
@@ -872,6 +874,7 @@ class SpillScanMixin:
         from avenir_tpu.core.stream import iter_byte_blocks, prefetched
 
         self._scan_begin()
+        label = type(self).__name__
         for si, path in enumerate(self.paths):
             if self._cache is not None:
                 self._cache.set_source(si)
@@ -879,11 +882,17 @@ class SpillScanMixin:
                         iter_byte_blocks(path, self.block_bytes,
                                          with_offsets=True), depth=1):
                     self._cache.note_block(off, data)
+                    t0 = _obs.now()
                     self._scan_block(data)
+                    _obs.record("stream.parse", t0, sink=label,
+                                nbytes=len(data))
             else:
                 for data in prefetched(
                         iter_byte_blocks(path, self.block_bytes), depth=1):
+                    t0 = _obs.now()
                     self._scan_block(data)
+                    _obs.record("stream.parse", t0, sink=label,
+                                nbytes=len(data))
         return self._scan_finish()
 
     def scan_consumer(self):
@@ -893,10 +902,16 @@ class SpillScanMixin:
         the source's own scan entry point would."""
         self._scan_begin()
         src = self
+        label = type(self).__name__
 
         class _ScanSink:
             def consume(self, data: bytes) -> None:
+                # pass-1 parse/encode of an externally-read block: the
+                # same stream.parse span the own-read scan records
+                t0 = _obs.now()
                 src._scan_block(data)
+                _obs.record("stream.parse", t0, sink=label,
+                            nbytes=len(data))
 
             def finish(self):
                 return src._scan_finish()

@@ -1,0 +1,46 @@
+"""avenir-trace: the always-on, low-overhead telemetry subsystem.
+
+Three pieces, all host-side and stdlib-pure (imported by core.stream at
+package init, so nothing here may import jax/numpy at module scope):
+
+- **Span flight recorder** (:mod:`avenir_tpu.obs.trace`): a thread-safe
+  ring buffer of ``(name, tid, t0, dur, attrs)`` span events with
+  bounded memory and Chrome-trace/Perfetto JSON export. Instrumentation
+  points live in core/stream (per-chunk read/parse/fold spans plus
+  producer/consumer stall attribution), runner (per-job phase spans for
+  the solo, shared, incremental and fused-incremental paths) and
+  server/jobserver (per-request queued/held/dispatch spans with batch
+  linkage attrs).
+- **Streaming histograms** (:mod:`avenir_tpu.obs.histogram`): fixed
+  log-spaced bucket accumulators that merge like ``RunningStats``
+  (counts and sums are additive, so ``merge`` is associative and
+  shard/worker results combine exactly); quantiles come from per-bucket
+  means, so they are exact whenever a bucket holds one distinct value.
+- **Span-coverage auditor** (:mod:`avenir_tpu.obs.coverage`): runs every
+  registered stream entry (analysis/manifest.stream_entries) and
+  asserts it emits the mandatory span set (read/parse/fold/finish) —
+  instrumentation can never silently rot; gated 8/8 by
+  ``bench_scaling.graftlint_tripwire``.
+
+Overhead contract: ``bench_scaling.obs_tripwire`` asserts a fused
+10M-row proxy run with tracing ON stays within 3% of the wall clock
+with tracing OFF, with byte-identical artifacts. Tracing is ON by
+default (``AVENIR_TRACE=0`` or :func:`set_enabled` turns it off); every
+record call is one enabled-flag load away from free when off.
+"""
+
+# the submodule is named ``histogram`` (not ``hist``) on purpose: a
+# submodule named ``hist`` would shadow the ``obs.hist(name)`` accessor
+# __all__ advertises below
+from avenir_tpu.obs.histogram import LatencyHistogram
+from avenir_tpu.obs.trace import (Span, SpanRecorder, capture, enabled,
+                                  hist, hist_summaries, now, observe,
+                                  record, record_min, recorder, reset_hists,
+                                  set_enabled, span)
+
+__all__ = [
+    "Span", "SpanRecorder", "LatencyHistogram",
+    "capture", "enabled", "set_enabled", "recorder",
+    "now", "record", "record_min", "span",
+    "observe", "hist", "hist_summaries", "reset_hists",
+]

@@ -1,0 +1,100 @@
+"""`python -m avenir_tpu stats <dir>` — render a live metrics snapshot.
+
+The resident job server atomically renames a ``metrics.json`` snapshot
+next to its spool every few seconds (jobserver.JobServer, the
+``metrics_path`` surface); this renderer is the operator's one-command
+view of it: queue depths, admission pressure, warm-store occupancy and
+the latency histograms (queue wait / admission hold / dispatch /
+chunk), without attaching to the server process. Accepts the snapshot
+file or the directory holding it.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Dict, List
+
+
+def load_metrics(path: str) -> Dict:
+    """The snapshot dict at `path` (a metrics.json, or a directory —
+    e.g. the spool dir — containing one)."""
+    if os.path.isdir(path):
+        path = os.path.join(path, "metrics.json")
+    with open(path) as fh:
+        return json.load(fh)
+
+
+def _fmt_bytes(n: float) -> str:
+    return f"{n / (1 << 20):.1f}MB"
+
+
+def _hist_rows(hists: Dict[str, Dict]) -> List[str]:
+    lines = [f"  {'histogram':<22s} {'count':>7s} {'p50':>9s} "
+             f"{'p95':>9s} {'p99':>9s} {'max':>9s}"]
+    for name, h in sorted(hists.items()):
+        lines.append(
+            f"  {name:<22s} {int(h.get('count', 0)):>7d} "
+            f"{h.get('p50', 0.0):>9.2f} {h.get('p95', 0.0):>9.2f} "
+            f"{h.get('p99', 0.0):>9.2f} {h.get('max', 0.0):>9.2f}")
+    return lines
+
+
+def render_metrics(snap: Dict) -> str:
+    """The snapshot as the operator table (pure function of the dict,
+    so tests pin the rendering without a filesystem)."""
+    lines: List[str] = []
+    age = time.time() - snap.get("ts_unix", time.time())
+    lines.append(f"avenir job server metrics "
+                 f"(snapshot {age:.1f}s old, "
+                 f"uptime {snap.get('uptime_s', 0.0):.1f}s)")
+    queues = snap.get("queues", {})
+    depth = sum(queues.values())
+    lines.append(f"queues: {depth} queued across {len(queues)} tenant(s)"
+                 + ("" if not queues else "  [" + ", ".join(
+                     f"{t}={n}" for t, n in sorted(queues.items())) + "]"))
+    infl = snap.get("inflight", {})
+    budget = infl.get("budget_bytes", 0) or 1
+    lines.append(f"admission: {_fmt_bytes(infl.get('priced_bytes', 0))} "
+                 f"priced in flight of {_fmt_bytes(budget)} budget "
+                 f"({100.0 * infl.get('priced_bytes', 0) / budget:.1f}%), "
+                 f"{infl.get('batches', 0)} batch(es) running, "
+                 f"peak {_fmt_bytes(infl.get('peak_priced_bytes', 0))}")
+    warm = snap.get("warm", {})
+    lines.append(f"warm store: {int(warm.get('pinned_sources', 0))} "
+                 f"pinned source(s), {_fmt_bytes(warm.get('pinned_bytes', 0))}"
+                 f", hits={int(warm.get('hits', 0))} "
+                 f"misses={int(warm.get('misses', 0))}")
+    stats = snap.get("stats", {})
+    lines.append(f"served: {int(stats.get('served', 0))} "
+                 f"(failed {int(stats.get('failed', 0))}), "
+                 f"batches={int(stats.get('batches', 0))} "
+                 f"coalesced={int(stats.get('coalesced', 0))} "
+                 f"holds={int(stats.get('admission_holds', 0))} "
+                 f"compile-warm={int(stats.get('compile_warm_dispatches', 0))}"
+                 f" warm-hits={int(stats.get('warm_hits', 0))}")
+    hists = snap.get("hists", {})
+    if hists:
+        lines.append("latency histograms (ms):")
+        lines.extend(_hist_rows(hists))
+    return "\n".join(lines)
+
+
+def stats_main(argv) -> int:
+    """CLI body for ``python -m avenir_tpu stats <dir-or-file>``."""
+    import argparse
+
+    ap = argparse.ArgumentParser(prog="avenir_tpu stats")
+    ap.add_argument("path", help="metrics.json, or the directory "
+                                 "(e.g. the spool dir) containing it")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the raw snapshot JSON instead of the table")
+    args = ap.parse_args(argv)
+    try:
+        snap = load_metrics(args.path)
+    except (OSError, ValueError) as e:
+        print(f"cannot load metrics snapshot from {args.path!r}: {e}")
+        return 2
+    print(json.dumps(snap, indent=1) if args.json else render_metrics(snap))
+    return 0

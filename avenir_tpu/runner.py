@@ -33,6 +33,7 @@ from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from avenir_tpu import obs as _obs
 from avenir_tpu.core.config import (JobConfig, MissingConfigError,
                                     load_properties)
 from avenir_tpu.core.dataset import Dataset
@@ -122,7 +123,9 @@ def run_job(name: str, conf, inputs: Sequence[str], output: str = "") -> JobResu
     if output:
         parent = os.path.dirname(os.path.abspath(output))
         os.makedirs(parent, exist_ok=True)
+    t0 = _obs.now()
     res = fn(cfg, list(inputs), output)
+    _obs.record("job.run", t0, job=canonical)
     _add_mem_counters(canonical, cfg, inputs, res)
     return res
 
@@ -226,6 +229,31 @@ def _validate(class_values: Sequence[str], actual: np.ndarray,
     cm = ConfusionMatrix(class_values, pos_class=pos_class)
     cm.add(actual, predicted)
     return cm.counters()
+
+
+def _drive_fold(fold, chunks, job: str) -> int:
+    """Drive one fold sink over a chunk iterator through ``SharedScan``
+    — the single-sink special case of the scan-sharing executor, which
+    is exactly what the one-job-one-scan paths always were. Routing the
+    solo paths through it means per-chunk ``stream.fold`` spans and the
+    ``chunk_latency_ms`` histogram come from ONE instrumentation point,
+    so the solo and fused executions can never drift apart in what they
+    report (or in how they close an abandoned prefetch worker)."""
+    from avenir_tpu.core.stream import SharedScan
+
+    scan = SharedScan(chunks)
+    scan.add_sink(fold, label=job)
+    return scan.run()
+
+
+def _finish_fold(fold, output: str, job: str) -> JobResult:
+    """fold.finish(output) under the ``job.finish`` span — the artifact
+    write + fold seal phase of every streamed job, one call site shape
+    for the solo, shared and incremental drivers."""
+    t0 = _obs.now()
+    res = fold.finish(output)
+    _obs.record("job.finish", t0, job=job)
+    return res
 
 
 # ============================================================ scan sharing
@@ -519,18 +547,24 @@ class _MarkovPerClassFold:
             from avenir_tpu.native.ingest import seq_encode_native
 
             # cannot be None: availability + 1-byte delim pre-checked
+            t0 = _obs.now()
             enc = seq_encode_native(data, self.delim, self.vocab)
+            _obs.record("stream.parse", t0, sink="markov_csr",
+                        nbytes=len(data))
             self.model.fit_csr(
                 *enc, skip=self.skip,
                 class_ord=self.class_ord if self.class_labels else None,
                 label_codes=self.label_codes)
             self.rows += enc[1].shape[0] - 1
         else:
+            t0 = _obs.now()
             lines = [ln.rstrip("\r")
                      for ln in data.decode("utf-8", "replace").split("\n")
                      if ln.strip()]
             _, seqs, labels = _parse_sequences(lines, self.delim, self.skip,
                                                self.class_ord)
+            _obs.record("stream.parse", t0, sink="markov_lines",
+                        nbytes=len(data))
             self.model.fit(seqs, labels if self.class_labels else None)
             self.rows += len(seqs)
 
@@ -593,16 +627,22 @@ def _cache_counters(src) -> Dict[str, float]:
 
 
 def _write_apriori_outputs(cfg: JobConfig, output: str, levels) -> List[str]:
+    # the miners' artifact-write phase is their "finish": spanned here so
+    # every miner path (solo job, fused fold sink, warm-source serve)
+    # emits job.finish from one place
+    t0 = _obs.now()
     outs = []
     os.makedirs(output or ".", exist_ok=True)
     for k, isl in enumerate(levels, start=1):
         p = os.path.join(output, f"itemsets-{k}.txt")
         isl.save(p, delim=cfg.field_delim)
         outs.append(p)
+    _obs.record("job.finish", t0, job="frequentItemsApriori")
     return outs
 
 
 def _write_gsp_outputs(cfg: JobConfig, output: str, levels) -> List[str]:
+    t0 = _obs.now()
     os.makedirs(output or ".", exist_ok=True)
     outs = []
     delim = cfg.field_delim
@@ -612,6 +652,7 @@ def _write_gsp_outputs(cfg: JobConfig, output: str, levels) -> List[str]:
             for cand, support in sorted(seqs.items()):
                 fh.write(delim.join([*cand, f"{support:.6f}"]) + "\n")
         outs.append(p)
+    _obs.record("job.finish", t0, job="candidateGenerationWithSelfJoin")
     return outs
 
 
@@ -907,14 +948,17 @@ def run_shared(specs: Sequence[Tuple[str, object, str]],
         if fold_hook is not None:
             fold_hook(canonical, fold)
         folds.append((canonical, fold, output))
-        scan.add_sink(fold)
-    scan.run()
+        scan.add_sink(fold, label=canonical)
+    t0 = _obs.now()
+    chunks_scanned = scan.run()
+    _obs.record("job.dispatch", t0, mode="shared", chunks=chunks_scanned,
+                jobs=",".join(c for c, _f, _o in folds))
     results: Dict[str, JobResult] = {}
     for canonical, fold, output in folds:
         if output:
             parent = os.path.dirname(os.path.abspath(output))
             os.makedirs(parent, exist_ok=True)
-        results[canonical] = fold.finish(output)
+        results[canonical] = _finish_fold(fold, output, canonical)
         _add_mem_counters(canonical, next(
             cfg for c, _k, cfg, _f, _o in built if c == canonical),
             inputs, results[canonical])
@@ -1081,6 +1125,7 @@ def _prepare_incremental(canonical: str, cfg: JobConfig, inputs: List[str],
     plan = _IncrementalPlan(canonical, cfg, ops, inputs, output, schema,
                             store, conf_digest)
 
+    t_restore = _obs.now()
     loaded = store.load()
     if loaded is not None:
         meta, blob = loaded
@@ -1125,12 +1170,15 @@ def _prepare_incremental(canonical: str, cfg: JobConfig, inputs: List[str],
                 plan.fps[:len(kept)] = kept
                 plan.hit_blocks = sum(len(x) for x in kept)
                 plan.skipped = sum(wm)
+    restored = plan.fold is not None
     if plan.fold is None:
         plan.watermarks = [0] * len(inputs)
         plan.fps = [[] for _ in inputs]
         plan.hit_blocks = 0
         plan.skipped = 0
         plan.fold = ops.factory(cfg, inputs, schema)
+    _obs.record("job.restore", t_restore, job=canonical,
+                restored=restored, skipped_bytes=plan.skipped)
 
     # the checkpoint footprint is priced against the graftlint-mem
     # analytic model (advisory: the oracle the job-server admission
@@ -1151,6 +1199,7 @@ def _plan_checkpoint(plan: _IncrementalPlan, complete: bool) -> None:
     """Commit one atomic checkpoint of a plan's carry + fingerprints."""
     from avenir_tpu.core import incremental as incr
 
+    t0 = _obs.now()
     plan.seq += 1
     blob = plan.ops.serialize_state(plan.fold)
     meta = {"format": 1, "job": plan.canonical, "seq": plan.seq,
@@ -1161,6 +1210,8 @@ def _plan_checkpoint(plan: _IncrementalPlan, complete: bool) -> None:
             "complete": complete,
             "predicted_peak_bytes": plan.predicted}
     saved = plan.store.save(meta, blob)
+    _obs.record("job.checkpoint", t0, job=plan.canonical, seq=plan.seq,
+                complete=complete, nbytes=len(blob))
     hook = incr._checkpoint_hook
     if hook is not None:
         hook(saved)
@@ -1174,7 +1225,7 @@ def _plan_finish(plan: _IncrementalPlan) -> JobResult:
     if plan.output:
         parent = os.path.dirname(os.path.abspath(plan.output))
         os.makedirs(parent, exist_ok=True)
-    res = plan.fold.finish(plan.output)
+    res = _finish_fold(plan.fold, plan.output, plan.canonical)
     res.counters["Cache:HitBlocks"] = float(plan.hit_blocks)
     res.counters["Cache:DeltaBlocks"] = float(plan.delta_blocks)
     res.counters["Resume:SkippedBytes"] = float(plan.skipped)
@@ -1234,10 +1285,16 @@ def run_incremental(name: str, conf, inputs: Sequence[str],
             for off, data in feed:
                 if not is_blank_block(data):
                     if plan.ops.kind == "dataset":
-                        plan.fold.consume(Dataset.from_csv(
-                            data, plan.schema, delim=plan.delim))
+                        t0 = _obs.now()
+                        payload = Dataset.from_csv(data, plan.schema,
+                                                   delim=plan.delim)
+                        _obs.record("stream.parse", t0, path=path,
+                                    nbytes=len(data), rows=len(payload))
                     else:
-                        plan.fold.consume(data)
+                        payload = data
+                    t0 = _obs.now()
+                    plan.fold.consume(payload)
+                    _obs.record("stream.fold", t0, sink=plan.canonical)
                 plan.fps[si].append(incr.block_fingerprint(off, data))
                 plan.watermarks[si] = off + len(data)
                 plan.delta_blocks += 1
@@ -1339,9 +1396,15 @@ def run_incremental_shared(specs: Sequence[Tuple[str, object, str]],
                 for off, data in feed:
                     payload = None
                     if not is_blank_block(data):
-                        payload = (Dataset.from_csv(data, schema,
-                                                    delim=delim)
-                                   if kind == "dataset" else data)
+                        if kind == "dataset":
+                            t0 = _obs.now()
+                            payload = Dataset.from_csv(data, schema,
+                                                       delim=delim)
+                            _obs.record("stream.parse", t0, path=path,
+                                        nbytes=len(data),
+                                        rows=len(payload))
+                        else:
+                            payload = data
                     yield si, off, data, payload
             finally:
                 feed.close()
@@ -1373,9 +1436,13 @@ def run_incremental_shared(specs: Sequence[Tuple[str, object, str]],
     for group in groups.values():
         scan = SharedScan(delta_feed(group))
         for plan in group:
-            scan.add_sink(fold_sink(plan))
-        scan.add_sink(bookkeeper(group))
-        scan.run()
+            scan.add_sink(fold_sink(plan), label=plan.canonical)
+        scan.add_sink(bookkeeper(group), label="bookkeeper")
+        t0 = _obs.now()
+        chunks_scanned = scan.run()
+        _obs.record("job.dispatch", t0, mode="incremental_shared",
+                    chunks=chunks_scanned,
+                    jobs=",".join(p.canonical for p in group))
 
     return {plan.canonical: _plan_finish(plan) for plan in plans}
 
@@ -1426,12 +1493,14 @@ def bayesian_distribution(cfg: JobConfig, inputs: List[str], output: str) -> Job
     # the mapper's one-line-at-a-time contract at block granularity
     # (BayesianDistribution.java:137); counts are additive so chunking
     # cannot change the model. The fold sink IS the shared-scan sink
-    # (_NBDistrFold): one-job-one-scan is the single-sink special case.
+    # (_NBDistrFold): one-job-one-scan is the single-sink special case,
+    # driven through SharedScan so the per-chunk fold spans come from
+    # the same instrumentation point as the fused path.
     schema = _schema(cfg)
     fold = _NBDistrFold(cfg, inputs, schema)
-    for ds in stream_job_inputs(cfg, inputs, schema):
-        fold.consume(ds)
-    return fold.finish(output)
+    _drive_fold(fold, stream_job_inputs(cfg, inputs, schema),
+                "bayesianDistr")
+    return _finish_fold(fold, output, "bayesianDistr")
 
 
 @job("bayesianPredictor", "bap", "org.avenir.bayesian.BayesianPredictor")
@@ -1962,9 +2031,9 @@ def mutual_information_job(cfg: JobConfig, inputs: List[str], output: str) -> Jo
     # MutualInformation.java:138-216); the fold sink doubles as the
     # shared-scan sink (_MutualInfoFold)
     fold = _MutualInfoFold(cfg, inputs, None)
-    for ds in stream_job_inputs(cfg, inputs, _schema(cfg)):
-        fold.consume(ds)
-    return fold.finish(output)
+    _drive_fold(fold, stream_job_inputs(cfg, inputs, _schema(cfg)),
+                "mutualInformation")
+    return _finish_fold(fold, output, "mutualInformation")
 
 
 @job("ruleEvaluator", "rue", "org.avenir.explore.RuleEvaluator")
@@ -2667,9 +2736,9 @@ def markov_model_job(cfg: JobConfig, inputs: List[str], output: str) -> JobResul
     from avenir_tpu.core.stream import stream_job_byte_blocks
 
     fold = _MarkovPerClassFold(cfg, inputs)
-    for data in stream_job_byte_blocks(cfg, inputs):
-        fold.consume(data)
-    return fold.finish(output)
+    _drive_fold(fold, stream_job_byte_blocks(cfg, inputs),
+                "markovStateTransitionModel")
+    return _finish_fold(fold, output, "markovStateTransitionModel")
 
 
 @job("markovModelClassifier", "mmc",
@@ -2841,9 +2910,9 @@ def fisher_job(cfg: JobConfig, inputs: List[str], output: str) -> JobResult:
 
     # the fold sink doubles as the shared-scan sink (_FisherFold)
     fold = _FisherFold(cfg, inputs, None)
-    for chunk in stream_job_inputs(cfg, inputs, _schema(cfg)):
-        fold.consume(chunk)
-    return fold.finish(output)
+    _drive_fold(fold, stream_job_inputs(cfg, inputs, _schema(cfg)),
+                "fisherDiscriminant")
+    return _finish_fold(fold, output, "fisherDiscriminant")
 
 
 # ======================================================================= text
@@ -3030,7 +3099,10 @@ def run_from_cli(argv: Sequence[str]) -> JobResult:
     `python -m avenir_tpu serve ...` instead starts the resident
     multi-tenant job server over a stdin/filesystem request spool
     (avenir_tpu.server.spool — batched shared scans, warm caches,
-    byte-budget admission; no network dependency)."""
+    byte-budget admission; no network dependency), and
+    `python -m avenir_tpu stats <dir>` renders the live metrics.json
+    snapshot a running server writes next to its spool
+    (avenir_tpu.obs.report)."""
     import argparse
 
     if argv and argv[0] == "serve":
@@ -3040,6 +3112,14 @@ def run_from_cli(argv: Sequence[str]) -> JobResult:
         if rc:
             sys.exit(rc)
         return JobResult("serve")
+
+    if argv and argv[0] == "stats":
+        from avenir_tpu.obs.report import stats_main
+
+        rc = stats_main(list(argv[1:]))
+        if rc:
+            sys.exit(rc)
+        return JobResult("stats")
 
     ap = argparse.ArgumentParser(prog="avenir_tpu")
     ap.add_argument("jobname", help="job name or reference Tool class")

@@ -59,6 +59,7 @@ the shared-scan and merge auditors re-prove every round.
 
 from __future__ import annotations
 
+import json
 import os
 import queue
 import shutil
@@ -67,6 +68,9 @@ import time
 import uuid
 from dataclasses import dataclass, field
 from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+from avenir_tpu import obs as _obs
+from avenir_tpu.obs.histogram import LatencyHistogram
 
 #: default admission ceiling: the repo's standing 3GB RSS budget
 #: (tools/stream_scale_check.py asserts it at every 100M-row anchor)
@@ -525,7 +529,9 @@ def _fold_kernel_cache_size() -> int:
 @dataclass
 class _Batch:
     """One admitted dispatch: `primaries` execute (one spec each),
-    `dups[i]` receive copies of primary i's artifact."""
+    `dups[i]` receive copies of primary i's artifact. ``batch_id`` is
+    the dispatch-clock ordinal — the linkage attr every per-request
+    span carries so a trace groups requests back into their batch."""
 
     tickets: List[Ticket]
     dups: List[List[Ticket]]
@@ -533,6 +539,7 @@ class _Batch:
     streamable: bool
     priced_bytes: int
     dispatched_at: float
+    batch_id: int = 0
 
 
 class JobServer:
@@ -551,7 +558,9 @@ class JobServer:
                  starvation_ms: float = DEFAULT_STARVATION_MS,
                  state_root: Optional[str] = None,
                  pricer: Optional[Callable] = None,
-                 rss_probe: Callable[[], int] = _process_rss_bytes):
+                 rss_probe: Callable[[], int] = _process_rss_bytes,
+                 metrics_path: Optional[str] = None,
+                 metrics_interval_s: float = 2.0):
         self._lock = threading.Lock()
         self._work = threading.Condition(self._lock)
         self._queues: Dict[str, List[Ticket]] = {}
@@ -579,6 +588,22 @@ class JobServer:
             "warm_hits": 0, "compile_warm_dispatches": 0,
         }
         self._dispatch_clock = 0
+        # streaming latency histograms (avenir_tpu.obs.histogram): the
+        # distribution view the old last-value-only scalars could not
+        # give — fed per finished request / per dispatched batch,
+        # surfaced in stats(), metrics.json and the per-result
+        # Server:*P50/P99 counters
+        self._hists: Dict[str, LatencyHistogram] = {
+            "queue_wait_ms": LatencyHistogram(),
+            "admission_held_ms": LatencyHistogram(),
+            "dispatch_ms": LatencyHistogram(),
+        }
+        self._started_at = time.perf_counter()
+        # live metrics surface: when set, the scheduler atomic-renames a
+        # metrics.json snapshot here every `metrics_interval_s`
+        self.metrics_path = metrics_path
+        self.metrics_interval_s = float(metrics_interval_s)
+        self._metrics_written_at = 0.0
 
     # ------------------------------------------------------------ public
     def __enter__(self) -> "JobServer":
@@ -688,6 +713,12 @@ class JobServer:
         for ticket in leftovers:
             ticket._complete(error=ServerClosed(
                 "server shut down before the request was served"))
+        # final snapshot: a short --once spool session must still leave
+        # a fresh metrics.json behind even when no interval tick fired
+        try:
+            self.write_metrics()
+        except OSError:
+            pass
         self.warm.close()
         if wedged:
             raise RuntimeError(
@@ -696,7 +727,7 @@ class JobServer:
         if drain_err is not None:
             raise drain_err
 
-    def stats(self) -> Dict[str, float]:
+    def stats(self) -> Dict:
         with self._lock:
             out = dict(self._stats)
             out["inflight_bytes"] = float(self._admission.inflight_bytes)
@@ -706,8 +737,80 @@ class JobServer:
             # advisory observability, never an admission input (the
             # _Admission docstring has the why)
             out["rss_bytes"] = float(self._admission.rss_probe())
+            # latency distributions (not scalars): {name: {count, mean,
+            # min, max, p50, p95, p99}} per histogram — the tail view
+            # the last-value Server:* counters could never give
+            out["hists"] = {name: h.summary()
+                            for name, h in self._hists.items()}
         out.update({f"warm_{k}": v for k, v in self.warm.stats().items()})
         return out
+
+    # ------------------------------------------------- live metrics surface
+    def metrics_snapshot(self) -> Dict:
+        """The live operator snapshot (``metrics.json`` schema —
+        docs/observability.md pins it): queue depths per tenant,
+        in-flight priced bytes vs budget, warm-store occupancy, served/
+        batch counters, and the latency histogram summaries (the
+        server's queue-wait/held/dispatch hists plus the process-global
+        obs hists like ``chunk_latency_ms``)."""
+        with self._lock:
+            queues = {tenant: len(q)
+                      for tenant, q in self._queues.items() if q}
+            inflight = {
+                "priced_bytes": int(self._admission.inflight_bytes),
+                "peak_priced_bytes": int(self._admission.peak_priced_bytes),
+                "budget_bytes": int(self._admission.budget),
+                "batches": int(self._admission.inflight_batches),
+            }
+            stats = {k: float(v) for k, v in self._stats.items()}
+            hists = {name: h.summary()
+                     for name, h in self._hists.items()}
+        # process-global streaming hists (chunk_latency_ms etc.) ride
+        # along; the server's own names win on collision
+        for name, summary in _obs.hist_summaries().items():
+            hists.setdefault(name, summary)
+        return {"ts_unix": time.time(),
+                "uptime_s": round(time.perf_counter() - self._started_at,
+                                  3),
+                "queues": queues,
+                "inflight": inflight,
+                "warm": self.warm.stats(),
+                "stats": stats,
+                "hists": hists,
+                "trace": {"spans": len(_obs.recorder()),
+                          "dropped_spans": _obs.recorder().dropped,
+                          "enabled": _obs.enabled()}}
+
+    def write_metrics(self, path: Optional[str] = None) -> Optional[str]:
+        """Atomically write the snapshot to `path` (default
+        ``self.metrics_path``); tmp + ``os.replace`` so a reader
+        (``python -m avenir_tpu stats``) never sees a torn file.
+        Returns the path written, or None when no path is configured."""
+        path = path or self.metrics_path
+        if not path:
+            return None
+        snap = self.metrics_snapshot()
+        tmp = f"{path}.{os.getpid()}.tmp"
+        with open(tmp, "w") as fh:
+            json.dump(snap, fh)
+        os.replace(tmp, path)
+        return path
+
+    def _maybe_write_metrics(self) -> None:
+        """Scheduler-loop tick: refresh the snapshot at most every
+        ``metrics_interval_s`` seconds. Snapshot errors are swallowed —
+        the metrics surface is observability, never a reason to stop
+        serving."""
+        if not self.metrics_path:
+            return
+        now = time.perf_counter()
+        if now - self._metrics_written_at < self.metrics_interval_s:
+            return
+        self._metrics_written_at = now
+        try:
+            self.write_metrics()
+        except OSError:
+            pass
 
     # ------------------------------------------------- scheduler internals
     def _pending_locked(self) -> int:
@@ -812,7 +915,8 @@ class JobServer:
         self._stats["batched_requests"] += n if n > 1 else 0
         self._stats["coalesced"] += sum(len(d) for d in dups)
         return _Batch(primaries, dups, seed.request.mode,
-                      key is not None, priced, now)
+                      key is not None, priced, now,
+                      batch_id=self._dispatch_clock)
 
     def _remove_locked(self, ticket: Ticket) -> None:
         q = self._queues.get(ticket.request.tenant)
@@ -822,6 +926,7 @@ class JobServer:
 
     def _scheduler_loop(self) -> None:
         while not self._shutdown.is_set():
+            self._maybe_write_metrics()
             with self._work:
                 batch = self._pick_batch_locked()
                 if batch is None:
@@ -880,13 +985,21 @@ class JobServer:
         compile_hit = 1.0 if _fold_kernel_cache_size() == compiles_before \
             else 0.0
         n = len(batch.tickets) + sum(len(d) for d in batch.dups)
+        dispatch_ms = (time.perf_counter() - batch.dispatched_at) * 1000.0
+        with self._lock:
+            self._hists["dispatch_ms"].add(dispatch_ms)
+        _obs.record("server.dispatch", batch.dispatched_at,
+                    batch=batch.batch_id, mode=batch.mode, requests=n,
+                    jobs=",".join(t._canonical or t.request.job
+                                  for t in batch.tickets))
         for i, ticket in enumerate(batch.tickets):
             res = results[i]
-            self._finish_ticket(ticket, res, n, compile_hit, warm_hit)
+            self._finish_ticket(ticket, res, batch, n, compile_hit,
+                                warm_hit)
             for dup in batch.dups[i]:
                 self._finish_ticket(
                     dup, _copy_result(res, ticket.request, dup.request),
-                    n, compile_hit, warm_hit)
+                    batch, n, compile_hit, warm_hit)
         with self._lock:
             self._stats["served"] += n
             if compile_hit:
@@ -894,17 +1007,56 @@ class JobServer:
             if warm_hit:
                 self._stats["warm_hits"] += 1
 
-    def _finish_ticket(self, ticket: Ticket, res, batch_n: int,
-                       compile_hit: float, warm_hit: float) -> None:
+    def _finish_ticket(self, ticket: Ticket, res, batch: _Batch,
+                       batch_n: int, compile_hit: float,
+                       warm_hit: float) -> None:
         now = time.perf_counter()
-        res.counters["Server:QueueWaitMs"] = round(
-            ((ticket._dispatched_at or now) - ticket.submitted_at)
-            * 1000.0, 3)
+        dispatched = ticket._dispatched_at or now
+        wait_ms = (dispatched - ticket.submitted_at) * 1000.0
+        held_ms = ticket._held_ms
+        # the per-request scalars (unchanged keys/semantics) now ALSO
+        # feed the server-level histograms, whose p50/p99 ride along on
+        # every result — a tenant sees the fleet-wide tail next to its
+        # own sample
+        with self._lock:
+            qh = self._hists["queue_wait_ms"].add(wait_ms)
+            ah = self._hists["admission_held_ms"].add(held_ms)
+            q50, q99 = qh.quantile(50), qh.quantile(99)
+            h50, h99 = ah.quantile(50), ah.quantile(99)
+        res.counters["Server:QueueWaitMs"] = round(wait_ms, 3)
         res.counters["Server:BatchSize"] = float(batch_n)
         res.counters["Server:CompileHits"] = compile_hit
-        res.counters["Server:AdmissionHeldMs"] = round(ticket._held_ms, 3)
+        res.counters["Server:AdmissionHeldMs"] = round(held_ms, 3)
         res.counters["Server:WarmHit"] = warm_hit
+        res.counters["Server:QueueWaitP50Ms"] = round(q50, 3)
+        res.counters["Server:QueueWaitP99Ms"] = round(q99, 3)
+        res.counters["Server:AdmissionHeldP50Ms"] = round(h50, 3)
+        res.counters["Server:AdmissionHeldP99Ms"] = round(h99, 3)
+        # the request's span trail: queued -> (held) -> dispatched ->
+        # finished, all linked to the batch by its dispatch ordinal
+        req = ticket.request
+        link = dict(req_id=req.req_id, tenant=req.tenant,
+                    job=ticket._canonical or req.job,
+                    batch=batch.batch_id)
+        if _obs.enabled():
+            _obs.recorder().record(
+                "server.queued", ticket.submitted_at,
+                max(dispatched - ticket.submitted_at, 0.0), attrs=link)
+        if held_ms > 0:
+            self._obs_record_held(dispatched, held_ms, link)
+        _obs.record("server.request", ticket.submitted_at, mode=req.mode,
+                    batch_size=batch_n, **link)
         ticket._complete(result=res)
+
+    @staticmethod
+    def _obs_record_held(dispatched: float, held_ms: float,
+                         link: Dict) -> None:
+        # a held batch is re-checked until it admits, so the hold ends
+        # exactly at dispatch: reconstruct t0 from the accumulated hold
+        if _obs.enabled():
+            t0 = dispatched - held_ms / 1000.0
+            _obs.recorder().record("server.held", t0, held_ms / 1000.0,
+                                   attrs=link)
 
     def _run_batch(self, batch: _Batch) -> Tuple[List, float]:
         """Execute primaries through the registered runner paths;

@@ -202,12 +202,25 @@ def serve_main(argv) -> int:
     ap.add_argument("--state-root", default=None,
                     help="managed incremental-checkpoint root (default: "
                          "a per-session temp dir)")
+    ap.add_argument("--metrics", default=None,
+                    help="metrics.json snapshot path (default: "
+                         "<spool>/metrics.json in spool mode; off for "
+                         "--stdin unless given)")
+    ap.add_argument("--metrics-interval", type=float, default=2.0,
+                    help="seconds between metrics.json refreshes "
+                         "(default 2)")
     args = ap.parse_args(argv)
+    metrics_path = args.metrics
+    if metrics_path is None and args.spool:
+        os.makedirs(args.spool, exist_ok=True)
+        metrics_path = os.path.join(args.spool, "metrics.json")
     server = JobServer(budget_bytes=int(args.budget_mb * (1 << 20)),
                        workers=args.workers,
                        warm_budget_bytes=int(
                            args.warm_budget_mb * (1 << 20)),
-                       state_root=args.state_root)
+                       state_root=args.state_root,
+                       metrics_path=metrics_path,
+                       metrics_interval_s=args.metrics_interval)
     server.start()
     try:
         if args.stdin:

@@ -18,6 +18,7 @@ from __future__ import annotations
 
 import contextlib
 import math
+import threading
 import time
 from dataclasses import dataclass
 from typing import Dict, Iterator, List
@@ -29,12 +30,17 @@ class PhaseTimer:
     with timer.phase("ingest"): ...
     with timer.phase("train"): ...
     timer.report() -> {"ingest": seconds, ...}
-    """
+
+    Thread-safe: phase exits mutate the accumulators under a lock, so
+    one timer can be shared across server worker threads (phases that
+    OVERLAP in time still sum their full durations — per-worker timers
+    aggregated through :meth:`merge` are the per-thread view)."""
 
     def __init__(self):
         self.totals: Dict[str, float] = {}
         self.counts: Dict[str, int] = {}
         self._order: List[str] = []
+        self._lock = threading.Lock()
 
     @contextlib.contextmanager
     def phase(self, name: str) -> Iterator[None]:
@@ -43,23 +49,48 @@ class PhaseTimer:
             yield
         finally:
             dt = time.perf_counter() - t0
-            if name not in self.totals:
-                self._order.append(name)
-                self.totals[name] = 0.0
-                self.counts[name] = 0
-            self.totals[name] += dt
-            self.counts[name] += 1
+            with self._lock:
+                if name not in self.totals:
+                    self._order.append(name)
+                    self.totals[name] = 0.0
+                    self.counts[name] = 0
+                self.totals[name] += dt
+                self.counts[name] += 1
+
+    def _snapshot(self) -> Dict[str, tuple]:
+        with self._lock:
+            return {name: (self.totals[name], self.counts[name])
+                    for name in self._order}
+
+    def merge(self, other: "PhaseTimer") -> "PhaseTimer":
+        """Fold another timer's accumulators into this one (additive,
+        like every fold-state merge in the repo) — how per-worker
+        timers aggregate into one report. Snapshot-then-apply: the two
+        locks are never held together, so ``a.merge(b)`` can never
+        deadlock against a concurrent ``b.merge(a)``."""
+        for name, (total, count) in other._snapshot().items():
+            with self._lock:
+                if name not in self.totals:
+                    self._order.append(name)
+                    self.totals[name] = 0.0
+                    self.counts[name] = 0
+                self.totals[name] += total
+                self.counts[name] += count
+        return self
 
     def report(self) -> Dict[str, float]:
-        return {name: self.totals[name] for name in self._order}
+        with self._lock:
+            return {name: self.totals[name] for name in self._order}
 
     def summary(self) -> str:
-        total = sum(self.totals.values()) or 1.0
-        lines = []
-        for name in self._order:
-            t = self.totals[name]
-            lines.append(f"{name:>20s}  {t:9.3f}s  {100 * t / total:5.1f}%  "
-                         f"x{self.counts[name]}")
+        with self._lock:
+            total = sum(self.totals.values()) or 1.0
+            lines = []
+            for name in self._order:
+                t = self.totals[name]
+                lines.append(
+                    f"{name:>20s}  {t:9.3f}s  {100 * t / total:5.1f}%  "
+                    f"x{self.counts[name]}")
         return "\n".join(lines)
 
 
@@ -67,8 +98,15 @@ class PhaseTimer:
 def trace(log_dir: str) -> Iterator[None]:
     """jax.profiler device trace of the enclosed region, written for
     TensorBoard / xprof. No-ops cleanly if the profiler can't start (e.g.
-    an already-active trace)."""
+    an already-active trace).
+
+    The region also records into the avenir-trace span recorder
+    (``jax.profiler.trace`` span with the device trace dir and whether
+    the profiler actually started as attrs), so a host-side Chrome
+    trace links each device-trace capture to the phase that took it."""
     import jax
+
+    from avenir_tpu import obs
 
     started = False
     try:
@@ -76,6 +114,7 @@ def trace(log_dir: str) -> Iterator[None]:
         started = True
     except Exception:
         pass
+    t0 = obs.now()
     try:
         yield
     finally:
@@ -84,6 +123,8 @@ def trace(log_dir: str) -> Iterator[None]:
                 jax.profiler.stop_trace()
             except Exception:
                 pass
+        obs.record("jax.profiler.trace", t0, log_dir=log_dir,
+                   started=started)
 
 
 @dataclass

@@ -111,6 +111,19 @@ def graftlint_tripwire() -> dict:
         raise RuntimeError(
             f"incremental-scan audit regression: append/resume output "
             f"drifted for {unincr}")
+    # span-coverage leg (avenir-trace): every registered stream entry,
+    # run under a captured recorder, must emit the mandatory span set
+    # (read/parse/fold/finish) — an instrumentation point lost in a
+    # refactor fails the bench this round, not the next profiling
+    # session. Same >= 8 floor as the other stream-entry legs.
+    from avenir_tpu.obs.coverage import audit_span_coverage
+
+    cov = audit_span_coverage()
+    blind = [r["kernel"] for r in cov if not r["span_coverage_validated"]]
+    if blind or len(cov) < 8:
+        raise RuntimeError(
+            f"span-coverage audit regression: {len(cov)} stream entries "
+            f"audited, blind={blind}")
     # re-derive the admission oracle and pin it next to the scale
     # records so the job-server work consumes a fresh artifact, not a
     # stale hand-written one
@@ -134,6 +147,7 @@ def graftlint_tripwire() -> dict:
             "merge_allowlisted": merge_rep["suppressed"],
             "merge_kernels_validated": len(ma),
             "incremental_kernels_validated": len(ma) - len(unincr),
+            "span_coverage_validated": len(cov),
             "memory_manifest": "MEMORY_MANIFEST.json"}
 
 
@@ -392,6 +406,114 @@ def shared_scan_tripwire(rows: int = 30_000) -> dict:
         shutil.rmtree(d, ignore_errors=True)
 
 
+def obs_tripwire(rows: int = 10_000_000, ceiling: float = 1.03) -> dict:
+    """Telemetry overhead + coverage tripwire: the fused churn trio
+    (nb + mi + discriminant through ONE SharedScan) runs once with
+    tracing OFF and once with tracing ON under a captured recorder; the
+    traced run must stay within `ceiling`x of the untraced wall clock,
+    the artifacts must be byte-identical, and the captured trace must
+    hold >= 1 read/parse span per chunk plus >= chunk-count fold spans
+    for EVERY job in the batch — always-on telemetry that either slowed
+    the hot path or went blind fails the bench, not the next profiling
+    session."""
+    import os
+    import shutil
+    import time
+    from collections import Counter
+
+    from avenir_tpu.data import churn_schema, generate_churn
+    from avenir_tpu.obs import trace
+    from avenir_tpu.runner import run_shared
+
+    d = tempfile.mkdtemp(prefix="avenir_obs_tripwire_")
+    try:
+        csv = os.path.join(d, "churn.csv")
+        blob = generate_churn(100_000, seed=21, as_csv=True)
+        with open(csv, "w") as fh:
+            for _ in range(max(rows // 100_000, 1)):
+                fh.write(blob)
+        schema = os.path.join(d, "churn.json")
+        churn_schema().save(schema)
+        conf = lambda p: {f"{p}.feature.schema.file.path": schema,  # noqa: E731
+                          f"{p}.stream.block.size.mb": "8"}
+        mi_conf = {**conf("mut"),
+                   "mut.mutual.info.score.algorithms":
+                       "mutual.info.maximization"}
+        specs = [("bayesianDistr", conf("bad"), "nb"),
+                 ("mutualInformation", mi_conf, "mi"),
+                 ("fisherDiscriminant", conf("fid"), "fid")]
+        jobs = [j for j, _c, _o in specs]
+        # warmup: one untimed pass over the REAL corpus, so jit compiles
+        # for the actual chunk shapes and the page-cache fill price
+        # neither timed side (a tiny-corpus warmup leaves the first
+        # timed run paying the big-chunk compiles — a 3% bound cannot
+        # survive that)
+        run_shared([(j, c, os.path.join(d, f"warm_{o}"))
+                    for j, c, o in specs], [csv])
+        import contextlib
+
+        try:
+            from bench import _host_core_lock
+        except ImportError:                      # bench.py not importable
+            _host_core_lock = contextlib.nullcontext
+        with _host_core_lock():
+            prev = trace.set_enabled(False)
+            try:
+                t0 = time.perf_counter()
+                off_res = run_shared(
+                    [(j, c, os.path.join(d, f"off_{o}"))
+                     for j, c, o in specs], [csv])
+                t_off = time.perf_counter() - t0
+            finally:
+                trace.set_enabled(prev)
+            with trace.capture() as rec:
+                t0 = time.perf_counter()
+                on_res = run_shared(
+                    [(j, c, os.path.join(d, f"on_{o}"))
+                     for j, c, o in specs], [csv])
+                t_on = time.perf_counter() - t0
+        for j in jobs:
+            for a, b in zip(sorted(off_res[j].outputs),
+                            sorted(on_res[j].outputs)):
+                with open(a, "rb") as fa, open(b, "rb") as fb:
+                    if fa.read() != fb.read():
+                        raise RuntimeError(
+                            f"tracing changed the output of {j} "
+                            f"({b} vs {a}) — instrumentation must be "
+                            f"observation-only")
+        spans = rec.spans()
+        chunks = next((int(sp.attrs["chunks"]) for sp in spans
+                       if sp.name == "job.dispatch"), 0)
+        names = Counter(sp.name for sp in spans)
+        folds = Counter(sp.attrs.get("sink") for sp in spans
+                        if sp.name == "stream.fold" and sp.attrs)
+        if chunks < 1:
+            raise RuntimeError("traced fused run recorded no job.dispatch "
+                               "span — the scan executor went blind")
+        blind = [j for j in jobs if folds.get(j, 0) < chunks]
+        if (blind or names["stream.read"] < chunks
+                or names["stream.parse"] < chunks):
+            raise RuntimeError(
+                f"trace coverage hole: {chunks} chunks scanned but "
+                f"read={names['stream.read']} parse={names['stream.parse']} "
+                f"folds={dict(folds)} (jobs missing folds: {blind})")
+        overhead = t_on / max(t_off, 1e-9)
+        if overhead > ceiling:
+            raise RuntimeError(
+                f"tracing overhead {overhead:.3f}x exceeds the "
+                f"{ceiling}x ceiling (off {t_off:.2f}s, on {t_on:.2f}s) "
+                f"— always-on telemetry is no longer cheap")
+        return {"rows": rows, "ceiling": ceiling,
+                "overhead_ratio": round(overhead, 4),
+                "t_off_s": round(t_off, 2), "t_on_s": round(t_on, 2),
+                "chunks": chunks,
+                "spans": len(spans),
+                "spans_dropped": rec.dropped,
+                "outputs_byte_identical": True}
+    finally:
+        shutil.rmtree(d, ignore_errors=True)
+
+
 def server_load(churn: str, seq: str, schema: str) -> list:
     """The canonical 6-request / 3-tenant mixed-kind open-loop load —
     (tenant, job, conf, corpus, tag) rows — shared by
@@ -613,6 +735,12 @@ def main(n_devices: int = 8, quick: bool = False):
     line["server_tripwire"] = (
         server_tripwire(100_000, floor=1.2) if quick
         else server_tripwire())
+    # quick mode's runs are short enough that scheduler jitter swamps
+    # the 3% overhead bound; the real <=1.03x gate runs at the 10M-row
+    # proxy every full round
+    line["obs_tripwire"] = (
+        obs_tripwire(100_000, ceiling=1.25) if quick
+        else obs_tripwire())
     line["graftlint"] = graftlint_tripwire()
     print(json.dumps(line))
 

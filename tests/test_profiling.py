@@ -1,6 +1,7 @@
 """PhaseTimer / trace / RunningStats / Histogram percentile utilities."""
 
 import math
+import threading
 import time
 
 import numpy as np
@@ -25,6 +26,50 @@ def test_phase_timer_accumulates():
     assert "a" in t.summary() and "%" in t.summary()
 
 
+def test_phase_timer_is_thread_safe():
+    """Regression: the dict mutations in phase() used to race when one
+    timer was shared across server worker threads — concurrent first
+    exits of the same phase could lose counts (read-modify-write on
+    totals/counts) or double-append to the report order."""
+    t = PhaseTimer()
+    n_threads, per_thread = 8, 200
+    barrier = threading.Barrier(n_threads)
+
+    def worker():
+        barrier.wait()                  # maximize first-exit contention
+        for _ in range(per_thread):
+            with t.phase("hot"):
+                pass
+            with t.phase("cold"):
+                pass
+
+    threads = [threading.Thread(target=worker) for _ in range(n_threads)]
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join()
+    assert t.counts["hot"] == n_threads * per_thread
+    assert t.counts["cold"] == n_threads * per_thread
+    assert sorted(t.report()) == ["cold", "hot"]   # no duplicate order rows
+
+
+def test_phase_timer_merge_aggregates_workers():
+    a, b = PhaseTimer(), PhaseTimer()
+    with a.phase("ingest"):
+        time.sleep(0.005)
+    with b.phase("ingest"):
+        time.sleep(0.005)
+    with b.phase("train"):
+        time.sleep(0.002)
+    out = a.merge(b)
+    assert out is a
+    assert a.counts == {"ingest": 2, "train": 1}
+    assert a.report()["ingest"] >= 0.008
+    assert list(a.report()) == ["ingest", "train"]
+    # b is only read: per-worker timers survive their own aggregation
+    assert b.counts == {"ingest": 1, "train": 1}
+
+
 def test_trace_writes_profile(tmp_path):
     import jax
     import jax.numpy as jnp
@@ -35,6 +80,22 @@ def test_trace_writes_profile(tmp_path):
     import os
     found = [f for _, _, fs in os.walk(d) for f in fs]
     assert found, "no trace files written"
+
+
+def test_trace_records_span_with_device_trace_dir(tmp_path):
+    """profiling.trace() feeds the avenir-trace recorder: the region
+    shows up as one span whose attrs carry the device trace dir and
+    whether the jax profiler actually started."""
+    from avenir_tpu.obs import trace as obs_trace
+
+    d = str(tmp_path / "trace")
+    with obs_trace.capture() as rec:
+        with trace(d):
+            pass
+    spans = [sp for sp in rec.spans() if sp.name == "jax.profiler.trace"]
+    assert len(spans) == 1
+    assert spans[0].attrs["log_dir"] == d
+    assert spans[0].attrs["started"] in (True, False)
 
 
 def test_running_stats_matches_numpy():

@@ -522,6 +522,80 @@ def test_serve_spool_once(tmp_path):
     assert _read(str(tmp_path / "sp.txt")) == _read(twin.outputs[0])
 
 
+def test_metrics_snapshot_written_and_rendered(tmp_path):
+    """The live metrics surface: a serving JobServer atomic-renames a
+    metrics.json snapshot; the queue-wait/admission-hold histograms
+    carry nonzero counts after serving, the per-result scalar keys are
+    unchanged and the new P50/P99 keys ride along, and `python -m
+    avenir_tpu stats` renders the file."""
+    from avenir_tpu.obs.report import load_metrics, render_metrics
+
+    csv, schema = _churn(tmp_path, rows=400)
+    mp = str(tmp_path / "metrics.json")
+    with _server(tmp_path, workers=1, metrics_path=mp,
+                 metrics_interval_s=0.0) as srv:
+        t1 = srv.submit(JobRequest("bayesianDistr", _conf("bad", schema),
+                                   [csv], str(tmp_path / "m1.csv"),
+                                   tenant="a"))
+        t2 = srv.submit(JobRequest("fisherDiscriminant",
+                                   _conf("fid", schema), [csv],
+                                   str(tmp_path / "m2.txt"), tenant="b"))
+        srv.drain(timeout=240)
+        r1, r2 = t1.result(timeout=10), t2.result(timeout=10)
+        stats = srv.stats()
+    # both results: old scalar keys unchanged, histogram keys new
+    for res in (r1, r2):
+        assert res.counters["Server:QueueWaitMs"] >= 0.0
+        assert res.counters["Server:AdmissionHeldMs"] >= 0.0
+        assert res.counters["Server:QueueWaitP50Ms"] >= 0.0
+        assert res.counters["Server:QueueWaitP99Ms"] >= \
+            res.counters["Server:QueueWaitP50Ms"]
+        assert "Server:AdmissionHeldP99Ms" in res.counters
+    # stats() surfaces the full summaries
+    assert stats["hists"]["queue_wait_ms"]["count"] == 2
+    assert stats["hists"]["admission_held_ms"]["count"] == 2
+    assert stats["hists"]["dispatch_ms"]["count"] >= 1
+    # the snapshot on disk (shutdown wrote a final one) is valid and
+    # renders; histograms show the served requests
+    snap = load_metrics(str(tmp_path))
+    assert snap["stats"]["served"] == 2
+    assert snap["inflight"]["budget_bytes"] > 0
+    assert snap["hists"]["queue_wait_ms"]["count"] == 2
+    assert snap["hists"]["admission_held_ms"]["count"] == 2
+    assert "chunk_latency_ms" in snap["hists"]
+    text = render_metrics(snap)
+    assert "served: 2" in text
+    assert "queue_wait_ms" in text
+
+
+def test_metrics_snapshot_refreshes_during_serving(tmp_path):
+    """The scheduler tick (not only shutdown) refreshes the snapshot:
+    with a zero interval, a snapshot must exist while the server is
+    still up, and `python -m avenir_tpu stats` exits 0 on it."""
+    from avenir_tpu.obs.report import stats_main
+
+    csv, schema = _churn(tmp_path, rows=400)
+    mp = str(tmp_path / "metrics.json")
+    with _server(tmp_path, workers=1, metrics_path=mp,
+                 metrics_interval_s=0.0) as srv:
+        t = srv.submit(JobRequest("bayesianDistr", _conf("bad", schema),
+                                  [csv], str(tmp_path / "m.csv"),
+                                  tenant="a"))
+        t.result(timeout=240)
+        deadline = 100
+        while not os.path.exists(mp) and deadline:
+            import time
+
+            time.sleep(0.05)
+            deadline -= 1
+        assert os.path.exists(mp), "no snapshot while serving"
+        live = json.load(open(mp))
+        assert live["stats"]["submitted"] >= 1
+    assert stats_main([mp]) == 0
+    assert stats_main([mp, "--json"]) == 0
+    assert stats_main([str(tmp_path / "nope.json")]) == 2
+
+
 def test_serve_cli_stdin(tmp_path):
     """`python -m avenir_tpu serve --stdin` — the hermetic CLI session:
     one request line in, one result line out, rc 0."""

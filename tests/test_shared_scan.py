@@ -200,6 +200,49 @@ def test_run_shared_rejects_bad_groups(tmp_path):
     assert "bayesianDistr" in stream_fold_names()
 
 
+# -------------------------------------------------------------- telemetry
+def test_fused_outputs_byte_identical_under_tracing(tmp_path):
+    """avenir-trace is observation-only: the fused scan with the span
+    recorder capturing must produce byte-identical artifacts to the
+    same scan with tracing disabled, and the capture must hold the
+    per-chunk read/parse/fold span set for every sink (the obs
+    tripwire's correctness gate at unit scale)."""
+    from collections import Counter
+
+    from avenir_tpu.obs import trace
+
+    csv, schema = _churn(tmp_path, rows=600)
+    conf = lambda p: {f"{p}.feature.schema.file.path": schema,  # noqa: E731
+                      f"{p}.stream.block.size.mb": "0.005"}
+    specs = lambda tag: [  # noqa: E731
+        ("bayesianDistr", conf("bad"), str(tmp_path / f"nb_{tag}")),
+        ("fisherDiscriminant", conf("fid"), str(tmp_path / f"fd_{tag}"))]
+    prev = trace.set_enabled(False)
+    try:
+        untraced = run_shared(specs("off"), [csv])
+    finally:
+        trace.set_enabled(prev)
+    with trace.capture() as rec:
+        traced = run_shared(specs("on"), [csv])
+    for name in untraced:
+        assert _read_outputs(traced[name]) == _read_outputs(untraced[name])
+    spans = rec.spans()
+    chunks = next(int(sp.attrs["chunks"]) for sp in spans
+                  if sp.name == "job.dispatch")
+    assert chunks > 1, "corpus did not chunk — the per-chunk claim is vacuous"
+    names = Counter(sp.name for sp in spans)
+    assert names["stream.read"] >= chunks
+    assert names["stream.parse"] >= chunks
+    folds = Counter(sp.attrs["sink"] for sp in spans
+                    if sp.name == "stream.fold")
+    assert folds["bayesianDistr"] == chunks
+    assert folds["fisherDiscriminant"] == chunks
+    assert names["job.finish"] == 2
+    # every chunk's fan-out also fed the process-global latency histogram
+    h = trace.hist("chunk_latency_ms")
+    assert h is not None and h.count >= chunks
+
+
 # ------------------------------------------------------- failure isolation
 def test_sink_failure_joins_prefetch_worker():
     """A sink raising mid-scan must not wedge or leak the prefetch

@@ -47,9 +47,13 @@ With --server, additionally measures the resident job server: the same
 (churn profilers + sequence jobs + one duplicate request), served by an
 in-process JobServer vs sequential one-job-at-a-time execution, in a
 fresh child — recording jobs/min both ways, the speedup, p50/p99 queue
-wait, and the per-request Server:* counters (Server:QueueWaitMs /
+wait, the per-request Server:* counters (Server:QueueWaitMs /
 Server:BatchSize / Server:CompileHits / Server:AdmissionHeldMs) the
-served JobResults carry.
+served JobResults carry, the avenir-trace latency histograms (the
+summary prints queue-wait p99 and per-chunk scan-latency p99 columns
+from the streaming accumulators), and a metrics.json snapshot written
+next to the served artifacts — the same file a resident server
+refreshes live for `python -m avenir_tpu stats`.
 
 Usage: python tools/stream_scale_check.py [--rows N_MILLION] [--extra]
                                           [--fused] [--incremental]
@@ -181,6 +185,13 @@ with _RssSampler() as sampler:
 t_srv = time.perf_counter() - t0
 served = {tag: t.result(timeout=60) for tag, t in tickets.items()}
 stats = server.stats()
+# the live metrics surface at anchor scale: the snapshot a resident
+# server would be renaming every few seconds, written once here so the
+# record keeps the full histogram summaries (queue wait, admission
+# hold, dispatch, chunk latency) next to the per-request counters
+server.metrics_path = os.path.join(outdir, "metrics.json")
+server.write_metrics()
+hists = server.metrics_snapshot()["hists"]
 server.shutdown()
 t0 = time.perf_counter()
 for tenant, job, cf, corpus, tag in load:
@@ -209,6 +220,7 @@ print(json.dumps({
     "server_counters": {tag: {k: v for k, v in r.counters.items()
                               if k.startswith("Server:")}
                         for tag, r in served.items()},
+    "hists": hists,
     "stats": {k: v for k, v in stats.items() if v},
 }))
 '''
@@ -490,6 +502,19 @@ def main():
             results["jobServer"]["jobs_per_min_served"]
         summary["server_p99_queue_wait_ms"] = \
             results["jobServer"]["p99_queue_wait_ms"]
+        # the avenir-trace histogram columns: queue-wait p99 from the
+        # server's streaming accumulator (not the sorted per-request
+        # scalars above — same data, distribution view) and per-chunk
+        # scan latency p99 from the process-global obs histogram
+        hists = results["jobServer"].get("hists", {})
+        for col, name in (("server_hist_queue_wait_p99_ms",
+                           "queue_wait_ms"),
+                          ("server_hist_admission_held_p99_ms",
+                           "admission_held_ms"),
+                          ("server_chunk_latency_p99_ms",
+                           "chunk_latency_ms")):
+            if name in hists:
+                summary[col] = hists[name]["p99"]
     # the two streaming-correctness columns, side by side: the folds the
     # numbers above measured are chunk-layout-invariant AND a merge
     # algebra (shard-merge + checkpoint-resume byte-identical)
