@@ -543,37 +543,48 @@ def _eff_block(stats: CorpusStats, block_bytes: int) -> int:
     return max(1, min(int(block_bytes), stats.total_bytes))
 
 
-def _dataset_ingest(stats: CorpusStats, block_bytes: int, schema
+#: default depth of the outer prefetched() job feeds (the
+#: `stream.prefetch.depth` conf key; core.stream.DEFAULT_PREFETCH_DEPTH)
+DEFAULT_MODEL_PREFETCH_DEPTH = 2
+
+
+def _dataset_ingest(stats: CorpusStats, block_bytes: int, schema,
+                    prefetch_depth: int = DEFAULT_MODEL_PREFETCH_DEPTH
                     ) -> Dict[str, int]:
     """Shared-schema Dataset ingest: CsvBlockReader's inner depth-1 byte
     prefetch (producer copy + queued + parsing = 3 raw blocks), the
     native parse writing float32/int32 column outputs plus the lazy
-    string-column raw bytes, and the outer depth-2 Dataset prefetch of
-    stream_job_inputs (2 queued + producing + consuming = 4 parsed
-    chunks)."""
+    string-column raw bytes, and the outer depth-D Dataset prefetch of
+    stream_job_inputs (D queued + producing + consuming = D+2 parsed
+    chunks; D is the `stream.prefetch.depth` key, default 2)."""
     eff = _eff_block(stats, block_bytes)
     rows = eff / stats.avg_row_bytes
     n_num, n_cat, n_str = _schema_cols(schema)
+    depth = max(int(prefetch_depth), 1)
     parsed = rows * 4.0 * (n_num + n_cat) + 0.3 * eff * max(n_str, 1)
     return {
         "raw_blocks_in_flight": int(3 * eff),
         "parse_transient": int(parsed),
-        "parsed_chunks_in_flight": int(4 * parsed),
+        "parsed_chunks_in_flight": int((depth + 2) * parsed),
     }
 
 
-def _bytes_ingest(stats: CorpusStats, block_bytes: int) -> Dict[str, int]:
-    """Raw byte-block ingest for the sequence-shaped jobs: depth-2 outer
-    prefetch (4 raw blocks in flight) plus the CSR encode transients —
-    int32 codes + int32 row_of + bool region per token, int64
-    offsets/starts per row, and one decoded copy on the vocabulary-
-    extension path. Without the native encoder every token becomes a
-    Python string (~64B each), and the model says so."""
+def _bytes_ingest(stats: CorpusStats, block_bytes: int,
+                  prefetch_depth: int = DEFAULT_MODEL_PREFETCH_DEPTH
+                  ) -> Dict[str, int]:
+    """Raw byte-block ingest for the sequence-shaped jobs: depth-D
+    outer prefetch (D queued + producing + consuming = D+2 raw blocks
+    in flight; D = `stream.prefetch.depth`, default 2) plus the CSR
+    encode transients — int32 codes + int32 row_of + bool region per
+    token, int64 offsets/starts per row, and one decoded copy on the
+    vocabulary-extension path. Without the native encoder every token
+    becomes a Python string (~64B each), and the model says so."""
     eff = _eff_block(stats, block_bytes)
     rows = eff / stats.avg_row_bytes
     toks = rows * stats.avg_fields
+    depth = max(int(prefetch_depth), 1)
     terms = {
-        "raw_blocks_in_flight": int(4 * eff),
+        "raw_blocks_in_flight": int((depth + 2) * eff),
         "csr_transients": int(toks * 9 + rows * 16 + eff),
     }
     try:
@@ -586,8 +597,9 @@ def _bytes_ingest(stats: CorpusStats, block_bytes: int) -> Dict[str, int]:
     return terms
 
 
-def _model_nb(stats, block_bytes, schema) -> Dict[str, int]:
-    t = _dataset_ingest(stats, block_bytes, schema)
+def _model_nb(stats, block_bytes, schema,
+              prefetch_depth=DEFAULT_MODEL_PREFETCH_DEPTH) -> Dict[str, int]:
+    t = _dataset_ingest(stats, block_bytes, schema, prefetch_depth)
     rows = _eff_block(stats, block_bytes) / stats.avg_row_bytes
     n_num, n_cat, _ = _schema_cols(schema)
     # deferred-fold code matrix per chunk (host int32 + device copy)
@@ -596,8 +608,9 @@ def _model_nb(stats, block_bytes, schema) -> Dict[str, int]:
     return t
 
 
-def _model_mi(stats, block_bytes, schema) -> Dict[str, int]:
-    t = _dataset_ingest(stats, block_bytes, schema)
+def _model_mi(stats, block_bytes, schema,
+              prefetch_depth=DEFAULT_MODEL_PREFETCH_DEPTH) -> Dict[str, int]:
+    t = _dataset_ingest(stats, block_bytes, schema, prefetch_depth)
     rows = _eff_block(stats, block_bytes) / stats.avg_row_bytes
     # per-pair bincount keys (int64) and their intp cast, per chunk
     t["mi_pair_keys"] = int(rows * 8 * 2)
@@ -605,23 +618,29 @@ def _model_mi(stats, block_bytes, schema) -> Dict[str, int]:
     return t
 
 
-def _model_fisher(stats, block_bytes, schema) -> Dict[str, int]:
-    t = _dataset_ingest(stats, block_bytes, schema)
+def _model_fisher(stats, block_bytes, schema,
+                  prefetch_depth=DEFAULT_MODEL_PREFETCH_DEPTH
+                  ) -> Dict[str, int]:
+    t = _dataset_ingest(stats, block_bytes, schema, prefetch_depth)
     t["fisher_moments"] = 1 << 20
     return t
 
 
-def _model_markov(stats, block_bytes, schema) -> Dict[str, int]:
-    t = _bytes_ingest(stats, block_bytes)
+def _model_markov(stats, block_bytes, schema,
+                  prefetch_depth=DEFAULT_MODEL_PREFETCH_DEPTH
+                  ) -> Dict[str, int]:
+    t = _bytes_ingest(stats, block_bytes, prefetch_depth)
     t["markov_counts"] = 1 << 20
     return t
 
 
-def _miner_common(stats: CorpusStats, block_bytes: int) -> Dict[str, int]:
+def _miner_common(stats: CorpusStats, block_bytes: int,
+                  prefetch_depth: int = DEFAULT_MODEL_PREFETCH_DEPTH
+                  ) -> Dict[str, int]:
     """Pass-1 scan + spill write + per-k replay transients shared by both
     miners: the replay pass re-reads narrow codes + per-row counts and
     re-expands them to int32 working arrays."""
-    t = _bytes_ingest(stats, block_bytes)
+    t = _bytes_ingest(stats, block_bytes, prefetch_depth)
     eff = _eff_block(stats, block_bytes)
     rows = eff / stats.avg_row_bytes
     toks = rows * stats.avg_fields
@@ -629,8 +648,10 @@ def _miner_common(stats: CorpusStats, block_bytes: int) -> Dict[str, int]:
     return t
 
 
-def _model_apriori(stats, block_bytes, schema) -> Dict[str, int]:
-    t = _miner_common(stats, block_bytes)
+def _model_apriori(stats, block_bytes, schema,
+                   prefetch_depth=DEFAULT_MODEL_PREFETCH_DEPTH
+                   ) -> Dict[str, int]:
+    t = _miner_common(stats, block_bytes, prefetch_depth)
     v = stats.distinct_tokens
     words = max((v + 31) // 32, 1)
     c_pad = _pow2ceil(min(v * v, 4096), 64)
@@ -640,8 +661,9 @@ def _model_apriori(stats, block_bytes, schema) -> Dict[str, int]:
     return t
 
 
-def _model_gsp(stats, block_bytes, schema) -> Dict[str, int]:
-    t = _miner_common(stats, block_bytes)
+def _model_gsp(stats, block_bytes, schema,
+               prefetch_depth=DEFAULT_MODEL_PREFETCH_DEPTH) -> Dict[str, int]:
+    t = _miner_common(stats, block_bytes, prefetch_depth)
     eff = _eff_block(stats, block_bytes)
     rows_page = _pow2ceil(min(eff / stats.avg_row_bytes, 65536), 1024)
     t_bucket = _pow2ceil(stats.avg_fields, 16)
@@ -671,28 +693,35 @@ _INGEST_TERMS = {"raw_blocks_in_flight", "parse_transient",
 
 
 def footprint_model(job: str, block_bytes: int, schema=None,
-                    stats: Optional[CorpusStats] = None) -> FootprintEstimate:
+                    stats: Optional[CorpusStats] = None,
+                    prefetch_depth: int = DEFAULT_MODEL_PREFETCH_DEPTH
+                    ) -> FootprintEstimate:
     """Predicted peak incremental host bytes of one registered streamed
-    job at `block_bytes`. With no `stats` the corpus is assumed
-    unbounded (every block term prices a full block) — the admission-
-    oracle posture the memory manifest exports."""
+    job at `block_bytes` with `prefetch_depth` queued chunks (the
+    `stream.prefetch.depth` key — the in-flight terms scale with it, so
+    an autotuned depth re-prices admission honestly). With no `stats`
+    the corpus is assumed unbounded (every block term prices a full
+    block) — the admission-oracle posture the memory manifest exports."""
     if job not in _JOB_MODELS:
         raise ValueError(
             f"no footprint model for job {job!r}; modeled jobs: "
             f"{', '.join(sorted(_JOB_MODELS))}")
     st = stats if stats is not None else _unbounded_stats()
-    terms = _JOB_MODELS[job](st, int(block_bytes), schema)
+    terms = _JOB_MODELS[job](st, int(block_bytes), schema,
+                             max(int(prefetch_depth), 1))
     return FootprintEstimate(job, int(block_bytes),
                              {k: int(v) for k, v in terms.items()})
 
 
 def combined_footprint(jobs: Sequence[str], block_bytes: int, schema=None,
-                       stats: Optional[CorpusStats] = None
+                       stats: Optional[CorpusStats] = None,
+                       prefetch_depth: int = DEFAULT_MODEL_PREFETCH_DEPTH
                        ) -> FootprintEstimate:
     """Footprint of N jobs fused on ONE shared scan: ingest terms are
     paid once (the scan-sharing executor's whole point), per-job state
     terms sum, prefixed by job so the decomposition stays readable."""
-    ests = [footprint_model(j, block_bytes, schema, stats) for j in jobs]
+    ests = [footprint_model(j, block_bytes, schema, stats, prefetch_depth)
+            for j in jobs]
     terms: Dict[str, int] = {}
     for est in ests:
         for k, v in est.terms.items():

@@ -30,6 +30,11 @@ from avenir_tpu.core.dataset import Dataset
 from avenir_tpu.core.schema import FeatureSchema
 
 DEFAULT_BLOCK_BYTES = 64 << 20
+#: default queued-items depth of the outer prefetched() job feeds — the
+#: `stream.prefetch.depth` conf key overrides it per job (the autotuner
+#: moves it from measured stall attribution; analysis/mem.py prices the
+#: blocks-in-flight terms from the same number)
+DEFAULT_PREFETCH_DEPTH = 2
 # first non-whitespace byte, located without copying the block the way
 # bytes.strip() would (pattern.search scans the buffer in place)
 _NONWS = re.compile(rb"\S")
@@ -366,15 +371,29 @@ class SharedScan:
         return n
 
 
+def prefetch_depth(cfg) -> int:
+    """The `stream.prefetch.depth` conf key (default 2, floor 1): how
+    many produced items may queue ahead of the consumer in the outer
+    job feeds below. Deeper absorbs producer burstiness when the
+    consumer measurably waits (the autotuner's signal); every queued
+    item is a resident parsed chunk / raw block, which is why the
+    footprint model's in-flight terms scale with this same number."""
+    return max(int(cfg.get_float("stream.prefetch.depth",
+                                 float(DEFAULT_PREFETCH_DEPTH))), 1)
+
+
 def stream_job_inputs(cfg, inputs: Iterable[str], schema: FeatureSchema,
                       keep_raw: bool = False) -> Iterator[Dataset]:
     """Per-job streaming input helper: prefetched block chunks of every
     input path, sized by the `stream.block.size.mb` config key (default
-    64). The one way runner jobs consume CSV inputs at unbounded size."""
+    64) and queued `stream.prefetch.depth` deep. The one way runner
+    jobs consume CSV inputs at unbounded size."""
     block = int(cfg.get_float("stream.block.size.mb", 64.0) * (1 << 20))
+    depth = prefetch_depth(cfg)
     for path in inputs:
         yield from prefetched(iter_csv_chunks(
-            path, schema, cfg.field_delim_regex, block, keep_raw=keep_raw))
+            path, schema, cfg.field_delim_regex, block, keep_raw=keep_raw),
+            depth=depth)
 
 
 def iter_byte_blocks(path: str,
@@ -522,15 +541,19 @@ def iter_line_blocks(path: str,
 
 def stream_job_lines(cfg, inputs: Iterable[str]) -> Iterator[list]:
     """Prefetched line blocks of every input path, sized by the same
-    `stream.block.size.mb` key as stream_job_inputs."""
+    `stream.block.size.mb` key (and queued `stream.prefetch.depth`
+    deep) as stream_job_inputs."""
     block = int(cfg.get_float("stream.block.size.mb", 64.0) * (1 << 20))
+    depth = prefetch_depth(cfg)
     for path in inputs:
-        yield from prefetched(iter_line_blocks(path, block))
+        yield from prefetched(iter_line_blocks(path, block), depth=depth)
 
 
 def stream_job_byte_blocks(cfg, inputs: Iterable[str]) -> Iterator[bytes]:
     """Prefetched raw byte blocks of every input path (the native
-    seq_encode feed), sized by the same `stream.block.size.mb` key."""
+    seq_encode feed), sized by the same `stream.block.size.mb` key and
+    queued `stream.prefetch.depth` deep."""
     block = int(cfg.get_float("stream.block.size.mb", 64.0) * (1 << 20))
+    depth = prefetch_depth(cfg)
     for path in inputs:
-        yield from prefetched(iter_byte_blocks(path, block))
+        yield from prefetched(iter_byte_blocks(path, block), depth=depth)

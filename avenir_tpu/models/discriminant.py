@@ -15,8 +15,6 @@ from __future__ import annotations
 from typing import Dict, List, Tuple
 
 import numpy as np
-import jax
-import jax.numpy as jnp
 
 from avenir_tpu.core.dataset import Dataset
 
@@ -34,7 +32,18 @@ class FisherDiscriminant:
 
     def accumulate(self, ds: Dataset) -> "FisherDiscriminant":
         """Fold one chunk's per-class moments (count, sum, sum-sq) —
-        additive, so the discriminant streams like every count job."""
+        additive, so the discriminant streams like every count job.
+
+        The per-chunk sums run in float64 ON THE HOST. They used to be
+        a float32 device einsum, whose rounding depends on how many
+        rows land in one chunk — at 10M-row corpora that moved the
+        published boundary in the 4th decimal when the block size
+        changed, breaking the chunk-invariance contract every tuned or
+        re-chunked scan relies on (caught by
+        bench_scaling.autotune_tripwire's byte-identity gate). float64
+        keeps the layout sensitivity ~9 orders below the artifact's
+        %.6f formatting; the moment fold is O(rows x features) adds —
+        never this job's bottleneck."""
         if self._cnt is None:
             self.fields = [f for f in ds.schema.feature_fields
                            if f.is_numeric]
@@ -43,12 +52,13 @@ class FisherDiscriminant:
             self._cnt = np.zeros(2, np.float64)
             self._s1 = np.zeros((2, len(self.fields)), np.float64)
             self._s2 = np.zeros((2, len(self.fields)), np.float64)
-        x = jnp.asarray(ds.feature_matrix(self.fields))        # [n, F]
-        oh = jax.nn.one_hot(jnp.asarray(ds.labels()), 2,
-                            dtype=jnp.float32)                 # [n, 2]
-        self._cnt += np.asarray(oh.sum(axis=0))
-        self._s1 += np.asarray(jnp.einsum("nk,nf->kf", oh, x))
-        self._s2 += np.asarray(jnp.einsum("nk,nf->kf", oh, x * x))
+        x = np.asarray(ds.feature_matrix(self.fields), np.float64)  # [n, F]
+        y = np.asarray(ds.labels())
+        for k in (0, 1):
+            xk = x[y == k]
+            self._cnt[k] += xk.shape[0]
+            self._s1[k] += xk.sum(axis=0)
+            self._s2[k] += (xk * xk).sum(axis=0)
         return self
 
     def merge(self, other: "FisherDiscriminant") -> "FisherDiscriminant":

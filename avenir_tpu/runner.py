@@ -123,15 +123,53 @@ def run_job(name: str, conf, inputs: Sequence[str], output: str = "") -> JobResu
     if output:
         parent = os.path.dirname(os.path.abspath(output))
         os.makedirs(parent, exist_ok=True)
+    session = _autotune_begin([canonical], [cfg], inputs)
+    rss0 = _rss_now()
     t0 = _obs.now()
-    res = fn(cfg, list(inputs), output)
+    try:
+        res = fn(cfg, list(inputs), output)
+    except BaseException:
+        if session is not None:
+            session.close()   # a leaked session would contaminate
+        raise                 # every later one in this process
     _obs.record("job.run", t0, job=canonical)
-    _add_mem_counters(canonical, cfg, inputs, res)
+    _add_mem_counters(canonical, cfg, inputs, res, rss0=rss0)
+    if session is not None:
+        session.finish({canonical: res})
     return res
 
 
+#: highest process-lifetime peak RSS (bytes) already attributed to a
+#: streamed result. ru_maxrss is a LIFETIME peak: inside a resident
+#: process every later job re-reads the biggest job's number, so a
+#: residual recorded from it would poison the learned admission factor
+#: for every small job that follows. Only a run that RAISES the peak
+#: records one — exact for the one-job-per-process scale anchors (the
+#: designed signal source), silent for the jobs residency dwarfs.
+#: Unlocked int: a racing double/missed record costs one advisory
+#: history sample, never a wrong knob or price.
+_residual_peak_seen = 0
+
+
+def _rss_now() -> int:
+    """Current (not peak) resident bytes via /proc/self/statm; 0 where
+    unavailable. Snapshotted at job start so the residual record can
+    price the job's INCREMENTAL footprint (peak minus the resident
+    baseline already paid — interpreter, jax, earlier jobs' sticky
+    arenas), which is what the analytic model predicts; pairing the
+    absolute peak against an incremental prediction would bake the
+    process baseline into the learned admission factor."""
+    try:
+        with open("/proc/self/statm") as fh:
+            return int(fh.read().split()[1]) * (os.sysconf("SC_PAGE_SIZE")
+                                                or 4096)
+    except (OSError, ValueError, IndexError):
+        return 0
+
+
 def _add_mem_counters(canonical: str, cfg: JobConfig,
-                      inputs: Sequence[str], res: JobResult) -> None:
+                      inputs: Sequence[str], res: JobResult,
+                      rss0: Optional[int] = None) -> None:
     """Attach the memory-oracle counters to a streamed job's result.
     Advisory by contract: a failure to PREDICT must never fail a job
     that already ran, so any error here drops the counters silently.
@@ -161,6 +199,8 @@ def _add_mem_counters(canonical: str, cfg: JobConfig,
             # run_incremental already priced the scan (its checkpoint
             # advisory) and pre-set the counter — don't re-sample the
             # corpus for the same number
+            from avenir_tpu.core.stream import prefetch_depth
+
             block = int(cfg.get_float("stream.block.size.mb", 64.0)
                         * (1 << 20))
             stats = corpus_stats(paths, delim=cfg.field_delim_regex)
@@ -168,11 +208,57 @@ def _add_mem_counters(canonical: str, cfg: JobConfig,
             schema_path = cfg.get("feature.schema.file.path")
             if schema_path:
                 schema = FeatureSchema.from_file(schema_path)
-            est = footprint_model(canonical, block, schema, stats)
+            est = footprint_model(canonical, block, schema, stats,
+                                  prefetch_depth=prefetch_depth(cfg))
             res.counters["Mem:PredictedPeakBytes"] = float(est.total_bytes)
         res.counters["Mem:PeakRSS"] = float(rss)
+        # the tuner's model-refinement history: a streamed result whose
+        # run RAISED the process peak (see _residual_peak_seen) lands
+        # its predicted-vs-measured pair in the per-(job, corpus)
+        # profile store — from day one, not only when autotune is on.
+        # measured is the INCREMENTAL growth over the run's starting
+        # RSS (rss0, captured by the caller), matching what the model
+        # predicts; callers without a start snapshot (the warm-miner
+        # fast path) record nothing.
+        global _residual_peak_seen
+        if rss > _residual_peak_seen:
+            _residual_peak_seen = rss
+            if rss0 is not None and rss - rss0 > 0:
+                from avenir_tpu import tune
+
+                tune.record_residual(
+                    canonical, cfg, paths,
+                    res.counters["Mem:PredictedPeakBytes"], rss - rss0)
     except Exception:
         pass
+
+
+def _autotune_begin(canonicals: Sequence[str], cfgs: Sequence[JobConfig],
+                    inputs: Sequence[str]):
+    """Start an autotuned run when the (first) config opts in with the
+    `stream.autotune` key and every job is streamed: overlays the
+    profile store's chosen knobs onto the configs and returns the
+    session whose ``finish(results)`` records this run's telemetry and
+    chooses the next knobs (avenir_tpu.tune.begin_run). Returns None
+    when autotune is off or inapplicable.
+
+    Advisory EXCEPT for the knob guard: a profile naming an unknown or
+    out-of-range knob key raises KnobError — loudly, so a typo'd tuned
+    profile can never silently run defaults; any other storage failure
+    degrades to an untuned run."""
+    cfg0 = cfgs[0]
+    if not cfg0.get_bool("stream.autotune", False):
+        return None
+    if not inputs or any(c not in _STREAM_FOLDS for c in canonicals):
+        return None
+    from avenir_tpu import tune
+
+    try:
+        return tune.begin_run(list(canonicals), list(cfgs), list(inputs))
+    except tune.KnobError:
+        raise
+    except Exception:
+        return None
 
 
 # ---------------------------------------------------------------- helpers
@@ -917,51 +1003,67 @@ def run_shared(specs: Sequence[Tuple[str, object, str]],
             raise ValueError(
                 f"job {canonical!r} appears twice in one shared scan")
         built.append((canonical, kind, cfg, factory, output))
-    kinds = {k for _, k, _, _, _ in built}
-    if len(kinds) != 1:
-        raise ValueError(f"cannot fuse jobs of mixed scan kinds {kinds}")
-    kind = kinds.pop()
-    blocks = {cfg.get_float("stream.block.size.mb", 64.0)
-              for _, _, cfg, _, _ in built}
-    if len(blocks) != 1:
-        raise ValueError(
-            f"fused jobs disagree on stream.block.size.mb: {blocks}")
-    delims = {cfg.field_delim_regex for _, _, cfg, _, _ in built}
-    if len(delims) != 1:
-        raise ValueError(f"fused jobs disagree on field delimiter: {delims}")
-    cfg0 = built[0][2]
-    schema = None
-    if kind == "dataset":
-        spaths = {cfg.assert_get("feature.schema.file.path")
-                  for _, _, cfg, _, _ in built}
-        if len(spaths) != 1:
+    # autotune overlay BEFORE the compatibility checks: one knob set
+    # (the fused group's profile) lands on every member config, so the
+    # block-size/delimiter agreement below judges the tuned values
+    session = _autotune_begin([b[0] for b in built],
+                              [b[2] for b in built], inputs)
+    rss0 = _rss_now()
+    try:
+        kinds = {k for _, k, _, _, _ in built}
+        if len(kinds) != 1:
             raise ValueError(
-                f"fused jobs disagree on the schema file: {spaths}")
-        schema = _FS.from_file(spaths.pop())
-        chunks = stream_job_inputs(cfg0, list(inputs), schema)
-    else:
-        chunks = stream_job_byte_blocks(cfg0, list(inputs))
-    scan = SharedScan(chunks)
-    folds = []
-    for canonical, _kind, cfg, factory, output in built:
-        fold = factory(cfg, list(inputs), schema)
-        if fold_hook is not None:
-            fold_hook(canonical, fold)
-        folds.append((canonical, fold, output))
-        scan.add_sink(fold, label=canonical)
-    t0 = _obs.now()
-    chunks_scanned = scan.run()
-    _obs.record("job.dispatch", t0, mode="shared", chunks=chunks_scanned,
-                jobs=",".join(c for c, _f, _o in folds))
-    results: Dict[str, JobResult] = {}
-    for canonical, fold, output in folds:
-        if output:
-            parent = os.path.dirname(os.path.abspath(output))
-            os.makedirs(parent, exist_ok=True)
-        results[canonical] = _finish_fold(fold, output, canonical)
-        _add_mem_counters(canonical, next(
-            cfg for c, _k, cfg, _f, _o in built if c == canonical),
-            inputs, results[canonical])
+                f"cannot fuse jobs of mixed scan kinds {kinds}")
+        kind = kinds.pop()
+        blocks = {cfg.get_float("stream.block.size.mb", 64.0)
+                  for _, _, cfg, _, _ in built}
+        if len(blocks) != 1:
+            raise ValueError(
+                f"fused jobs disagree on stream.block.size.mb: {blocks}")
+        delims = {cfg.field_delim_regex for _, _, cfg, _, _ in built}
+        if len(delims) != 1:
+            raise ValueError(
+                f"fused jobs disagree on field delimiter: {delims}")
+        cfg0 = built[0][2]
+        schema = None
+        if kind == "dataset":
+            spaths = {cfg.assert_get("feature.schema.file.path")
+                      for _, _, cfg, _, _ in built}
+            if len(spaths) != 1:
+                raise ValueError(
+                    f"fused jobs disagree on the schema file: {spaths}")
+            schema = _FS.from_file(spaths.pop())
+            chunks = stream_job_inputs(cfg0, list(inputs), schema)
+        else:
+            chunks = stream_job_byte_blocks(cfg0, list(inputs))
+        scan = SharedScan(chunks)
+        folds = []
+        for canonical, _kind, cfg, factory, output in built:
+            fold = factory(cfg, list(inputs), schema)
+            if fold_hook is not None:
+                fold_hook(canonical, fold)
+            folds.append((canonical, fold, output))
+            scan.add_sink(fold, label=canonical)
+        t0 = _obs.now()
+        chunks_scanned = scan.run()
+        _obs.record("job.dispatch", t0, mode="shared",
+                    chunks=chunks_scanned,
+                    jobs=",".join(c for c, _f, _o in folds))
+        results: Dict[str, JobResult] = {}
+        for canonical, fold, output in folds:
+            if output:
+                parent = os.path.dirname(os.path.abspath(output))
+                os.makedirs(parent, exist_ok=True)
+            results[canonical] = _finish_fold(fold, output, canonical)
+            _add_mem_counters(canonical, next(
+                cfg for c, _k, cfg, _f, _o in built if c == canonical),
+                inputs, results[canonical], rss0=rss0)
+    except BaseException:
+        if session is not None:
+            session.close()   # a leaked session would contaminate
+        raise                 # every later one in this process
+    if session is not None:
+        session.finish(results)
     return results
 
 
@@ -1055,7 +1157,15 @@ def _conf_digest(cfg: JobConfig) -> str:
 
     h = hashlib.sha1()
     for k in sorted(cfg.props):
-        if "incremental.state.dir" in k:
+        # skipped keys only name WHERE driver state lives / whether the
+        # tuner records — never how bytes are parsed or folded. The
+        # autotune control keys must be digest-neutral so a job server
+        # injecting its profile dir (or an operator flipping recording
+        # on) does not invalidate every checkpoint; the knob keys the
+        # tuner OVERLAYS (block size etc.) are ordinary prefixed props
+        # and stay in the digest, which is what re-scans cold exactly
+        # when a knob value actually changes.
+        if "incremental.state.dir" in k or "stream.autotune" in k:
             continue
         h.update(f"{k}={cfg.props[k]}\n".encode())
     schema_path = cfg.get("feature.schema.file.path")
@@ -1101,6 +1211,7 @@ class _IncrementalPlan:
         self.delta_blocks = 0
         self.since_ckpt = 0
         self.predicted: Optional[int] = None
+        self.rss0 = _rss_now()
 
 
 def _prepare_incremental(canonical: str, cfg: JobConfig, inputs: List[str],
@@ -1185,11 +1296,13 @@ def _prepare_incremental(canonical: str, cfg: JobConfig, inputs: List[str],
     # layer consumes; a failure to predict never fails the scan)
     try:
         from avenir_tpu.analysis.mem import corpus_stats, footprint_model
+        from avenir_tpu.core.stream import prefetch_depth
 
         stats = corpus_stats([p for p in inputs if os.path.exists(p)],
                              delim=plan.delim)
-        plan.predicted = int(footprint_model(canonical, plan.block, schema,
-                                             stats).total_bytes)
+        plan.predicted = int(footprint_model(
+            canonical, plan.block, schema, stats,
+            prefetch_depth=prefetch_depth(cfg)).total_bytes)
     except Exception:
         pass
     return plan
@@ -1231,7 +1344,8 @@ def _plan_finish(plan: _IncrementalPlan) -> JobResult:
     res.counters["Resume:SkippedBytes"] = float(plan.skipped)
     if plan.predicted is not None:
         res.counters["Mem:PredictedPeakBytes"] = float(plan.predicted)
-    _add_mem_counters(plan.canonical, plan.cfg, plan.inputs, res)
+    _add_mem_counters(plan.canonical, plan.cfg, plan.inputs, res,
+                      rss0=plan.rss0)
     return res
 
 
@@ -1270,41 +1384,59 @@ def run_incremental(name: str, conf, inputs: Sequence[str],
 
     canonical, _prefix, cfg = _job_cfg(name, conf)
     inputs = [str(p) for p in inputs]
-    plan = _prepare_incremental(canonical, cfg, inputs, output, state_dir)
+    # autotune overlay BEFORE the restore plan: the knobs land in the
+    # conf digest, so a knob CHANGE re-scans cold (the documented
+    # conservative gate for any conf change) and the next refresh under
+    # the same knobs restores warm. This is also the only path that
+    # emits job.checkpoint spans — the checkpoint-interval rule's
+    # signal lives here.
+    session = _autotune_begin([canonical], [cfg], inputs)
+    try:
+        plan = _prepare_incremental(canonical, cfg, inputs, output,
+                                    state_dir)
 
-    # ------------------------------------------------------- delta fold
-    for si, path in enumerate(inputs):
-        size = os.path.getsize(path)
-        start = plan.watermarks[si]
-        if start >= size:
-            continue
-        feed = prefetched(iter_byte_blocks(path, plan.block,
-                                           byte_range=(start, size),
-                                           with_offsets=True), depth=1)
-        try:
-            for off, data in feed:
-                if not is_blank_block(data):
-                    if plan.ops.kind == "dataset":
+        # --------------------------------------------------- delta fold
+        for si, path in enumerate(inputs):
+            size = os.path.getsize(path)
+            start = plan.watermarks[si]
+            if start >= size:
+                continue
+            feed = prefetched(iter_byte_blocks(path, plan.block,
+                                               byte_range=(start, size),
+                                               with_offsets=True), depth=1)
+            try:
+                for off, data in feed:
+                    if not is_blank_block(data):
+                        if plan.ops.kind == "dataset":
+                            t0 = _obs.now()
+                            payload = Dataset.from_csv(data, plan.schema,
+                                                       delim=plan.delim)
+                            _obs.record("stream.parse", t0, path=path,
+                                        nbytes=len(data),
+                                        rows=len(payload))
+                        else:
+                            payload = data
                         t0 = _obs.now()
-                        payload = Dataset.from_csv(data, plan.schema,
-                                                   delim=plan.delim)
-                        _obs.record("stream.parse", t0, path=path,
-                                    nbytes=len(data), rows=len(payload))
-                    else:
-                        payload = data
-                    t0 = _obs.now()
-                    plan.fold.consume(payload)
-                    _obs.record("stream.fold", t0, sink=plan.canonical)
-                plan.fps[si].append(incr.block_fingerprint(off, data))
-                plan.watermarks[si] = off + len(data)
-                plan.delta_blocks += 1
-                plan.since_ckpt += len(data)
-                if plan.since_ckpt >= plan.interval:
-                    _plan_checkpoint(plan, complete=False)
-                    plan.since_ckpt = 0
-        finally:
-            feed.close()
-    return _plan_finish(plan)
+                        plan.fold.consume(payload)
+                        _obs.record("stream.fold", t0,
+                                    sink=plan.canonical)
+                    plan.fps[si].append(incr.block_fingerprint(off, data))
+                    plan.watermarks[si] = off + len(data)
+                    plan.delta_blocks += 1
+                    plan.since_ckpt += len(data)
+                    if plan.since_ckpt >= plan.interval:
+                        _plan_checkpoint(plan, complete=False)
+                        plan.since_ckpt = 0
+            finally:
+                feed.close()
+        res = _plan_finish(plan)
+    except BaseException:
+        if session is not None:
+            session.close()   # a leaked session would contaminate
+        raise                 # every later one in this process
+    if session is not None:
+        session.finish({canonical: res})
+    return res
 
 
 def run_incremental_shared(specs: Sequence[Tuple[str, object, str]],
@@ -3121,6 +3253,14 @@ def run_from_cli(argv: Sequence[str]) -> JobResult:
             sys.exit(rc)
         return JobResult("stats")
 
+    if argv and argv[0] == "tune":
+        from avenir_tpu.tune.report import tune_main
+
+        rc = tune_main(list(argv[1:]))
+        if rc:
+            sys.exit(rc)
+        return JobResult("tune")
+
     ap = argparse.ArgumentParser(prog="avenir_tpu")
     ap.add_argument("jobname", help="job name or reference Tool class")
     ap.add_argument("--conf", required=False, default=None,
@@ -3129,6 +3269,10 @@ def run_from_cli(argv: Sequence[str]) -> JobResult:
                     help="delta-scan a streamed job: restore the last "
                          "fold-state checkpoint and fold only appended "
                          "blocks (run_incremental)")
+    ap.add_argument("--autotune", action="store_true",
+                    help="close the telemetry loop: apply the profile "
+                         "store's tuned knobs to this run and record its "
+                         "signals for the next (sets stream.autotune)")
     ap.add_argument("paths", nargs="*", help="input paths... output path")
     # intermixed: `jobname --conf props IN OUT` splits the positionals
     # around the optional, which plain parse_args cannot reassemble
@@ -3145,6 +3289,18 @@ def run_from_cli(argv: Sequence[str]) -> JobResult:
               "running on CPU", file=sys.stderr)
     # a .conf path routes through the HOCON block loader in run_job
     props = args.conf if args.conf else {}
+    if args.autotune:
+        # splice the opt-in key into the properties; HOCON confs carry
+        # per-block keys, so the flag cannot reach inside one — set
+        # stream.autotune in the job's block instead
+        if isinstance(props, str):
+            if props.endswith(".conf"):
+                ap.error("--autotune cannot rewrite a HOCON .conf; set "
+                         "stream.autotune = true in the job's block")
+            props = dict(load_properties(props))
+        else:
+            props = dict(props)
+        props["stream.autotune"] = "true"
     short = args.jobname.rsplit(".", 1)[-1]
     name = args.jobname if args.jobname in _REGISTRY else short[0].lower() + short[1:]
     inputs, output = args.paths[:-1], args.paths[-1]

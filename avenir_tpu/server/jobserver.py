@@ -241,9 +241,32 @@ def price_request_bytes(requests: Sequence[JobRequest],
         return flat
     try:
         from avenir_tpu.analysis.mem import combined_footprint, corpus_stats
+        from avenir_tpu.core.stream import prefetch_depth
 
         cfg0 = streamed[0][1]
-        block = int(cfg0.get_float("stream.block.size.mb", 64.0) * (1 << 20))
+        block_mb = cfg0.get_float("stream.block.size.mb", 64.0)
+        depth = prefetch_depth(cfg0)
+        if cfg0.get_bool("stream.autotune", False):
+            # price what the runner will RUN: an autotuned dispatch
+            # overlays the profile's knobs AFTER admission, so the
+            # oracle must price the overlaid block/depth, not the
+            # static conf — otherwise a tuned-up block size runs at
+            # several times its admitted bytes. A bad profile prices
+            # at the static values (and the run fails loudly on it).
+            try:
+                from avenir_tpu import tune
+
+                jobs = sorted(c for c, _cfg in streamed)
+                prof = tune.ProfileStore(tune.resolve_dir(
+                    cfg0, requests[0].inputs)).load(
+                    "+".join(jobs), tune.corpus_digest(requests[0].inputs))
+                knobs = dict((prof or {}).get("knobs") or {})
+                block_mb = float(knobs.get("stream.block.size.mb",
+                                           block_mb))
+                depth = int(knobs.get("stream.prefetch.depth", depth))
+            except Exception:
+                pass
+        block = int(block_mb * (1 << 20))
         paths = [p for p in requests[0].inputs if os.path.exists(p)]
         stats = corpus_stats(paths, delim=cfg0.field_delim_regex) \
             if paths else None
@@ -252,7 +275,7 @@ def price_request_bytes(requests: Sequence[JobRequest],
         if schema_path:
             schema = FeatureSchema.from_file(schema_path)
         est = combined_footprint([c for c, _cfg in streamed], block,
-                                 schema, stats)
+                                 schema, stats, prefetch_depth=depth)
         return flat + int(est.total_bytes)
     except Exception:
         return flat + int(reserve_bytes) * len(streamed)
@@ -560,7 +583,9 @@ class JobServer:
                  pricer: Optional[Callable] = None,
                  rss_probe: Callable[[], int] = _process_rss_bytes,
                  metrics_path: Optional[str] = None,
-                 metrics_interval_s: float = 2.0):
+                 metrics_interval_s: float = 2.0,
+                 autotune_dir: Optional[str] = None,
+                 autotune_balance_ratio: float = 4.0):
         self._lock = threading.Lock()
         self._work = threading.Condition(self._lock)
         self._queues: Dict[str, List[Ticket]] = {}
@@ -574,6 +599,21 @@ class JobServer:
         self._threads: List[threading.Thread] = []
         self._admission = _Admission(budget_bytes, reserve_bytes,
                                      rss_probe=rss_probe)
+        # the autotune wiring (avenir_tpu.tune): an `autotune_dir` is a
+        # profile-store root — the pricer gains the residual-learned
+        # correction factor (clamped >= 1.0: the validated model stays
+        # the admission FLOOR, the learned factor can only add
+        # conservatism) and the scheduler consults per-job measured
+        # fold-cost means when composing batches
+        self._autotune_dir = autotune_dir
+        self._balance_ratio = float(autotune_balance_ratio)
+        self._fold_costs: Dict[tuple, Optional[float]] = {}
+        self._fold_costs_at = 0.0
+        if pricer is None and autotune_dir:
+            from avenir_tpu import tune
+
+            pricer = tune.make_tuned_pricer(autotune_dir,
+                                            base=price_request_bytes)
         # the admission oracle: price_request_bytes (graftlint-mem's
         # footprint model) unless a test/operator injects its own
         self._pricer = pricer or price_request_bytes
@@ -865,6 +905,15 @@ class JobServer:
                         # same job under a different conf cannot share
                         # one scan; stop the prefix so FIFO holds
                         break
+                    if not self._batch_balanced_locked(primaries, ticket):
+                        # fold-cost imbalance (autotune profiles): a
+                        # shared chunk waits on the SUM of its sinks'
+                        # folds, so batching a cheap fold behind one
+                        # measured far more expensive costs the cheap
+                        # job more latency than the shared ingest saves
+                        # — stop the prefix, FIFO holds, it dispatches
+                        # in its own batch
+                        break
                     jobs_in_batch.add(ticket._canonical)
                     seen[ticket._ekey] = len(primaries)
                     primaries.append(ticket)
@@ -923,6 +972,45 @@ class JobServer:
         if q is not None and ticket in q:
             q.remove(ticket)
         self._order.pop(ticket.request.req_id, None)
+
+    # ------------------------------------------------ autotune composition
+    def _fold_cost_locked(self, canonical: Optional[str],
+                          inputs: Sequence[str]) -> Optional[float]:
+        """Measured mean per-chunk fold cost (ms) of one (job, corpus)
+        from the autotune profile store, memoized with a short TTL so
+        the scheduler never re-reads tiny JSON files 20x/sec under the
+        lock. None = unmeasured (always batches)."""
+        if not self._autotune_dir or canonical is None:
+            return None
+        now = time.perf_counter()
+        if now - self._fold_costs_at > 5.0:
+            self._fold_costs.clear()
+            self._fold_costs_at = now
+        from avenir_tpu.tune import ProfileStore, corpus_digest
+
+        key = (canonical, corpus_digest(inputs))
+        if key not in self._fold_costs:
+            self._fold_costs[key] = ProfileStore(
+                self._autotune_dir).fold_cost_ms(canonical, key[1])
+        return self._fold_costs[key]
+
+    def _batch_balanced_locked(self, primaries: List[Ticket],
+                               candidate: Ticket) -> bool:
+        """True when the candidate's measured fold cost sits inside the
+        batch's fold-cost band (tune.batch_balanced). Trivially true
+        without an autotune dir or without measurements — the balancer
+        must never refuse work it simply hasn't profiled."""
+        if not self._autotune_dir:
+            return True
+        from avenir_tpu.tune import batch_balanced
+
+        costs = [self._fold_cost_locked(t._canonical, t.request.inputs)
+                 for t in primaries]
+        return batch_balanced(
+            costs,
+            self._fold_cost_locked(candidate._canonical,
+                                   candidate.request.inputs),
+            ratio=self._balance_ratio)
 
     def _scheduler_loop(self) -> None:
         while not self._shutdown.is_set():
@@ -1058,6 +1146,30 @@ class JobServer:
             _obs.recorder().record("server.held", t0, held_ms / 1000.0,
                                    attrs=link)
 
+    def _conf_with_tune_dir(self, conf):
+        """The request conf with the server's `autotune_dir` spliced in
+        as `stream.autotune.dir` (unless the tenant set one) — so the
+        profiles the RUNNER writes land in the store the server's
+        pricer and batch balancer READ. Digest-neutral (the runner's
+        conf digest skips autotune control keys), so injection never
+        invalidates a tenant's checkpoints. Properties-file confs pass
+        through untouched: the file is the tenant's contract."""
+        if not self._autotune_dir:
+            return conf
+        from avenir_tpu.core.config import JobConfig
+
+        if isinstance(conf, dict):
+            if "stream.autotune.dir" in conf:
+                return conf
+            return {**conf, "stream.autotune.dir": self._autotune_dir}
+        if isinstance(conf, JobConfig):
+            if conf.get("stream.autotune.dir"):
+                return conf
+            props = dict(conf.props)
+            props["stream.autotune.dir"] = self._autotune_dir
+            return JobConfig(props, conf.prefix)
+        return conf
+
     def _run_batch(self, batch: _Batch) -> Tuple[List, float]:
         """Execute primaries through the registered runner paths;
         (one JobResult per primary index-aligned, warm-hit flag)."""
@@ -1079,15 +1191,17 @@ class JobServer:
                         managed.append(sd)
                     state_dirs[canonical] = sd
                 shared = run_incremental_shared(
-                    [(r.job, r.conf, r.output) for r in reqs], inputs,
+                    [(r.job, self._conf_with_tune_dir(r.conf),
+                      r.output) for r in reqs], inputs,
                     state_dirs=state_dirs)
             finally:
                 for sd in managed:
                     self.warm.release_dir(sd)
             return [shared[_scoped(r.job, r.conf)[0]] for r in reqs], 0.0
         if not batch.streamable:
-            return [run_job(reqs[0].job, reqs[0].conf, reqs[0].inputs,
-                            reqs[0].output)], 0.0
+            return [run_job(reqs[0].job,
+                            self._conf_with_tune_dir(reqs[0].conf),
+                            reqs[0].inputs, reqs[0].output)], 0.0
         # warm miner fast path: a lone mining request over a corpus
         # whose pinned source is still content-valid replays encoded
         # blocks — zero CSV parses
@@ -1103,8 +1217,10 @@ class JobServer:
                 captured[canonical] = fold
 
         try:
-            shared = run_shared([(r.job, r.conf, r.output) for r in reqs],
-                                inputs, fold_hook=fold_hook)
+            shared = run_shared(
+                [(r.job, self._conf_with_tune_dir(r.conf), r.output)
+                 for r in reqs],
+                inputs, fold_hook=fold_hook)
         except BaseException:
             # a fold marked keep_sources holds its source (and spill
             # cache) open for pinning; on a failed batch nothing will

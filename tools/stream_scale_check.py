@@ -265,6 +265,27 @@ def run_child(job, conf, inp, out, incremental_state=None):
     return line
 
 
+def residual_trend(job: str, inp: str) -> list:
+    """The predicted-vs-measured RSS residual ratios the runner's
+    always-on recording (runner._add_mem_counters -> avenir_tpu.tune)
+    has accumulated for (job, corpus) — newest last. Every anchor run
+    appends one, so across rounds this column shows whether the
+    footprint model's real-scale error is drifting; [] when no profile
+    exists (first round, or the store dir was cleaned)."""
+    try:
+        from avenir_tpu.tune import ProfileStore, corpus_digest, resolve_dir
+
+        store = ProfileStore(resolve_dir(None, [inp]))
+        prof = store.load(job, corpus_digest([inp]))
+        if not prof:
+            return []
+        return [round(float(r["measured"]) / float(r["predicted"]), 3)
+                for r in prof.get("residuals", [])
+                if float(r.get("predicted", 0)) > 0]
+    except Exception as e:                        # noqa: BLE001
+        return [f"unavailable ({type(e).__name__})"]
+
+
 def audit_status(mode: str) -> str:
     """"validated/total" of one graftlint streaming audit (--flow
     chunk-invariance or --merge shard-merge/resume), run in a child so
@@ -488,6 +509,14 @@ def main():
     summary["mem_model_delta_pct"] = {
         job: line["mem_model_delta_pct"] for job, line in results.items()
         if isinstance(line, dict) and "mem_model_delta_pct" in line}
+    # the residual TREND next to the single-run delta: every anchor's
+    # predicted-vs-measured pair lands in the per-(job, corpus) autotune
+    # profile store, so this column shows the model error across rounds
+    # (the history the tuner's admission-correction factor learns from)
+    summary["mem_residual_trend"] = {
+        job: residual_trend(job, inp) for job, inp in
+        (("mutualInformation", CHURN_CSV),
+         ("markovStateTransitionModel", SEQ_CSV))}
     if "sharedScan" in results:
         summary["shared_scan_speedup"] = results["sharedScan"]["speedup"]
     # the incremental-speedup column: O(delta) refresh vs O(corpus)
