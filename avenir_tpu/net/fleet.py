@@ -1,0 +1,594 @@
+"""A fleet of job-server processes behind one affinity router.
+
+One resident JobServer amortizes scans/compiles across tenants but is
+still one Python process on one core-set; the fleet layer is the
+scale-out: N ``serve --spool`` subprocesses (same host here — the spool
+transport is already host-agnostic, so a host list later is a mount
+away), each with its own spool, byte budget and warm state, fed by an
+:class:`~avenir_tpu.net.router.AffinityRouter` that keeps a corpus
+hitting the process whose WarmStore already pins its encoded blocks and
+checkpoints, against a per-host priced-bytes budget vector.
+
+The front half runs in the CALLER's process:
+
+- :class:`Fleet` — spawn/stop the server processes, ``submit`` request
+  objects (priced by ``price_request_bytes``, placed by the router,
+  written atomically into the placed host's spool ``in/``),
+  ``collect`` result rows from the per-host ``out/`` dirs, and roll
+  the per-host ``metrics.json`` snapshots into ONE fleet view through
+  the additive ``LatencyHistogram.merge`` algebra
+  (``obs.report.merge_snapshots``) with the router's placement stats
+  attached.
+- :func:`fleet_main` — ``python -m avenir_tpu fleet``: a fleet-level
+  spool (requests into ``<root>/in/``, results out of ``<root>/out/``)
+  so tenants address ONE directory and the router fans out behind it.
+  SIGTERM/SIGINT drain gracefully: stop claiming, finish in-flight,
+  final merged metrics.json, exit 0.
+
+Placement cost: when a profile store (``avenir_tpu.tune``) is
+configured, the router's tie-break consults the measured per-chunk fold
+cost of each (job, corpus) — a corpus whose folds are measured
+expensive counts for more pending load than its bytes alone say.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from avenir_tpu.net.router import AffinityRouter, Placement
+from avenir_tpu.server.spool import (nonce_result_name,
+                                     request_from_json, spool_dirs)
+
+#: fleet front poll granularity (seconds)
+_POLL_SECS = 0.1
+#: price-memo freshness: long enough to amortize an arrival burst over
+#: a hot corpus, short enough that a growing refresh corpus re-prices
+_PRICE_MEMO_TTL_SECS = 30.0
+#: price-memo size bound for resident fronts
+_PRICE_MEMO_MAX = 4096
+
+
+def _pkg_parent() -> str:
+    import avenir_tpu
+
+    return os.path.dirname(os.path.dirname(
+        os.path.abspath(avenir_tpu.__file__)))
+
+
+def affinity_key(request) -> Tuple:
+    """The router's sticky key: the corpus identity (mode + absolute
+    input paths) — the component of ``server.compat_key`` warm state
+    actually keys on. Everything else (job, conf) may vary per request
+    without moving the corpus off its warm host."""
+    return (request.mode,
+            tuple(os.path.abspath(p) for p in request.inputs))
+
+
+class FleetError(RuntimeError):
+    """A fleet host died or refused to start."""
+
+
+class _Outstanding:
+    """One submitted request the front is waiting on."""
+
+    __slots__ = ("placement", "out_path", "work_name")
+
+    def __init__(self, placement: Placement, out_path: str,
+                 work_name: str):
+        self.placement = placement
+        self.out_path = out_path
+        self.work_name = work_name
+
+
+class Fleet:
+    """N job-server processes + the affinity front (module docstring).
+
+    Construct, ``start()``, ``submit()`` request objects (the spool
+    JSON schema), ``collect()`` rows, ``stop()``. The budget vector is
+    one ``budget_mb`` entry per host; ``profile_dir`` opts placement
+    into fold-cost weighting and is forwarded to every host as its
+    autotune store."""
+
+    def __init__(self, root: str, hosts: int = 2,
+                 budget_mb: float = 3072.0, workers: int = 1,
+                 warm_budget_mb: float = 256.0,
+                 metrics_interval_s: float = 0.5,
+                 profile_dir: Optional[str] = None,
+                 env: Optional[Dict[str, str]] = None,
+                 pin_cores: Optional[Sequence[int]] = None):
+        """``pin_cores``: pin host i to CPU ``pin_cores[i % len]``
+        (Linux ``sched_setaffinity``; ignored where unsupported). On a
+        shared box an UNPINNED single process borrows every core
+        through XLA's intra-op threads, so a same-box fleet-vs-one
+        comparison measures nothing — pinning one core per host is
+        what makes a single machine a faithful proxy for N hosts
+        (``bench_scaling.fleet_tripwire`` relies on it)."""
+        if hosts < 1:
+            raise ValueError("fleet needs at least one host")
+        self.root = os.path.abspath(root)
+        os.makedirs(self.root, exist_ok=True)
+        self.host_dirs = [os.path.join(self.root, f"host{i}")
+                          for i in range(hosts)]
+        self.budget_bytes = int(budget_mb * (1 << 20))
+        self.router = AffinityRouter([self.budget_bytes] * hosts)
+        self.workers = int(workers)
+        self.warm_budget_mb = float(warm_budget_mb)
+        self.metrics_interval_s = float(metrics_interval_s)
+        self.profile_dir = profile_dir
+        self._env = env
+        self.pin_cores = list(pin_cores) if pin_cores else None
+        self._procs: List[subprocess.Popen] = []
+        self._logs: List[str] = []
+        self._lock = threading.Lock()
+        self._seq = 0
+        self._outstanding: Dict[str, _Outstanding] = {}
+        #: finished rows swept off disk but not yet collect()ed — the
+        #: submit loop's capacity sweep must never lose a row a later
+        #: named collect() will ask for
+        self._collected: Dict[str, Dict] = {}
+        # pricing memo: corpus_stats head-samples the corpus per call,
+        # so an open-loop front pricing hundreds of arrivals over a few
+        # hot corpora would pay the sample per request; identical
+        # (job, conf, corpus, mode) submissions price once, and the
+        # profile-store fold cost rides along. Entries expire (a
+        # refresh corpus GROWS between rounds — a price from its
+        # smallest snapshot must not undercount the vector forever)
+        # and the dict is bounded for resident fronts. Value:
+        # (priced_bytes, cost_ms, stamped_at).
+        self._price_memo: Dict[Tuple, Tuple] = {}
+
+    # ------------------------------------------------------------ lifecycle
+    def start(self, timeout: float = 60.0) -> "Fleet":
+        env = dict(os.environ if self._env is None else self._env)
+        env["PYTHONPATH"] = os.pathsep.join(
+            p for p in (_pkg_parent(), env.get("PYTHONPATH")) if p)
+        for i, host_dir in enumerate(self.host_dirs):
+            os.makedirs(host_dir, exist_ok=True)
+            log_path = os.path.join(host_dir, "server.log")
+            cmd = [sys.executable, "-m", "avenir_tpu", "serve",
+                   "--spool", host_dir,
+                   "--workers", str(self.workers),
+                   "--budget-mb", str(self.budget_bytes / (1 << 20)),
+                   "--warm-budget-mb", str(self.warm_budget_mb),
+                   "--state-root", os.path.join(host_dir, "state"),
+                   "--metrics-interval", str(self.metrics_interval_s)]
+            if self.profile_dir:
+                # hosts share ONE profile store: a fold cost measured on
+                # any host informs placement for all of them
+                cmd += ["--autotune-dir", self.profile_dir]
+            preexec = None
+            if self.pin_cores and hasattr(os, "sched_setaffinity"):
+                core = self.pin_cores[i % len(self.pin_cores)]
+                preexec = (lambda c=core:
+                           os.sched_setaffinity(0, {c}))
+            with open(log_path, "ab") as log:
+                proc = subprocess.Popen(cmd, stdout=log, stderr=log,
+                                        env=env, cwd=_pkg_parent(),
+                                        preexec_fn=preexec)
+            self._procs.append(proc)
+            self._logs.append(log_path)
+        deadline = time.perf_counter() + timeout
+        for i, host_dir in enumerate(self.host_dirs):
+            in_dir = os.path.join(host_dir, "in")
+            while not os.path.isdir(in_dir):
+                self._check_alive()
+                if time.perf_counter() > deadline:
+                    raise FleetError(
+                        f"host {i} did not open its spool within "
+                        f"{timeout}s (log: {self._logs[i]})")
+                time.sleep(_POLL_SECS)
+        return self
+
+    def _check_alive(self) -> None:
+        for i, proc in enumerate(self._procs):
+            rc = proc.poll()
+            if rc is not None and rc != 0:
+                tail = _tail(self._logs[i])
+                raise FleetError(
+                    f"fleet host {i} exited rc={rc}; log tail:\n{tail}")
+
+    def __enter__(self) -> "Fleet":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+    # ------------------------------------------------------------ submitting
+    def price(self, obj: Dict) -> Tuple[object, int, Optional[float]]:
+        """(request, priced bytes, fold cost ms) of one request object
+        — the placement inputs. Pricing uses the same oracle the hosts
+        admit with; fold cost comes from the shared profile store when
+        one is configured."""
+        req = request_from_json(obj)
+        memo_key = (req.job, req.mode,
+                    tuple(os.path.abspath(p) for p in req.inputs),
+                    json.dumps(req.conf, sort_keys=True)
+                    if isinstance(req.conf, dict) else str(req.conf))
+        now = time.perf_counter()
+        with self._lock:
+            hit = self._price_memo.get(memo_key)
+            if hit is not None and now - hit[2] < _PRICE_MEMO_TTL_SECS:
+                return req, hit[0], hit[1]
+        priced = self._pricer()(req)
+        cost = None
+        if self.profile_dir:
+            # the fold cost rides the same memo: re-reading the profile
+            # store's JSON per arrival would pay a disk read per
+            # request on exactly the hot-corpus path the memo exists
+            # for
+            from avenir_tpu import tune
+
+            cost = tune.placement_cost_ms(self.profile_dir, req.job,
+                                          req.conf, req.inputs)
+        with self._lock:
+            if len(self._price_memo) >= _PRICE_MEMO_MAX:
+                self._price_memo = {
+                    k: v for k, v in self._price_memo.items()
+                    if now - v[2] < _PRICE_MEMO_TTL_SECS}
+                if len(self._price_memo) >= _PRICE_MEMO_MAX:
+                    self._price_memo.clear()
+            self._price_memo[memo_key] = (priced, cost, now)
+        return req, priced, cost
+
+    def _pricer(self):
+        """The front's pricing oracle — the SAME one the hosts admit
+        with: the residual-corrected tuned pricer when a profile store
+        is configured (the hosts get it via --autotune-dir), the bare
+        footprint model otherwise. A front that raw-priced what a host
+        tuned-prices would place work the host then fast-fails."""
+        fn = getattr(self, "_pricer_fn", None)
+        if fn is not None:
+            return fn
+        from avenir_tpu.server.jobserver import (DEFAULT_RESERVE_BYTES,
+                                                 price_request_bytes)
+
+        if self.profile_dir:
+            from avenir_tpu import tune
+
+            base = tune.make_tuned_pricer(self.profile_dir,
+                                          base=price_request_bytes)
+        else:
+            base = price_request_bytes
+        self._pricer_fn = fn = \
+            lambda req: int(base([req], DEFAULT_RESERVE_BYTES))
+        return fn
+
+    def submit(self, obj: Dict, block: bool = True,
+               timeout: float = 600.0,
+               count_held: bool = True) -> Optional[str]:
+        """Route one request object to a host spool; returns the fleet
+        request name to ``collect`` on, or None when every host is over
+        its budget-vector entry and ``block`` is False. Blocking waits
+        for a host to free capacity — the fleet-front analog of the
+        single server's admission hold. ``count_held=False`` marks a
+        caller-level retry of an arrival already counted held."""
+        req, priced, cost = self.price(obj)
+        key = affinity_key(req)
+        deadline = time.perf_counter() + timeout
+        while True:
+            placement = self.router.place(key, priced, cost,
+                                          count_held=count_held)
+            if placement is not None:
+                break
+            count_held = False        # this arrival is counted now
+            # capacity frees only when finished requests are swept off
+            # disk — a blocking submit must sweep ITSELF or a saturated
+            # single-threaded front would spin the full timeout while
+            # every host sits idle with its results already written
+            self._sweep()
+            if not block:
+                return None
+            if time.perf_counter() > deadline:
+                raise TimeoutError(
+                    f"no host freed budget for a {priced}-byte request "
+                    f"within {timeout}s")
+            self._check_alive()
+            time.sleep(_POLL_SECS)
+        return self._spool_to(placement, obj)
+
+    def submit_to(self, host: int, obj: Dict) -> str:
+        """Pin one request to `host`, bypassing the router (warmup
+        traffic that must touch a SPECIFIC process). Accounted against
+        the budget vector like any placement."""
+        req, priced, cost = self.price(obj)
+        placement = self.router.assign_to(host, affinity_key(req),
+                                          priced, cost)
+        return self._spool_to(placement, obj)
+
+    def _spool_to(self, placement: Placement, obj: Dict) -> str:
+        with self._lock:
+            self._seq += 1
+            name = f"r{self._seq:06d}.json"
+        host_dir = self.host_dirs[placement.host]
+        out_name = nonce_result_name(name, obj.get("nonce"))
+        out_path = os.path.join(host_dir, "out", out_name)
+        tmp = os.path.join(host_dir, f".{name}.tmp")
+        with open(tmp, "w") as fh:
+            json.dump(obj, fh)
+        os.replace(tmp, os.path.join(host_dir, "in", name))
+        with self._lock:
+            self._outstanding[name] = _Outstanding(placement, out_path,
+                                                   out_name)
+        return name
+
+    # ------------------------------------------------------------ collecting
+    def ready(self) -> List[str]:
+        """Names of submitted requests whose result row is available
+        (already swept, or on disk) — what a non-blocking front sweep
+        collects."""
+        with self._lock:
+            entries = list(self._outstanding.items())
+            banked = list(self._collected)
+        return banked + [n for n, e in entries
+                         if os.path.exists(e.out_path)]
+
+    def _sweep(self) -> int:
+        """Move every finished request's row off disk into the
+        collected bank and release its router accounting. Returns how
+        many were swept. Idempotent and safe to call from the submit
+        loop — a banked row waits for its named ``collect``."""
+        with self._lock:
+            entries = list(self._outstanding.items())
+        swept = 0
+        for name, entry in entries:
+            if not os.path.exists(entry.out_path):
+                continue
+            with open(entry.out_path) as fh:
+                row = json.load(fh)
+            with self._lock:
+                if self._outstanding.pop(name, None) is None:
+                    continue              # raced another sweeper
+                self._collected[name] = row
+            self.router.release(entry.placement)
+            swept += 1
+        return swept
+
+    def collect(self, names: Optional[Sequence[str]] = None,
+                timeout: float = 600.0) -> Dict[str, Dict]:
+        """Block until every named request (default: all submitted,
+        uncollected) has a result row; returns {name: row}. Router
+        accounting is released as each row is swept off disk."""
+        with self._lock:
+            wanted = list(names) if names is not None else \
+                list(self._outstanding) + list(self._collected)
+            unknown = [n for n in wanted
+                       if n not in self._outstanding
+                       and n not in self._collected]
+        if unknown:
+            raise KeyError(f"unknown fleet request(s) {unknown}")
+        rows: Dict[str, Dict] = {}
+        deadline = time.perf_counter() + timeout
+        while True:
+            self._sweep()
+            with self._lock:
+                for name in wanted:
+                    if name not in rows and name in self._collected:
+                        rows[name] = self._collected.pop(name)
+            if len(rows) == len(wanted):
+                return rows
+            self._check_alive()
+            if time.perf_counter() > deadline:
+                missing = [n for n in wanted if n not in rows]
+                raise TimeoutError(
+                    f"fleet results {missing} not served within "
+                    f"{timeout}s")
+            time.sleep(_POLL_SECS)
+
+    # --------------------------------------------------------------- metrics
+    def merged_metrics(self) -> Dict:
+        """The fleet snapshot: per-host metrics.json files folded into
+        one through the additive histogram merge, with the router's
+        placement stats and budget-vector occupancy attached
+        (docs/observability.md "Fleet roll-up")."""
+        from avenir_tpu.obs.report import merge_snapshots
+
+        snaps = []
+        for host_dir in self.host_dirs:
+            path = os.path.join(host_dir, "metrics.json")
+            try:
+                with open(path) as fh:
+                    snaps.append(json.load(fh))
+            except (OSError, ValueError):
+                continue            # host not up yet / mid-rename
+        merged = merge_snapshots(snaps)
+        merged["router"] = self.router.snapshot()
+        return merged
+
+    def write_metrics(self, path: Optional[str] = None) -> str:
+        path = path or os.path.join(self.root, "metrics.json")
+        tmp = f"{path}.{os.getpid()}.tmp"
+        with open(tmp, "w") as fh:
+            json.dump(self.merged_metrics(), fh)
+        os.replace(tmp, path)
+        return path
+
+    # ------------------------------------------------------------- stopping
+    def stop(self, timeout: float = 120.0) -> List[int]:
+        """Graceful fleet shutdown: SIGTERM every host (their handlers
+        drain: finish claimed work, final per-host metrics.json, exit
+        0), join, write the final merged metrics. Returns the per-host
+        exit codes; a host that needed SIGKILL reports rc < 0."""
+        for proc in self._procs:
+            if proc.poll() is None:
+                try:
+                    proc.send_signal(signal.SIGTERM)
+                except OSError:
+                    pass
+        codes: List[int] = []
+        deadline = time.perf_counter() + timeout
+        for proc in self._procs:
+            remaining = max(deadline - time.perf_counter(), 0.1)
+            try:
+                codes.append(proc.wait(timeout=remaining))
+            except subprocess.TimeoutExpired:
+                proc.kill()
+                codes.append(proc.wait())
+        self._procs = []
+        try:
+            self.write_metrics()
+        except OSError:
+            pass
+        return codes
+
+
+def _tail(path: str, nbytes: int = 800) -> str:
+    try:
+        with open(path, "rb") as fh:
+            fh.seek(0, os.SEEK_END)
+            fh.seek(max(fh.tell() - nbytes, 0))
+            return fh.read().decode(errors="replace")
+    except OSError:
+        return "<no log>"
+
+
+# --------------------------------------------------------------------------
+# the fleet CLI
+# --------------------------------------------------------------------------
+def fleet_main(argv) -> int:
+    """``python -m avenir_tpu fleet --root DIR --hosts N [...]`` — the
+    fleet-level spool session (module docstring)."""
+    import argparse
+
+    from avenir_tpu.server.spool import _claim, install_drain_handlers
+
+    ap = argparse.ArgumentParser(prog="avenir_tpu fleet")
+    ap.add_argument("--root", required=True,
+                    help="fleet root: requests in <root>/in, results in "
+                         "<root>/out, hosts under <root>/host<i>")
+    ap.add_argument("--hosts", type=int, default=2)
+    ap.add_argument("--workers", type=int, default=1,
+                    help="worker threads per host process (default 1)")
+    ap.add_argument("--budget-mb", type=float, default=3072.0,
+                    help="per-host admission budget — one entry of the "
+                         "fleet's budget vector (default 3072)")
+    ap.add_argument("--once", action="store_true",
+                    help="serve what is spooled, drain, exit")
+    ap.add_argument("--profile-dir", default=None,
+                    help="autotune profile store consulted for "
+                         "fold-cost-weighted placement")
+    ap.add_argument("--metrics-interval", type=float, default=1.0)
+    args = ap.parse_args(argv)
+
+    in_dir, work_dir, out_dir = spool_dirs(args.root)
+    fleet = Fleet(args.root, hosts=args.hosts, budget_mb=args.budget_mb,
+                  workers=args.workers, profile_dir=args.profile_dir,
+                  metrics_interval_s=min(args.metrics_interval, 1.0))
+    stop_event = threading.Event()
+    should_stop = install_drain_handlers(stop_event)
+    failures = 0
+    #: fleet request name -> (client name, nonce, work path): the work
+    #: file survives until the final out/ row lands (serve_spool's own
+    #: discipline), so a front crash never silently loses an accepted
+    #: request — the file is still in work/ for recovery
+    submitted: Dict[str, Tuple[str, Optional[str], str]] = {}
+    #: claimed but not yet placeable (every host over its vector
+    #: entry): retried each pass — the front must stay live (writing
+    #: rows, refreshing metrics, noticing SIGTERM) while work is held,
+    #: so placement is never allowed to block the loop. The bool marks
+    #: whether the arrival was already counted held (transition-only).
+    backlog: List[Tuple[str, Dict, str, bool]] = []
+
+    def finish(work_path: str) -> None:
+        try:
+            os.remove(work_path)
+        except OSError:
+            pass
+
+    def fail_row(name: str, obj, exc: BaseException,
+                 work_path: str) -> None:
+        row = {"ok": False, "error": f"{type(exc).__name__}: {exc}"}
+        # failure rows honor the nonce namespace too — a nonce-polling
+        # client must see its failure, not wait forever on an
+        # un-prefixed row
+        nonce = obj.get("nonce") if isinstance(obj, dict) else None
+        if isinstance(nonce, str) and nonce:
+            row["nonce"] = nonce
+        _write_row(out_dir, nonce_result_name(
+            name, nonce if isinstance(nonce, str) and nonce else None),
+            row)
+        finish(work_path)
+
+    fleet.start()
+    try:
+        last_metrics = 0.0
+        while True:
+            stopping = should_stop()
+            if not stopping:
+                for name, work_path in _claim(in_dir, work_dir):
+                    obj = None
+                    try:
+                        with open(work_path) as fh:
+                            obj = json.load(fh)
+                        # validate before routing so a bad request is
+                        # reported in-band, not a front crash
+                        request_from_json(obj)
+                        backlog.append((name, obj, work_path, True))
+                    except Exception as exc:  # noqa: BLE001 — in-band
+                        failures += 1
+                        fail_row(name, obj, exc, work_path)
+            # place what the budget vector has room for; the rest stays
+            # backlogged (claimed work still drains during a stop)
+            still: List[Tuple[str, Dict, str, bool]] = []
+            for name, obj, work_path, first in backlog:
+                try:
+                    fname = fleet.submit(obj, block=False,
+                                         count_held=first)
+                except Exception as exc:  # noqa: BLE001 — in-band
+                    failures += 1
+                    fail_row(name, obj, exc, work_path)
+                    continue
+                if fname is None:
+                    still.append((name, obj, work_path, False))
+                else:
+                    submitted[fname] = (name, obj.get("nonce"),
+                                        work_path)
+            backlog = still
+            # non-blocking sweep: collect whatever is ready
+            ready = fleet.ready()
+            done = fleet.collect(ready, timeout=30.0) if ready else {}
+            for fname, row in done.items():
+                client_name, nonce, work_path = submitted.pop(
+                    fname, (fname, None, ""))
+                failures += 0 if row.get("ok") else 1
+                _write_row(out_dir,
+                           nonce_result_name(client_name, nonce), row)
+                if work_path:
+                    finish(work_path)
+            now = time.perf_counter()
+            if now - last_metrics >= args.metrics_interval:
+                last_metrics = now
+                try:
+                    fleet.write_metrics()
+                except OSError:
+                    pass
+            drained = not submitted and not backlog
+            try:
+                spooled = any(n.endswith(".json")
+                              for n in os.listdir(in_dir))
+            except OSError:
+                spooled = False
+            if stopping and drained:
+                break
+            if args.once and drained and not spooled:
+                break
+            time.sleep(_POLL_SECS)
+    finally:
+        fleet.stop()
+    print(json.dumps({"fleet": "done", "failed": failures,
+                      "router": fleet.router.snapshot()}),
+          file=sys.stderr)
+    return 1 if failures else 0
+
+
+def _write_row(out_dir: str, name: str, row: Dict) -> None:
+    tmp = os.path.join(out_dir, name + ".tmp")
+    with open(tmp, "w") as fh:
+        json.dump(row, fh, indent=1)
+    os.replace(tmp, os.path.join(out_dir, name))

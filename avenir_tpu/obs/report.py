@@ -1,16 +1,22 @@
-"""`python -m avenir_tpu stats <dir>` — render a live metrics snapshot.
+"""`python -m avenir_tpu stats <paths...>` — render metrics snapshots.
 
 The resident job server atomically renames a ``metrics.json`` snapshot
 next to its spool every few seconds (jobserver.JobServer, the
 ``metrics_path`` surface); this renderer is the operator's one-command
 view of it: queue depths, admission pressure, warm-store occupancy and
 the latency histograms (queue wait / admission hold / dispatch /
-chunk), without attaching to the server process. Accepts the snapshot
-file or the directory holding it.
+chunk), without attaching to the server process. Accepts snapshot
+files, directories holding one, or a FLEET root (``host*/metrics.json``
+underneath); given several snapshots it renders the MERGED view —
+counters summed, histograms folded through the additive
+``LatencyHistogram.merge`` algebra over the snapshots' sparse
+``hists_raw`` buckets, so a fleet's p99 is computed from the combined
+distribution, never averaged from per-host summaries.
 """
 
 from __future__ import annotations
 
+import glob
 import json
 import os
 import time
@@ -24,6 +30,73 @@ def load_metrics(path: str) -> Dict:
         path = os.path.join(path, "metrics.json")
     with open(path) as fh:
         return json.load(fh)
+
+
+def expand_metrics_paths(paths: List[str]) -> List[str]:
+    """Every metrics.json the CLI arguments name: a file stays itself;
+    a directory with a metrics.json contributes it; a directory with
+    ``host*/metrics.json`` underneath (a fleet root) contributes every
+    host's — so ``stats <fleet-root>`` sees the whole fleet."""
+    out: List[str] = []
+    for path in paths:
+        if os.path.isdir(path):
+            own = os.path.join(path, "metrics.json")
+            hosts = sorted(glob.glob(
+                os.path.join(path, "host*", "metrics.json")))
+            if hosts:
+                # the per-host truth beats the (possibly stale) rolled-
+                # up fleet file when both exist under a fleet root
+                out.extend(hosts)
+            elif os.path.exists(own):
+                out.append(own)
+            else:
+                raise OSError(
+                    f"no metrics.json (or host*/metrics.json) under "
+                    f"{path!r}")
+        else:
+            out.append(path)
+    return out
+
+
+def merge_snapshots(snaps: List[Dict]) -> Dict:
+    """Fold N metrics snapshots into one fleet view. Counters, queue
+    depths and warm/inflight occupancy are additive and sum; the
+    latency histograms merge EXACTLY through each snapshot's sparse
+    ``hists_raw`` buckets (``LatencyHistogram.merge`` — the same
+    algebra every fold state in the repo obeys). A snapshot predating
+    the raw surface contributes its counters but no distribution;
+    ``peak_priced_bytes`` sums to the fleet-wide upper bound (per-host
+    peaks need not be simultaneous)."""
+    from avenir_tpu.obs.histogram import LatencyHistogram
+
+    out: Dict = {"hosts": len(snaps), "ts_unix": 0.0, "uptime_s": 0.0,
+                 "queues": {}, "inflight": {}, "warm": {}, "stats": {},
+                 "hists": {}, "hists_raw": {}, "draining": False,
+                 "trace": {"spans": 0, "dropped_spans": 0,
+                           "enabled": False}}
+    merged: Dict[str, LatencyHistogram] = {}
+    for snap in snaps:
+        out["ts_unix"] = max(out["ts_unix"], snap.get("ts_unix", 0.0))
+        out["uptime_s"] = max(out["uptime_s"], snap.get("uptime_s", 0.0))
+        out["draining"] = out["draining"] or bool(snap.get("draining"))
+        for tenant, n in (snap.get("queues") or {}).items():
+            out["queues"][tenant] = out["queues"].get(tenant, 0) + int(n)
+        for section in ("inflight", "warm", "stats"):
+            for key, val in (snap.get(section) or {}).items():
+                if isinstance(val, (int, float)):
+                    out[section][key] = out[section].get(key, 0) + val
+        trace = snap.get("trace") or {}
+        out["trace"]["spans"] += int(trace.get("spans", 0))
+        out["trace"]["dropped_spans"] += int(trace.get("dropped_spans",
+                                                       0))
+        out["trace"]["enabled"] = out["trace"]["enabled"] \
+            or bool(trace.get("enabled"))
+        for name, raw in (snap.get("hists_raw") or {}).items():
+            merged.setdefault(name, LatencyHistogram()).merge(
+                LatencyHistogram.from_dict(raw))
+    out["hists"] = {name: h.summary() for name, h in merged.items()}
+    out["hists_raw"] = {name: h.to_dict() for name, h in merged.items()}
+    return out
 
 
 def _fmt_bytes(n: float) -> str:
@@ -46,9 +119,21 @@ def render_metrics(snap: Dict) -> str:
     so tests pin the rendering without a filesystem)."""
     lines: List[str] = []
     age = time.time() - snap.get("ts_unix", time.time())
-    lines.append(f"avenir job server metrics "
-                 f"(snapshot {age:.1f}s old, "
-                 f"uptime {snap.get('uptime_s', 0.0):.1f}s)")
+    hosts = int(snap.get("hosts", 1))
+    what = f"fleet metrics ({hosts} hosts merged, " if hosts > 1 \
+        else "job server metrics (snapshot "
+    lines.append(f"avenir {what}{age:.1f}s old, "
+                 f"uptime {snap.get('uptime_s', 0.0):.1f}s"
+                 + (", DRAINING)" if snap.get("draining") else ")"))
+    router = snap.get("router")
+    if router:
+        rs = router.get("stats", {})
+        lines.append(
+            f"router: {rs.get('placed', 0)} placed, "
+            f"hits={rs.get('affinity_hits', 0)} "
+            f"misses={rs.get('affinity_misses', 0)} "
+            f"spills={rs.get('spills', 0)} held={rs.get('held', 0)} "
+            f"across {len(router.get('hosts', []))} host(s)")
     queues = snap.get("queues", {})
     depth = sum(queues.values())
     lines.append(f"queues: {depth} queued across {len(queues)} tenant(s)"
@@ -82,19 +167,39 @@ def render_metrics(snap: Dict) -> str:
 
 
 def stats_main(argv) -> int:
-    """CLI body for ``python -m avenir_tpu stats <dir-or-file>``."""
+    """CLI body for ``python -m avenir_tpu stats <paths...>`` — one
+    snapshot renders as-is; several (or a fleet root) render the
+    additive-merged fleet view."""
     import argparse
 
     ap = argparse.ArgumentParser(prog="avenir_tpu stats")
-    ap.add_argument("path", help="metrics.json, or the directory "
-                                 "(e.g. the spool dir) containing it")
+    ap.add_argument("paths", nargs="+",
+                    help="metrics.json file(s), directories containing "
+                         "one, or a fleet root (host*/metrics.json)")
     ap.add_argument("--json", action="store_true",
                     help="emit the raw snapshot JSON instead of the table")
     args = ap.parse_args(argv)
     try:
-        snap = load_metrics(args.path)
+        files = expand_metrics_paths(args.paths)
+        snaps = [load_metrics(p) for p in files]
     except (OSError, ValueError) as e:
-        print(f"cannot load metrics snapshot from {args.path!r}: {e}")
+        print(f"cannot load metrics snapshot(s) from {args.paths}: {e}")
         return 2
+    snap = snaps[0] if len(snaps) == 1 else merge_snapshots(snaps)
+    # a fleet root's own rolled-up file carries the router section;
+    # surface it next to the host counters whenever the arguments
+    # named a fleet root — a 1-host fleet is still a fleet
+    if "router" not in snap:
+        for path in args.paths:
+            own = os.path.join(path, "metrics.json") \
+                if os.path.isdir(path) else path
+            try:
+                with open(own) as fh:
+                    router = json.load(fh).get("router")
+            except (OSError, ValueError):
+                continue
+            if router:
+                snap["router"] = router
+                break
     print(json.dumps(snap, indent=1) if args.json else render_metrics(snap))
     return 0

@@ -3229,12 +3229,16 @@ def run_from_cli(argv: Sequence[str]) -> JobResult:
     `hadoop jar avenir.jar <class> -Dconf.path=<props> IN OUT` surface.
 
     `python -m avenir_tpu serve ...` instead starts the resident
-    multi-tenant job server over a stdin/filesystem request spool
+    multi-tenant job server — over a stdin/filesystem request spool
     (avenir_tpu.server.spool — batched shared scans, warm caches,
-    byte-budget admission; no network dependency), and
-    `python -m avenir_tpu stats <dir>` renders the live metrics.json
-    snapshot a running server writes next to its spool
-    (avenir_tpu.obs.report)."""
+    byte-budget admission; no network dependency) or, with
+    `--listen HOST:PORT`, behind the JSON-over-HTTP edge
+    (avenir_tpu.net.listener — 429 backpressure wired to the admission
+    model). `python -m avenir_tpu fleet --root DIR --hosts N` runs N
+    server processes behind the affinity router (avenir_tpu.net.fleet),
+    and `python -m avenir_tpu stats <paths...>` renders one server's
+    live metrics.json — or a fleet's, merged through the additive
+    histogram algebra (avenir_tpu.obs.report)."""
     import argparse
 
     if argv and argv[0] == "serve":
@@ -3244,6 +3248,14 @@ def run_from_cli(argv: Sequence[str]) -> JobResult:
         if rc:
             sys.exit(rc)
         return JobResult("serve")
+
+    if argv and argv[0] == "fleet":
+        from avenir_tpu.net.fleet import fleet_main
+
+        rc = fleet_main(list(argv[1:]))
+        if rc:
+            sys.exit(rc)
+        return JobResult("fleet")
 
     if argv and argv[0] == "stats":
         from avenir_tpu.obs.report import stats_main

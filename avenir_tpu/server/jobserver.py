@@ -113,7 +113,10 @@ class JobRequest:
     checkpoint store (O(delta) after an append). ``priority``: higher
     dispatches first, FIFO within a tenant, aging-boosted against
     starvation. ``state_dir`` overrides the managed checkpoint dir for
-    refresh requests."""
+    refresh requests. ``nonce`` is the CLIENT's namespace token: the
+    spool transport writes the result to ``<nonce>.<name>`` so two
+    clients reusing one filename stem can never overwrite each other's
+    results (the server itself never interprets it)."""
 
     job: str
     conf: object
@@ -123,6 +126,7 @@ class JobRequest:
     priority: int = 0
     mode: str = "run"
     state_dir: Optional[str] = None
+    nonce: Optional[str] = None
     req_id: str = field(default_factory=lambda: uuid.uuid4().hex[:12])
 
 
@@ -639,6 +643,9 @@ class JobServer:
             "dispatch_ms": LatencyHistogram(),
         }
         self._started_at = time.perf_counter()
+        # drain state (the network edge's /healthz answer): begin_drain
+        # gates NEW submissions while in-flight work finishes
+        self._draining = False
         # live metrics surface: when set, the scheduler atomic-renames a
         # metrics.json snapshot here every `metrics_interval_s`
         self.metrics_path = metrics_path
@@ -671,6 +678,8 @@ class JobServer:
         with self._work:
             if self._closed:
                 raise ServerClosed("server is shut down")
+            if self._draining:
+                raise ServerClosed("server is draining")
             self._seq += 1
             self._order[request.req_id] = self._seq
             self._queues.setdefault(request.tenant, []).append(ticket)
@@ -785,6 +794,41 @@ class JobServer:
         out.update({f"warm_{k}": v for k, v in self.warm.stats().items()})
         return out
 
+    # ------------------------------------------------------- edge hooks
+    def price(self, requests: Sequence[JobRequest]) -> int:
+        """The admission oracle's prediction for `requests` as one
+        group — the number the network edge sheds against BEFORE
+        enqueueing (the same pricer the scheduler admits with, so the
+        edge and the admission controller can never disagree on what a
+        request costs)."""
+        return int(self._pricer(list(requests), self._admission.reserve))
+
+    def queue_depth(self, tenant: Optional[str] = None) -> int:
+        """Currently queued (not yet dispatched) request count — one
+        tenant's, or every tenant's summed. The edge's per-tenant depth
+        bound reads this."""
+        with self._lock:
+            if tenant is not None:
+                return len(self._queues.get(tenant, ()))
+            return sum(len(q) for q in self._queues.values())
+
+    @property
+    def budget_bytes(self) -> int:
+        return self._admission.budget
+
+    @property
+    def draining(self) -> bool:
+        return self._draining
+
+    def begin_drain(self) -> None:
+        """Stop accepting NEW submissions (submit raises ServerClosed)
+        while queued and in-flight work keeps serving — the graceful-
+        drain half of SIGTERM handling; ``drain()``/``shutdown()``
+        still finish the session."""
+        with self._work:
+            self._draining = True
+            self._work.notify_all()
+
     # ------------------------------------------------- live metrics surface
     def metrics_snapshot(self) -> Dict:
         """The live operator snapshot (``metrics.json`` schema —
@@ -805,10 +849,20 @@ class JobServer:
             stats = {k: float(v) for k, v in self._stats.items()}
             hists = {name: h.summary()
                      for name, h in self._hists.items()}
+            # the sparse bucket form next to the summaries: summaries
+            # cannot be combined after the fact, buckets merge exactly
+            # (LatencyHistogram.merge), so the fleet roll-up and
+            # `python -m avenir_tpu stats a.json b.json` fold per-host
+            # snapshots into one distribution instead of approximating
+            raw = {name: h.to_dict() for name, h in self._hists.items()}
         # process-global streaming hists (chunk_latency_ms etc.) ride
         # along; the server's own names win on collision
         for name, summary in _obs.hist_summaries().items():
             hists.setdefault(name, summary)
+            if name not in raw:
+                h = _obs.hist(name)       # a merged copy, race-free
+                if h is not None:
+                    raw[name] = h.to_dict()
         return {"ts_unix": time.time(),
                 "uptime_s": round(time.perf_counter() - self._started_at,
                                   3),
@@ -817,6 +871,8 @@ class JobServer:
                 "warm": self.warm.stats(),
                 "stats": stats,
                 "hists": hists,
+                "hists_raw": raw,
+                "draining": self._draining,
                 "trace": {"spans": len(_obs.recorder()),
                           "dropped_spans": _obs.recorder().dropped,
                           "enabled": _obs.enabled()}}

@@ -61,6 +61,7 @@ __all__ = [
     "BATCH_BALANCE_RATIO", "RESIDUAL_FACTOR_CAP",
     "ProfileStore", "corpus_digest", "resolve_dir",
     "begin_run", "record_residual", "make_tuned_pricer",
+    "placement_cost_ms",
 ]
 
 
@@ -254,3 +255,26 @@ def make_tuned_pricer(profile_dir: str,
         return int(raw * max(factor, 1.0))
 
     return pricer
+
+
+def placement_cost_ms(profile_dir: Optional[str], job: str, conf,
+                      inputs: Sequence[str]) -> Optional[float]:
+    """The measured mean per-chunk fold cost (ms) of one (job, corpus)
+    from a profile store — the fleet router's placement weight: a
+    corpus whose folds are measured expensive counts for more pending
+    load on its host than its bytes alone say. None (and never an
+    exception) when there is no store, no profile, or no measurement —
+    placement must degrade to bytes-only, not refuse to route."""
+    if not profile_dir:
+        return None
+    try:
+        from avenir_tpu.runner import _job_cfg
+
+        canonical = _job_cfg(job, conf)[0]
+    except Exception:  # noqa: BLE001 — unresolvable job: bytes-only
+        canonical = job
+    try:
+        return ProfileStore(profile_dir).fold_cost_ms(
+            canonical, corpus_digest(inputs))
+    except Exception:  # noqa: BLE001 — unreadable store: bytes-only
+        return None

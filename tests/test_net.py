@@ -1,0 +1,715 @@
+"""avenir-net: listener backpressure, affinity routing, fleet, roll-up.
+
+The PR's contracts:
+1. Listener — the HTTP edge round-trips the spool request/result JSON
+   byte-identically to the solo runner; /metrics serves the live
+   snapshot, /healthz the drain state.
+2. Edge load-shed — a flood priced over budget is answered 429 with
+   Retry-After (or held, per policy) at the EDGE; the server's priced
+   peak never exceeds its budget; a previously-shed request succeeds
+   on retry after drain.
+3. Router — sticky corpus->host affinity with spillover, against a
+   per-host priced-bytes budget vector that placement can never
+   breach; fold-cost-weighted tie-breaks.
+4. Fleet — N serve subprocesses behind the router serve byte-identical
+   artifacts, roll per-host metrics up through the additive histogram
+   merge, and SIGTERM-drain to exit 0.
+5. stats — `python -m avenir_tpu stats` renders N snapshots (or a
+   fleet root) as one merged view.
+
+Every network test binds port 0 (ephemeral) and every subprocess test
+polls for observable state — no fixed ports, no bare sleeps.
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from avenir_tpu.net.fleet import Fleet, affinity_key
+from avenir_tpu.net.listener import EdgePolicy, NetListener
+from avenir_tpu.net.router import AffinityRouter, RouterError
+from avenir_tpu.runner import run_job
+from avenir_tpu.server import JobRequest, JobServer
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_SUB_ENV = dict(os.environ, JAX_PLATFORMS="cpu",
+                AVENIR_SKIP_DEVICE_PROBE="1",
+                PYTHONPATH=os.pathsep.join(
+                    p for p in (REPO, os.environ.get("PYTHONPATH"))
+                    if p))
+
+MST_CONF = {"mst.model.states": "L,M,H",
+            "mst.class.label.field.ord": "1",
+            "mst.skip.field.count": "2",
+            "mst.class.labels": "T,F"}
+
+
+# ---------------------------------------------------------------- fixtures
+def _seq(tmp_path, rows=300, seed=12, name="seq.csv"):
+    rng = np.random.default_rng(seed)
+    states = ["L", "M", "H"]
+    csv = tmp_path / name
+    with open(csv, "w") as fh:
+        for i in range(rows):
+            up = i % 2 == 0
+            s, toks = 1, []
+            for _ in range(6):
+                p = [0.1, 0.3, 0.6] if up else [0.6, 0.3, 0.1]
+                s = int(np.clip(s + rng.choice([-1, 0, 1], p=p), 0, 2))
+                toks.append(states[s])
+            fh.write(f"c{i},{'T' if up else 'F'}," + ",".join(toks) + "\n")
+    return str(csv)
+
+
+def _req_obj(csv, out, tenant="default", **extra):
+    return {"job": "markovStateTransitionModel", "conf": MST_CONF,
+            "inputs": [csv], "output": out, "tenant": tenant, **extra}
+
+
+def _post(url, obj, expect_error=False):
+    """(status, row) of one POST; 4xx/5xx surfaced as (code, body)."""
+    req = urllib.request.Request(url, data=json.dumps(obj).encode(),
+                                 headers={"Content-Type":
+                                          "application/json"})
+    try:
+        with urllib.request.urlopen(req, timeout=240) as resp:
+            return resp.status, json.load(resp), dict(resp.headers)
+    except urllib.error.HTTPError as exc:
+        if not expect_error:
+            raise
+        body = json.loads(exc.read() or b"{}")
+        return exc.code, body, dict(exc.headers)
+
+
+def _get(url, expect_error=False):
+    try:
+        with urllib.request.urlopen(url, timeout=240) as resp:
+            return resp.status, json.load(resp)
+    except urllib.error.HTTPError as exc:
+        if not expect_error:
+            raise
+        return exc.code, json.loads(exc.read() or b"{}")
+
+
+def _server(tmp_path, **kw):
+    kw.setdefault("state_root", str(tmp_path / "srv_state"))
+    kw.setdefault("workers", 1)
+    return JobServer(**kw)
+
+
+# ------------------------------------------------------------------ router
+def test_router_affinity_spill_and_budget_vector():
+    r = AffinityRouter([100, 100])
+    a = r.place(("a",), 60)
+    b = r.place(("b",), 60)
+    assert {a.host, b.host} == {0, 1}        # least-loaded spread
+    assert a.kind == b.kind == "miss"
+    # sticky: corpus a returns to its host while it fits
+    hit = r.place(("a",), 30)
+    assert (hit.host, hit.kind) == (a.host, "hit")
+    # over the sticky host's vector entry: spill to the other host,
+    # sticky mapping unmoved
+    spill = r.place(("a",), 35)
+    assert (spill.host, spill.kind) == (b.host, "spill")
+    # nothing fits: held, never a breach — and a poller's RETRY of the
+    # same arrival must not inflate the held stat (transition-only)
+    assert r.place(("c",), 50) is None
+    assert r.place(("c",), 50, count_held=False) is None
+    snap = r.snapshot()
+    for h in snap["hosts"]:
+        assert h["assigned_bytes"] <= h["budget_bytes"]
+        assert h["peak_assigned_bytes"] <= h["budget_bytes"]
+    assert snap["stats"]["held"] == 1
+    # release returns capacity; the corpus comes home to its warm host
+    r.release(spill)
+    r.release(hit)
+    home = r.place(("a",), 30)
+    assert (home.host, home.kind) == (a.host, "hit")
+    # a request over EVERY vector entry can never place
+    with pytest.raises(RouterError):
+        r.place(("z",), 1000)
+
+
+def test_router_fold_cost_breaks_byte_ties():
+    r = AffinityRouter([1000, 1000])
+    # equal bytes on both hosts, but host 0 carries measured-expensive
+    # pending folds: the tie must break to host 1
+    r.assign_to(0, ("w0",), 100, cost_ms=500.0)
+    r.assign_to(1, ("w1",), 100, cost_ms=1.0)
+    p = r.place(("new",), 100)
+    assert p.host == 1
+    # hit-rate counts only routed placements, not pinned warmups
+    assert r.affinity_hit_rate() == 0.0
+    r2 = AffinityRouter([1000])
+    r2.place(("k",), 10)
+    r2.place(("k",), 10)
+    assert r2.affinity_hit_rate() == 0.5
+
+
+# ---------------------------------------------------------------- listener
+def test_listener_round_trip_byte_identical(tmp_path):
+    csv = _seq(tmp_path)
+    srv = _server(tmp_path).start()
+    with NetListener(srv, port=0) as lis:
+        url = f"http://127.0.0.1:{lis.port}"
+        # blocking submit
+        code, row, _ = _post(url + "/submit?wait=1",
+                             _req_obj(csv, str(tmp_path / "net1.txt")))
+        assert code == 200 and row["ok"]
+        assert row["counters"]["Server:BatchSize"] >= 1.0
+        # async submit + result poll
+        code, sub, _ = _post(url + "/submit",
+                             _req_obj(csv, str(tmp_path / "net2.txt")))
+        assert code == 202 and sub["status"] == "queued"
+        assert sub["priced_bytes"] > 0
+        code, row2 = _get(url + f"/result/{sub['req_id']}?timeout=120")
+        assert code == 200 and row2["ok"]
+        # fetched results are popped: a second fetch is a 404
+        code, _ = _get(url + f"/result/{sub['req_id']}",
+                       expect_error=True)
+        assert code == 404
+        # metrics carries the server snapshot + the edge section + the
+        # mergeable raw buckets
+        code, snap = _get(url + "/metrics")
+        assert code == 200
+        assert snap["stats"]["served"] >= 2
+        assert snap["edge"]["accepted"] == 2
+        assert snap["hists_raw"]["queue_wait_ms"]["count"] >= 2
+        code, health = _get(url + "/healthz")
+        assert code == 200 and health["status"] == "serving"
+        # malformed requests answer 400, not a stack trace
+        code, err, _ = _post(url + "/submit",
+                             {"job": "noSuchJob", "inputs": [csv],
+                              "output": "x"}, expect_error=True)
+        assert code == 400 and "KeyError" in err["error"]
+        code, err, _ = _post(url + "/submit", {"jobb": "x"},
+                             expect_error=True)
+        assert code == 400
+    srv.shutdown()
+    twin = run_job("markovStateTransitionModel", MST_CONF, [csv],
+                   str(tmp_path / "net_ref.txt"))
+    for out in ("net1.txt", "net2.txt"):
+        with open(tmp_path / out, "rb") as fa, \
+                open(twin.outputs[0], "rb") as fb:
+            assert fa.read() == fb.read()
+
+
+def test_edge_sheds_flood_and_recovers_after_drain(tmp_path):
+    """The load-shed contract: a flood priced over budget gets 429 with
+    Retry-After AT THE EDGE, the server's peak priced bytes never
+    exceed its budget, and a previously-shed request succeeds on retry
+    once in-flight work drains."""
+    csv = _seq(tmp_path)
+    srv = _server(tmp_path, budget_bytes=150 << 20,
+                  pricer=lambda reqs, reserve: (100 << 20) * len(reqs),
+                  rss_probe=lambda: 0).start()
+    with NetListener(srv, port=0) as lis:
+        url = f"http://127.0.0.1:{lis.port}"
+        code, first, _ = _post(url + "/submit",
+                               _req_obj(csv, str(tmp_path / "s0.txt")))
+        assert code == 202
+        shed = 0
+        for i in range(4):
+            code, err, headers = _post(
+                url + "/submit",
+                _req_obj(csv, str(tmp_path / f"sf{i}.txt"),
+                         tenant=f"t{i}"),
+                expect_error=True)
+            assert code == 429
+            assert int(headers["Retry-After"]) >= 1
+            assert "budget" in err["error"]
+            shed += 1
+        assert shed == 4
+        # the in-flight request finishes; the edge frees its priced
+        # bytes; the SAME previously-shed request now succeeds
+        code, row = _get(url + f"/result/{first['req_id']}?timeout=240")
+        assert code == 200 and row["ok"]
+        deadline = time.perf_counter() + 30
+        while True:
+            code, retried, _ = _post(
+                url + "/submit?wait=1",
+                _req_obj(csv, str(tmp_path / "sf0.txt"), tenant="t0"),
+                expect_error=True)
+            if code == 200:
+                break
+            assert code == 429
+            assert time.perf_counter() < deadline, \
+                "shed request never recovered after drain"
+            time.sleep(0.1)
+        assert retried["ok"]
+        edge = lis.edge_stats()
+        assert edge["rejected"] >= 4
+    stats = srv.stats()
+    srv.shutdown()
+    assert stats["peak_priced_bytes"] <= 150 << 20
+
+
+def test_edge_hold_mode_parks_instead_of_429(tmp_path):
+    csv = _seq(tmp_path)
+    srv = _server(tmp_path, budget_bytes=150 << 20,
+                  pricer=lambda reqs, reserve: (100 << 20) * len(reqs),
+                  rss_probe=lambda: 0).start()
+    policy = EdgePolicy(shed_mode="hold", hold_timeout_s=120.0)
+    with NetListener(srv, port=0, policy=policy) as lis:
+        url = f"http://127.0.0.1:{lis.port}"
+        code, _first, _ = _post(url + "/submit",
+                                _req_obj(csv, str(tmp_path / "h0.txt")))
+        assert code == 202
+        # over budget: the edge PARKS the accept until the first
+        # request frees its priced bytes, then serves — never a 429
+        code, row, _ = _post(url + "/submit?wait=1",
+                             _req_obj(csv, str(tmp_path / "h1.txt"),
+                                      tenant="b"))
+        assert code == 200 and row["ok"]
+        edge = lis.edge_stats()
+        assert edge["rejected"] == 0
+        assert edge["held_accepts"] >= 1
+    srv.shutdown()
+
+
+def test_edge_tenant_depth_bound(tmp_path):
+    csv = _seq(tmp_path)
+    srv = _server(tmp_path)          # deliberately NOT started: queued
+    policy = EdgePolicy(max_tenant_depth=2)
+    with NetListener(srv, port=0, policy=policy) as lis:
+        url = f"http://127.0.0.1:{lis.port}"
+        for i in range(2):
+            code, _row, _ = _post(
+                url + "/submit",
+                _req_obj(csv, str(tmp_path / f"d{i}.txt"), tenant="t"))
+            assert code == 202
+        code, err, headers = _post(
+            url + "/submit",
+            _req_obj(csv, str(tmp_path / "d2.txt"), tenant="t"),
+            expect_error=True)
+        assert code == 429 and "depth" in err["error"]
+        assert "Retry-After" in headers
+        # another tenant is NOT shed by t's depth
+        code, _row, _ = _post(
+            url + "/submit",
+            _req_obj(csv, str(tmp_path / "d3.txt"), tenant="u"))
+        assert code == 202
+        srv.start()
+        srv.drain(timeout=240)
+    srv.shutdown()
+
+
+def test_edge_reused_req_id_does_not_leak_budget(tmp_path):
+    """A client retrying with the SAME req_id while the first attempt
+    is in flight must not ratchet the edge's outstanding total up —
+    the replaced entry's priced bytes are freed on re-register."""
+    csv = _seq(tmp_path)
+    srv = _server(tmp_path, budget_bytes=250 << 20,
+                  pricer=lambda reqs, reserve: (100 << 20) * len(reqs),
+                  rss_probe=lambda: 0)   # not started: all stay queued
+    with NetListener(srv, port=0) as lis:
+        url = f"http://127.0.0.1:{lis.port}"
+        for attempt in range(2):         # same req_id twice
+            code, _row, _ = _post(url + "/submit", _req_obj(
+                csv, str(tmp_path / f"rr_{attempt}.txt"),
+                req_id="fixed-id"))
+            assert code == 202
+        # outstanding must be ONE 100MB entry, so a third distinct
+        # request (100MB) still fits the 250MB edge budget
+        assert lis.edge_stats()["outstanding_priced_bytes"] == 100 << 20
+        code, _row, _ = _post(url + "/submit",
+                              _req_obj(csv, str(tmp_path / "rr2.txt"),
+                                       tenant="u"))
+        assert code == 202
+        srv.start()
+        srv.drain(timeout=240)
+    srv.shutdown()
+
+
+def test_edge_unfetched_results_expire(tmp_path):
+    """Fire-and-forget clients must not grow a resident edge forever:
+    a served-but-never-fetched result is dropped after result_ttl_s."""
+    csv = _seq(tmp_path)
+    srv = _server(tmp_path).start()
+    policy = EdgePolicy(result_ttl_s=0.2)
+    with NetListener(srv, port=0, policy=policy) as lis:
+        url = f"http://127.0.0.1:{lis.port}"
+        code, sub, _ = _post(url + "/submit",
+                             _req_obj(csv, str(tmp_path / "ttl.txt")))
+        assert code == 202
+        srv.drain(timeout=240)
+        _wait_for(lambda: lis.edge_stats()["outstanding_requests"] == 0,
+                  30, "unfetched result expired")
+        code, _ = _get(url + f"/result/{sub['req_id']}",
+                       expect_error=True)
+        assert code == 404
+    srv.shutdown()
+
+
+def test_edge_malformed_timeout_is_400_not_crash(tmp_path):
+    csv = _seq(tmp_path)
+    srv = _server(tmp_path).start()
+    with NetListener(srv, port=0) as lis:
+        url = f"http://127.0.0.1:{lis.port}"
+        code, err = _get(url + "/result/whatever?timeout=abc",
+                         expect_error=True)
+        assert code == 400 and "timeout" in err["error"]
+        code, _err, _ = _post(url + "/submit?wait=1&timeout=nope",
+                              _req_obj(csv, str(tmp_path / "tq.txt")),
+                              expect_error=True)
+        assert code == 400
+        srv.drain(timeout=240)           # the 400'd job still ran
+    srv.shutdown()
+
+
+def test_edge_policy_not_mutated_across_listeners(tmp_path):
+    """Resolving the default edge budget must never write through to a
+    caller's shared EdgePolicy — listener B would inherit listener A's
+    server budget and accept work B's admission can never hold."""
+    policy = EdgePolicy(shed_mode="hold")
+    srv_a = _server(tmp_path, budget_bytes=3 << 30)
+    srv_b = JobServer(budget_bytes=150 << 20,
+                      state_root=str(tmp_path / "b_state"))
+    lis_a = NetListener(srv_a, port=0, policy=policy)
+    lis_b = NetListener(srv_b, port=0, policy=policy)
+    try:
+        assert policy.budget_bytes is None       # caller's object intact
+        assert lis_a.policy.budget_bytes == 3 << 30
+        assert lis_b.policy.budget_bytes == 150 << 20
+        assert lis_b.policy.shed_mode == "hold"  # knobs still copied
+    finally:
+        # never started: close the bound sockets directly (stop() joins
+        # an accept loop these listeners never ran)
+        lis_a._httpd.server_close()
+        lis_b._httpd.server_close()
+        srv_a.shutdown(drain=False)
+        srv_b.shutdown(drain=False)
+
+
+def test_listener_drain_state(tmp_path):
+    csv = _seq(tmp_path)
+    srv = _server(tmp_path).start()
+    with NetListener(srv, port=0) as lis:
+        url = f"http://127.0.0.1:{lis.port}"
+        code, _row, _ = _post(url + "/submit?wait=1",
+                              _req_obj(csv, str(tmp_path / "dr.txt")))
+        assert code == 200
+        lis.begin_drain()
+        code, health = _get(url + "/healthz", expect_error=True)
+        assert code == 503 and health["status"] == "draining"
+        code, err, _ = _post(url + "/submit",
+                             _req_obj(csv, str(tmp_path / "dr2.txt")),
+                             expect_error=True)
+        assert code == 503 and err["status"] == "draining"
+    srv.shutdown()
+
+
+# ------------------------------------------------------------- subprocesses
+def _wait_for(predicate, timeout, what):
+    deadline = time.perf_counter() + timeout
+    while not predicate():
+        assert time.perf_counter() < deadline, f"timed out: {what}"
+        time.sleep(0.05)
+
+
+def test_serve_spool_sigterm_graceful_drain(tmp_path):
+    """SIGTERM on a `serve --spool` session is a graceful drain: the
+    claimed request finishes, the final metrics.json lands, exit 0."""
+    csv = _seq(tmp_path)
+    spool = str(tmp_path / "spool")
+    os.makedirs(os.path.join(spool, "in"), exist_ok=True)
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "avenir_tpu", "serve", "--spool", spool,
+         "--workers", "1", "--metrics-interval", "0.2"],
+        cwd=REPO, env=_SUB_ENV, stderr=subprocess.PIPE, text=True)
+    try:
+        req = _req_obj(csv, str(tmp_path / "sig.txt"))
+        tmp = os.path.join(spool, "r1.json.tmp")
+        with open(tmp, "w") as fh:
+            json.dump(req, fh)
+        os.replace(tmp, os.path.join(spool, "in", "r1.json"))
+        out_path = os.path.join(spool, "out", "r1.json")
+        _wait_for(lambda: os.path.exists(out_path), 240,
+                  "spooled request served")
+        proc.send_signal(signal.SIGTERM)
+        _stdout, stderr = proc.communicate(timeout=120)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait()
+    assert proc.returncode == 0, stderr[-800:]
+    assert '"drained": true' in stderr
+    with open(os.path.join(spool, "metrics.json")) as fh:
+        snap = json.load(fh)
+    assert snap["stats"]["served"] >= 1
+    with open(out_path) as fh:
+        assert json.load(fh)["ok"]
+
+
+def test_serve_listen_cli_sigterm(tmp_path):
+    """`serve --listen 127.0.0.1:0`: ephemeral port via --port-file,
+    HTTP round trip, SIGTERM drains to exit 0."""
+    csv = _seq(tmp_path)
+    port_file = str(tmp_path / "port")
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "avenir_tpu", "serve", "--listen",
+         "127.0.0.1:0", "--workers", "1", "--port-file", port_file],
+        cwd=REPO, env=_SUB_ENV, stderr=subprocess.PIPE, text=True)
+    try:
+        _wait_for(lambda: os.path.exists(port_file), 120, "port file")
+        with open(port_file) as fh:
+            port = int(fh.read())
+        url = f"http://127.0.0.1:{port}"
+        code, row, _ = _post(url + "/submit?wait=1",
+                             _req_obj(csv, str(tmp_path / "lc.txt")))
+        assert code == 200 and row["ok"]
+        code, health = _get(url + "/healthz")
+        assert code == 200
+        proc.send_signal(signal.SIGTERM)
+        _stdout, stderr = proc.communicate(timeout=120)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait()
+    assert proc.returncode == 0, stderr[-800:]
+    twin = run_job("markovStateTransitionModel", MST_CONF, [csv],
+                   str(tmp_path / "lc_ref.txt"))
+    with open(tmp_path / "lc.txt", "rb") as fa, \
+            open(twin.outputs[0], "rb") as fb:
+        assert fa.read() == fb.read()
+
+
+def test_fleet_two_hosts_round_trip(tmp_path):
+    """2 subprocess hosts behind the router: byte-identical artifacts,
+    corpus affinity (repeats hit the warm host), per-host metrics
+    merged through the additive histogram algebra, SIGTERM exit 0."""
+    a = _seq(tmp_path, seed=1, name="a.csv")
+    b = _seq(tmp_path, seed=2, name="b.csv")
+    fleet = Fleet(str(tmp_path / "fleet"), hosts=2, workers=1,
+                  env=_SUB_ENV)
+    fleet.start()
+    try:
+        names = {}
+        for i, corpus in enumerate([a, b, a, b]):
+            names[i] = fleet.submit(_req_obj(
+                corpus, str(tmp_path / f"fo{i}.txt"), tenant=f"t{i}"))
+        rows = fleet.collect(list(names.values()), timeout=240)
+        assert all(r["ok"] for r in rows.values())
+        snap = fleet.merged_metrics()
+        router = fleet.router.snapshot()
+    finally:
+        codes = fleet.stop()
+    assert codes == [0, 0]             # SIGTERM drained both hosts
+    assert snap["hosts"] == 2
+    # 4 placements over 2 corpora: 2 misses seed the map, 2 repeats hit
+    assert router["stats"]["affinity_misses"] == 2
+    assert router["stats"]["affinity_hits"] == 2
+    assert fleet.router.affinity_hit_rate() == 0.5
+    for h in router["hosts"]:
+        assert h["peak_assigned_bytes"] <= h["budget_bytes"]
+    # the final fleet metrics.json was written by stop() from the
+    # hosts' shutdown snapshots — the deterministic place to assert the
+    # merged counters and the additive histogram fold (the live `snap`
+    # depends on interval timing)
+    with open(tmp_path / "fleet" / "metrics.json") as fh:
+        final = json.load(fh)
+    assert final["stats"]["served"] >= 4.0
+    assert final["router"]["stats"]["placed"] == 4
+    # merged hists fold both hosts' queue-wait distributions
+    assert final["hists"]["queue_wait_ms"]["count"] >= 4
+    twins = {
+        a: run_job("markovStateTransitionModel", MST_CONF, [a],
+                   str(tmp_path / "fa_ref.txt")),
+        b: run_job("markovStateTransitionModel", MST_CONF, [b],
+                   str(tmp_path / "fb_ref.txt")),
+    }
+    for i, corpus in enumerate([a, b, a, b]):
+        with open(tmp_path / f"fo{i}.txt", "rb") as fa, \
+                open(twins[corpus].outputs[0], "rb") as fb:
+            assert fa.read() == fb.read()
+
+
+def test_fleet_blocking_submit_sweeps_its_own_capacity(tmp_path):
+    """A saturated single-threaded front must not livelock: a blocking
+    submit sweeps finished results itself to free the budget vector,
+    and the banked rows still arrive through their named collect."""
+    csv = _seq(tmp_path)
+    probe = Fleet(str(tmp_path / "probe"), hosts=1, env=_SUB_ENV)
+    _req, priced, _cost = probe.price(_req_obj(csv, "x"))
+    # budget fits exactly ONE request at a time
+    fleet = Fleet(str(tmp_path / "fleet"), hosts=1,
+                  budget_mb=priced * 1.5 / (1 << 20), env=_SUB_ENV)
+    fleet.start()
+    try:
+        names = [fleet.submit(_req_obj(csv, str(tmp_path / f"sw{i}.txt"),
+                                       tenant=f"t{i}"), timeout=240)
+                 for i in range(3)]      # 2nd/3rd block until a sweep
+        rows = fleet.collect(names, timeout=240)
+    finally:
+        codes = fleet.stop()
+    assert codes == [0]
+    assert sorted(rows) == sorted(names)
+    assert all(r["ok"] for r in rows.values())
+    snap = fleet.router.snapshot()
+    assert snap["hosts"][0]["peak_assigned_bytes"] <= \
+        snap["hosts"][0]["budget_bytes"]
+    assert snap["hosts"][0]["assigned_bytes"] == 0   # all released
+
+
+def test_fleet_cli_once(tmp_path):
+    """`python -m avenir_tpu fleet --root R --hosts 1 --once`: requests
+    spooled into the FLEET root are routed, served, and answered in
+    <root>/out with nonce namespacing; merged metrics land at the
+    root."""
+    csv = _seq(tmp_path)
+    root = str(tmp_path / "froot")
+    os.makedirs(os.path.join(root, "in"), exist_ok=True)
+    drops = [("q1.json", _req_obj(csv, str(tmp_path / "fc.txt"),
+                                  nonce="client7")),
+             ("q2.json", {"job": "noSuchJob", "conf": {},
+                          "inputs": [csv], "output": "x",
+                          "nonce": "bad1"})]
+    for name, req in drops:
+        tmp = os.path.join(root, f"{name}.tmp")
+        with open(tmp, "w") as fh:
+            json.dump(req, fh)
+        os.replace(tmp, os.path.join(root, "in", name))
+    proc = subprocess.run(
+        [sys.executable, "-m", "avenir_tpu", "fleet", "--root", root,
+         "--hosts", "1", "--once", "--metrics-interval", "0.2"],
+        cwd=REPO, env=_SUB_ENV, capture_output=True, text=True,
+        timeout=300)
+    assert proc.returncode == 1, proc.stderr[-800:]   # 1 failed request
+    with open(os.path.join(root, "out", "client7.q1.json")) as fh:
+        row = json.load(fh)
+    assert row["ok"] and row["nonce"] == "client7"
+    # the FAILED request's row honors its nonce namespace too
+    with open(os.path.join(root, "out", "bad1.q2.json")) as fh:
+        bad = json.load(fh)
+    assert not bad["ok"] and bad["nonce"] == "bad1"
+    assert "noSuchJob" in bad["error"]
+    with open(os.path.join(root, "metrics.json")) as fh:
+        snap = json.load(fh)
+    assert snap["router"]["stats"]["placed"] == 1
+    # `stats` on a 1-host fleet root still renders the router section
+    from avenir_tpu.obs.report import stats_main
+
+    assert stats_main([root]) == 0
+
+
+def test_serve_stdin_still_killed_by_sigterm(tmp_path):
+    """--stdin sessions keep the DEFAULT signal semantics (EOF is
+    their graceful end): SIGTERM must terminate the process, not be
+    absorbed by a drain handler nothing in the stdin path reads."""
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "avenir_tpu", "serve", "--stdin",
+         "--workers", "1"],
+        cwd=REPO, env=_SUB_ENV, stdin=subprocess.PIPE,
+        stderr=subprocess.DEVNULL)
+    try:
+        time.sleep(1.0)                  # let it reach the read loop
+        proc.send_signal(signal.SIGTERM)
+        rc = proc.wait(timeout=30)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait()
+    assert rc != 0                       # killed by the signal, not hung
+
+
+def test_spool_failure_row_keeps_nonce(tmp_path):
+    """A nonce-carrying request that FAILS (unknown job) still writes
+    its row at out/<nonce>.<name> — the polling client must see the
+    failure, and the un-namespaced stem must stay unclobbered."""
+    import threading
+
+    from avenir_tpu.server.spool import serve_spool
+
+    spool = str(tmp_path / "spool")
+    os.makedirs(os.path.join(spool, "in"), exist_ok=True)
+    stop = threading.Event()
+    srv = _server(tmp_path)
+    with srv:
+        t = threading.Thread(target=lambda: serve_spool(
+            srv, spool, should_stop=stop.is_set))
+        t.start()
+        try:
+            req = {"job": "noSuchJob", "conf": {}, "inputs": [],
+                   "output": "x", "nonce": "cfail"}
+            tmp = os.path.join(spool, "bad.tmp")
+            with open(tmp, "w") as fh:
+                json.dump(req, fh)
+            os.replace(tmp, os.path.join(spool, "in", "bad.json"))
+            out = os.path.join(spool, "out", "cfail.bad.json")
+            _wait_for(lambda: os.path.exists(out), 60,
+                      "nonce-namespaced failure row")
+        finally:
+            stop.set()
+            t.join(30)
+        assert not t.is_alive()
+    with open(out) as fh:
+        row = json.load(fh)
+    assert not row["ok"] and row["nonce"] == "cfail"
+    assert "noSuchJob" in row["error"]
+
+
+# ------------------------------------------------------------- stats merge
+def test_stats_merges_snapshots_and_fleet_dirs(tmp_path):
+    from avenir_tpu.obs.report import (expand_metrics_paths,
+                                       merge_snapshots, render_metrics,
+                                       stats_main)
+
+    csv = _seq(tmp_path)
+    paths = []
+    for i in range(2):
+        mp = str(tmp_path / f"host{i}" / "metrics.json")
+        os.makedirs(os.path.dirname(mp), exist_ok=True)
+        srv = JobServer(workers=1, metrics_path=mp,
+                        state_root=str(tmp_path / f"state{i}"))
+        t = srv.submit(JobRequest(
+            "markovStateTransitionModel", MST_CONF, [csv],
+            str(tmp_path / f"m{i}.txt"), tenant=f"t{i}"))
+        with srv:
+            t.result(240)
+        paths.append(mp)
+    snaps = [json.load(open(p)) for p in paths]
+    merged = merge_snapshots(snaps)
+    assert merged["hosts"] == 2
+    assert merged["stats"]["served"] == 2.0
+    # the histograms merged ADDITIVELY: merged count = sum of counts
+    assert merged["hists"]["queue_wait_ms"]["count"] == sum(
+        s["hists"]["queue_wait_ms"]["count"] for s in snaps)
+    assert merged["hists"]["queue_wait_ms"]["max"] == max(
+        s["hists"]["queue_wait_ms"]["max"] for s in snaps)
+    text = render_metrics(merged)
+    assert "2 hosts merged" in text
+    # the CLI: N explicit paths, and the fleet-root glob, both exit 0
+    assert stats_main(paths) == 0
+    assert stats_main([str(tmp_path)]) == 0          # host*/ glob
+    assert stats_main(paths + ["--json"]) == 0
+    assert stats_main([str(tmp_path / "nope")]) == 2
+    assert expand_metrics_paths([str(tmp_path)]) == paths
+
+
+# ------------------------------------------------------------ load harness
+def test_fleet_load_harness_inproc(tmp_path, capsys):
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    try:
+        import fleet_load
+    finally:
+        sys.path.pop(0)
+    rc = fleet_load.main(["--requests", "4", "--tenants", "3",
+                          "--corpora", "2", "--rows", "200",
+                          "--rate", "50", "--arms", "inproc"])
+    assert rc == 0
+    lines = [json.loads(ln) for ln in
+             capsys.readouterr().out.strip().splitlines()]
+    assert lines[0]["offered_jobs_per_min"] > 0
+    arm = lines[1]
+    assert arm["arm"] == "inproc"
+    assert arm["served"] == 4 and arm["shed"] == 0
+    assert arm["jobs_per_min"] > 0
+    assert arm["p99_queue_wait_ms"] >= arm["p50_queue_wait_ms"] >= 0.0

@@ -28,7 +28,7 @@ import pytest
 
 from avenir_tpu.runner import run_incremental, run_job
 from avenir_tpu.server import (AdmissionError, JobRequest, JobServer,
-                               ServerClosed, compat_key,
+                               ServerClosed, Ticket, compat_key,
                                price_request_bytes, serve_spool,
                                serve_stream)
 
@@ -520,6 +520,143 @@ def test_serve_spool_once(tmp_path):
     twin = run_job("mutualInformation", _mi_conf(schema), [csv],
                    str(tmp_path / "sp_ref.txt"))
     assert _read(str(tmp_path / "sp.txt")) == _read(twin.outputs[0])
+
+
+def test_spool_nonce_namespaces_results(tmp_path):
+    """Two clients reusing ONE filename stem used to overwrite each
+    other in <spool>/out; with client nonces the results live side by
+    side as <nonce>.<name>."""
+    import time
+
+    from avenir_tpu.server.spool import result_name
+
+    csv, schema = _churn(tmp_path, rows=400)
+    spool = str(tmp_path / "spool")
+    in_dir = os.path.join(spool, "in")
+    os.makedirs(in_dir, exist_ok=True)
+    stop = threading.Event()
+    srv = _server(tmp_path, workers=1)
+    failures = []
+    with srv:
+        t = threading.Thread(target=lambda: failures.append(
+            serve_spool(srv, spool, should_stop=stop.is_set)))
+        t.start()
+        try:
+            def drop(nonce, out):
+                req = {"job": "bayesianDistr",
+                       "conf": _conf("bad", schema), "inputs": [csv],
+                       "output": out, "nonce": nonce}
+                tmp = os.path.join(spool, f".{nonce}.tmp")
+                with open(tmp, "w") as fh:
+                    json.dump(req, fh)
+                os.replace(tmp, os.path.join(in_dir, "req.json"))
+
+            def wait_for(path, what, timeout=120):
+                deadline = time.perf_counter() + timeout
+                while not os.path.exists(path):
+                    assert time.perf_counter() < deadline, what
+                    time.sleep(0.05)
+
+            out_a = os.path.join(spool, "out", "clientA.req.json")
+            out_b = os.path.join(spool, "out", "clientB.req.json")
+            drop("clientA", str(tmp_path / "na.csv"))
+            wait_for(out_a, "client A result")
+            drop("clientB", str(tmp_path / "nb.csv"))
+            wait_for(out_b, "client B result")
+        finally:
+            stop.set()
+            t.join(60)
+        assert not t.is_alive()
+    for path, nonce in ((out_a, "clientA"), (out_b, "clientB")):
+        with open(path) as fh:
+            row = json.load(fh)
+        assert row["ok"] and row["nonce"] == nonce
+    # both artifacts written — nothing overwrote anything
+    assert _read(str(tmp_path / "na.csv")) == _read(str(tmp_path / "nb.csv"))
+    # the namespacing recipe itself
+    ticket = Ticket(JobRequest("j", {}, [], "", nonce="n1"))
+    assert result_name("req.json", ticket) == "n1.req.json"
+    assert result_name("req.json", Ticket(JobRequest("j", {}, [], ""))) \
+        == "req.json"
+
+
+def test_spool_concurrent_writers_same_stems(tmp_path):
+    """Two writer threads submit through one spool with IDENTICAL
+    filename stems (no-clobber drops: link-then-retry, the documented
+    client discipline), distinct nonces: every request is served and
+    every result is separately addressable."""
+    import time
+
+    csv, schema = _churn(tmp_path, rows=400)
+    spool = str(tmp_path / "spool")
+    in_dir = os.path.join(spool, "in")
+    os.makedirs(in_dir, exist_ok=True)
+    stop = threading.Event()
+    srv = _server(tmp_path, workers=2)
+    errors = []
+
+    def writer(nonce):
+        try:
+            for i in range(3):
+                req = {"job": "bayesianDistr",
+                       "conf": _conf("bad", schema), "inputs": [csv],
+                       "output": str(tmp_path / f"cw_{nonce}_{i}.csv"),
+                       "nonce": nonce}
+                tmp = os.path.join(spool, f".{nonce}_{i}.tmp")
+                with open(tmp, "w") as fh:
+                    json.dump(req, fh)
+                dst = os.path.join(in_dir, f"r{i}.json")   # shared stem
+                deadline = time.perf_counter() + 120
+                while True:                  # atomic no-clobber drop
+                    try:
+                        os.link(tmp, dst)
+                        os.remove(tmp)
+                        break
+                    except FileExistsError:
+                        assert time.perf_counter() < deadline
+                        time.sleep(0.02)
+                out = os.path.join(spool, "out", f"{nonce}.r{i}.json")
+                deadline = time.perf_counter() + 120
+                while not os.path.exists(out):
+                    assert time.perf_counter() < deadline
+                    time.sleep(0.02)
+        except BaseException as exc:  # noqa: BLE001 — reported to main
+            errors.append((nonce, exc))
+
+    with srv:
+        t = threading.Thread(target=lambda: serve_spool(
+            srv, spool, should_stop=stop.is_set))
+        t.start()
+        writers = [threading.Thread(target=writer, args=(n,))
+                   for n in ("wa", "wb")]
+        try:
+            for w in writers:
+                w.start()
+            for w in writers:
+                w.join(240)
+                assert not w.is_alive(), "writer wedged"
+        finally:
+            stop.set()
+            t.join(60)
+        assert not t.is_alive()
+    assert not errors, errors
+    for nonce in ("wa", "wb"):
+        for i in range(3):
+            with open(os.path.join(spool, "out",
+                                   f"{nonce}.r{i}.json")) as fh:
+                row = json.load(fh)
+            assert row["ok"] and row["nonce"] == nonce
+
+
+def test_request_from_json_rejects_bad_nonce(tmp_path):
+    from avenir_tpu.server.spool import request_from_json
+
+    base = {"job": "j", "conf": {}, "inputs": [], "output": ""}
+    assert request_from_json({**base, "nonce": "ok-1.a_B"}).nonce \
+        == "ok-1.a_B"
+    for bad in ("", ".hidden", "a/b", "../up", "x" * 65):
+        with pytest.raises(ValueError):
+            request_from_json({**base, "nonce": bad})
 
 
 def test_metrics_snapshot_written_and_rendered(tmp_path):

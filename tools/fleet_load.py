@@ -1,0 +1,217 @@
+#!/usr/bin/env python
+"""Open-loop load harness for the job-server fleet.
+
+Synthetic tenants fire requests at the serving surface the way real
+traffic does — on a Poisson arrival clock that does NOT wait for
+completions (open loop: a slow server faces the same offered load as a
+fast one, so queue-wait tails are honest), over a corpus population
+with Zipfian popularity (a few hot corpora, a long cold tail — the
+distribution that makes warm-affinity routing matter).
+
+Arms:
+
+- ``inproc``  — one in-process JobServer (no subprocess, the fast arm
+  for tests and tier-1).
+- ``solo``    — a 1-host fleet: one ``serve --spool`` subprocess.
+- ``fleet``   — an N-host fleet behind the affinity router.
+
+Per arm it prints ONE JSON line: offered vs served jobs/min, p50/p99
+queue wait and p99 chunk latency (the PR 10 histograms, read from the
+server's merged metrics — never recomputed client-side), shed count
+(fleet arms shed when every host is over its budget-vector entry), and
+the router's affinity hit rate.
+
+    python tools/fleet_load.py --requests 40 --tenants 20 --corpora 6 \
+        --rows 2000 --rate 5 --arms inproc,fleet --hosts 2
+"""
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+MST_CONF = {"mst.model.states": "L,M,H",
+            "mst.class.label.field.ord": "1",
+            "mst.skip.field.count": "2",
+            "mst.class.labels": "T,F"}
+
+
+def write_corpus(path: str, rows: int, seed: int) -> None:
+    """A small markov-sequence corpus (the cheap byte-fold workload)."""
+    rng = np.random.default_rng(seed)
+    states = ["L", "M", "H"]
+    with open(path, "w") as fh:
+        for i in range(rows):
+            up = i % 2 == 0
+            s, toks = 1, []
+            for _ in range(6):
+                p = [0.1, 0.3, 0.6] if up else [0.6, 0.3, 0.1]
+                s = int(np.clip(s + rng.choice([-1, 0, 1], p=p), 0, 2))
+                toks.append(states[s])
+            fh.write(f"c{i},{'T' if up else 'F'}," + ",".join(toks) + "\n")
+
+
+def plan_load(args, corpora, out_dir):
+    """The open-loop schedule: (arrival_s, request_obj) rows, fixed by
+    the seed BEFORE any arm runs so every arm faces the identical
+    offered load. Corpus popularity is Zipf(s) over the corpus list;
+    arrivals are Poisson at --rate req/s."""
+    rng = np.random.default_rng(args.seed)
+    ranks = np.arange(1, len(corpora) + 1, dtype=float)
+    pmf = ranks ** -args.zipf_s
+    pmf /= pmf.sum()
+    gaps = rng.exponential(1.0 / args.rate, size=args.requests)
+    arrivals = np.cumsum(gaps)
+    load = []
+    for i in range(args.requests):
+        corpus = corpora[int(rng.choice(len(corpora), p=pmf))]
+        tenant = f"t{int(rng.integers(args.tenants)):04d}"
+        load.append((float(arrivals[i]), {
+            "job": "markovStateTransitionModel", "conf": MST_CONF,
+            "inputs": [corpus],
+            "output": os.path.join(out_dir, f"out_{i:05d}.txt"),
+            "tenant": tenant,
+        }))
+    return load
+
+
+def _hist_stats(hists, name):
+    h = hists.get(name) or {}
+    return {f"p50_{name}": h.get("p50", 0.0),
+            f"p99_{name}": h.get("p99", 0.0)}
+
+
+def run_inproc(args, load):
+    from avenir_tpu.server import JobRequest, JobServer
+    from avenir_tpu.server.spool import request_from_json
+
+    with tempfile.TemporaryDirectory(prefix="fleet_load_state_") as sr:
+        server = JobServer(workers=args.workers,
+                           state_root=sr).start()
+        tickets = []
+        t0 = time.perf_counter()
+        for arrival, obj in load:
+            _sleep_until(t0, arrival)
+            tickets.append(server.submit(request_from_json(obj)))
+        server.drain(timeout=args.drain_timeout)
+        wall = time.perf_counter() - t0
+        served = sum(1 for t in tickets if _ok(t))
+        stats = server.stats()
+        server.shutdown()
+    row = {"arm": "inproc", "hosts": 1, "served": served, "shed": 0,
+           "wall_s": round(wall, 2),
+           "jobs_per_min": round(served / (wall / 60.0), 2)}
+    row.update(_hist_stats(stats["hists"], "queue_wait_ms"))
+    return row
+
+
+def _ok(ticket):
+    try:
+        ticket.result(timeout=0)
+        return True
+    except BaseException:  # noqa: BLE001 — the count IS the report
+        return False
+
+
+def run_fleet(args, load, hosts):
+    from avenir_tpu.net.fleet import Fleet
+
+    root = tempfile.mkdtemp(prefix=f"fleet_load_{hosts}h_")
+    fleet = Fleet(root, hosts=hosts, workers=args.workers,
+                  budget_mb=args.budget_mb)
+    shed = 0
+    names = []
+    with fleet:
+        t0 = time.perf_counter()
+        for arrival, obj in load:
+            _sleep_until(t0, arrival)
+            # open loop: a fleet with no budget headroom sheds the
+            # arrival (the listener's 429 analog), never queues it
+            name = fleet.submit(obj, block=False)
+            if name is None:
+                shed += 1
+            else:
+                names.append(name)
+        rows = fleet.collect(names, timeout=args.drain_timeout)
+        wall = time.perf_counter() - t0
+        snap = fleet.merged_metrics()
+        hit_rate = fleet.router.affinity_hit_rate()
+    served = sum(1 for r in rows.values() if r.get("ok"))
+    row = {"arm": "fleet" if hosts > 1 else "solo", "hosts": hosts,
+           "served": served, "shed": shed, "wall_s": round(wall, 2),
+           "jobs_per_min": round(served / (wall / 60.0), 2),
+           "affinity_hit_rate": round(hit_rate, 3)}
+    row.update(_hist_stats(snap.get("hists", {}), "queue_wait_ms"))
+    row.update(_hist_stats(snap.get("hists", {}), "chunk_latency_ms"))
+    return row
+
+
+def _sleep_until(t0, arrival):
+    delay = arrival - (time.perf_counter() - t0)
+    if delay > 0:
+        time.sleep(delay)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="open-loop Zipf/Poisson load against the job-server "
+                    "fleet (module docstring)")
+    ap.add_argument("--requests", type=int, default=40)
+    ap.add_argument("--tenants", type=int, default=200)
+    ap.add_argument("--corpora", type=int, default=8)
+    ap.add_argument("--rows", type=int, default=5_000,
+                    help="rows per corpus (default 5000)")
+    ap.add_argument("--rate", type=float, default=5.0,
+                    help="Poisson arrival rate, requests/s (default 5)")
+    ap.add_argument("--zipf-s", type=float, default=1.1,
+                    help="Zipf exponent of corpus popularity")
+    ap.add_argument("--hosts", type=int, default=2)
+    ap.add_argument("--workers", type=int, default=1)
+    ap.add_argument("--budget-mb", type=float, default=3072.0)
+    ap.add_argument("--arms", default="inproc,fleet",
+                    help="comma list of inproc,solo,fleet")
+    ap.add_argument("--seed", type=int, default=7)
+    ap.add_argument("--drain-timeout", type=float, default=1800.0)
+    args = ap.parse_args(argv)
+
+    work = tempfile.mkdtemp(prefix="fleet_load_")
+    corpora = []
+    for i in range(args.corpora):
+        path = os.path.join(work, f"corpus_{i:03d}.csv")
+        write_corpus(path, args.rows, seed=100 + i)
+        corpora.append(path)
+    out_dir = os.path.join(work, "out")
+    os.makedirs(out_dir, exist_ok=True)
+    load = plan_load(args, corpora, out_dir)
+    offered = args.requests / (load[-1][0] / 60.0)
+    print(json.dumps({"offered_jobs_per_min": round(offered, 2),
+                      "requests": args.requests,
+                      "corpora": args.corpora, "tenants": args.tenants,
+                      "zipf_s": args.zipf_s, "workdir": work}))
+    rc = 0
+    for arm in args.arms.split(","):
+        arm = arm.strip()
+        if arm == "inproc":
+            row = run_inproc(args, load)
+        elif arm == "solo":
+            row = run_fleet(args, load, hosts=1)
+        elif arm == "fleet":
+            row = run_fleet(args, load, hosts=args.hosts)
+        else:
+            print(f"unknown arm {arm!r}", file=sys.stderr)
+            return 2
+        row["offered_jobs_per_min"] = round(offered, 2)
+        if row["served"] + row["shed"] < args.requests:
+            rc = 1                    # lost requests: a harness bug
+        print(json.dumps(row))
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())
