@@ -29,6 +29,23 @@ Placement cost: when a profile store (``avenir_tpu.tune``) is
 configured, the router's tie-break consults the measured per-chunk fold
 cost of each (job, corpus) — a corpus whose folds are measured
 expensive counts for more pending load than its bytes alone say.
+
+Fault tolerance (avenir-fault, :mod:`avenir_tpu.net.fault`): a
+supervisor thread watches the host processes (exit code + spool
+heartbeat = the host's ``metrics.json`` mtime), restarts a dead host
+with capped exponential backoff and quarantines one that dies
+repeatedly; every placed request carries a LEASE file under
+``<root>/leases/`` that the front renews while the host stays healthy
+and sweeps when it does not — the request requeues to a different
+healthy host (failed ones excluded), and because results are
+nonce-namespaced, byte-identical by construction and atomically
+renamed into place, a slow original finishing late is a harmless
+duplicate write, never a conflict. When a healthy host's queue-wait
+tail runs hot past the fleet median, its queued requests are MIRRORED
+to the least-loaded compatible host (hedged dispatch, charged against
+the budget vector) and the first result to land wins. All of it is
+policy-driven by :class:`~avenir_tpu.net.fault.FaultPolicy` and gated
+by ``bench_scaling.fleet_fault_tripwire``.
 """
 
 from __future__ import annotations
@@ -42,6 +59,9 @@ import threading
 import time
 from typing import Dict, List, Optional, Sequence, Tuple
 
+from avenir_tpu.net import fault
+from avenir_tpu.net.fault import (FaultPolicy, Lease, LeaseStore,
+                                  RestartTracker, Supervisor)
 from avenir_tpu.net.router import AffinityRouter, Placement
 from avenir_tpu.server.spool import (nonce_result_name,
                                      request_from_json, spool_dirs)
@@ -75,16 +95,35 @@ class FleetError(RuntimeError):
     """A fleet host died or refused to start."""
 
 
-class _Outstanding:
-    """One submitted request the front is waiting on."""
+class _Copy:
+    """One spooled COPY of an outstanding request: the original
+    placement, a requeue, or a hedged mirror — each with its own spool
+    name, out path and budget accounting."""
 
-    __slots__ = ("placement", "out_path", "work_name")
+    __slots__ = ("placement", "name", "out_path")
 
-    def __init__(self, placement: Placement, out_path: str,
-                 work_name: str):
+    def __init__(self, placement: Placement, name: str, out_path: str):
         self.placement = placement
+        self.name = name
         self.out_path = out_path
-        self.work_name = work_name
+
+
+class _Outstanding:
+    """One submitted request the front is waiting on. ``copies`` holds
+    every spooled copy (original + requeues + mirrors); the first
+    result to land on ANY copy's out path wins and releases all of
+    them — re-execution is safe by the idempotency contract, so a late
+    duplicate is an identical write, never a conflict."""
+
+    __slots__ = ("copies", "obj", "submitted_at", "lease", "mirrored")
+
+    def __init__(self, copy: _Copy, obj: Dict, submitted_at: float,
+                 lease: Lease):
+        self.copies = [copy]
+        self.obj = obj
+        self.submitted_at = submitted_at
+        self.lease = lease
+        self.mirrored = False
 
 
 class Fleet:
@@ -102,7 +141,8 @@ class Fleet:
                  metrics_interval_s: float = 0.5,
                  profile_dir: Optional[str] = None,
                  env: Optional[Dict[str, str]] = None,
-                 pin_cores: Optional[Sequence[int]] = None):
+                 pin_cores: Optional[Sequence[int]] = None,
+                 fault_policy: Optional[FaultPolicy] = None):
         """``pin_cores``: pin host i to CPU ``pin_cores[i % len]``
         (Linux ``sched_setaffinity``; ignored where unsupported). On a
         shared box an UNPINNED single process borrows every core
@@ -124,11 +164,29 @@ class Fleet:
         self.profile_dir = profile_dir
         self._env = env
         self.pin_cores = list(pin_cores) if pin_cores else None
-        self._procs: List[subprocess.Popen] = []
-        self._logs: List[str] = []
+        self.fault = fault_policy or FaultPolicy()
+        self._procs: List[Optional[subprocess.Popen]] = [None] * hosts
+        self._logs: List[str] = [
+            os.path.join(d, "server.log") for d in self.host_dirs]
         self._lock = threading.Lock()
         self._seq = 0
         self._outstanding: Dict[str, _Outstanding] = {}
+        # ---- fault-tolerance state (avenir_tpu.net.fault) ----
+        self._leases = LeaseStore(self.root)
+        self._trackers = [RestartTracker(self.fault)
+                          for _ in range(hosts)]
+        self._host_state = [fault.SERVING] * hosts
+        self._restart_at: List[Optional[float]] = [None] * hosts
+        self._spawned_at = [0.0] * hosts
+        self._supervisor: Optional[Supervisor] = None
+        # a heartbeat bound tighter than the metrics refresh would mark
+        # every host stalled between writes
+        self._hb_timeout = max(self.fault.heartbeat_timeout_s,
+                               4.0 * self.metrics_interval_s)
+        self._fault_stats = {"requeues": 0, "respools": 0,
+                             "restarts": 0, "quarantined": 0,
+                             "abandoned": 0}
+        self._restart_counts = [0] * hosts
         #: finished rows swept off disk but not yet collect()ed — the
         #: submit loop's capacity sweep must never lose a row a later
         #: named collect() will ask for
@@ -145,54 +203,114 @@ class Fleet:
         self._price_memo: Dict[Tuple, Tuple] = {}
 
     # ------------------------------------------------------------ lifecycle
-    def start(self, timeout: float = 60.0) -> "Fleet":
+    def _host_env(self) -> Dict[str, str]:
         env = dict(os.environ if self._env is None else self._env)
         env["PYTHONPATH"] = os.pathsep.join(
             p for p in (_pkg_parent(), env.get("PYTHONPATH")) if p)
-        for i, host_dir in enumerate(self.host_dirs):
-            os.makedirs(host_dir, exist_ok=True)
-            log_path = os.path.join(host_dir, "server.log")
-            cmd = [sys.executable, "-m", "avenir_tpu", "serve",
-                   "--spool", host_dir,
-                   "--workers", str(self.workers),
-                   "--budget-mb", str(self.budget_bytes / (1 << 20)),
-                   "--warm-budget-mb", str(self.warm_budget_mb),
-                   "--state-root", os.path.join(host_dir, "state"),
-                   "--metrics-interval", str(self.metrics_interval_s)]
-            if self.profile_dir:
-                # hosts share ONE profile store: a fold cost measured on
-                # any host informs placement for all of them
-                cmd += ["--autotune-dir", self.profile_dir]
-            preexec = None
-            if self.pin_cores and hasattr(os, "sched_setaffinity"):
-                core = self.pin_cores[i % len(self.pin_cores)]
-                preexec = (lambda c=core:
-                           os.sched_setaffinity(0, {c}))
-            with open(log_path, "ab") as log:
-                proc = subprocess.Popen(cmd, stdout=log, stderr=log,
-                                        env=env, cwd=_pkg_parent(),
-                                        preexec_fn=preexec)
-            self._procs.append(proc)
-            self._logs.append(log_path)
+        return env
+
+    def _spawn_host(self, i: int) -> None:
+        """(Re)spawn host `i`'s ``serve --spool`` process — shared by
+        ``start()`` and the supervisor's restart path, so a restarted
+        host comes back with the identical config (budget, state root,
+        core pin) it died with."""
+        host_dir = self.host_dirs[i]
+        os.makedirs(host_dir, exist_ok=True)
+        cmd = [sys.executable, "-m", "avenir_tpu", "serve",
+               "--spool", host_dir,
+               "--workers", str(self.workers),
+               "--budget-mb", str(self.budget_bytes / (1 << 20)),
+               "--warm-budget-mb", str(self.warm_budget_mb),
+               "--state-root", os.path.join(host_dir, "state"),
+               "--metrics-interval", str(self.metrics_interval_s)]
+        if self.profile_dir:
+            # hosts share ONE profile store: a fold cost measured on
+            # any host informs placement for all of them
+            cmd += ["--autotune-dir", self.profile_dir]
+        preexec = None
+        if self.pin_cores and hasattr(os, "sched_setaffinity"):
+            core = self.pin_cores[i % len(self.pin_cores)]
+            preexec = (lambda c=core:
+                       os.sched_setaffinity(0, {c}))
+        with open(self._logs[i], "ab") as log:
+            proc = subprocess.Popen(cmd, stdout=log, stderr=log,
+                                    env=self._host_env(),
+                                    cwd=_pkg_parent(),
+                                    preexec_fn=preexec)
+        with self._lock:
+            self._procs[i] = proc
+            self._spawned_at[i] = time.time()
+
+    def start(self, timeout: float = 60.0) -> "Fleet":
+        for i in range(len(self.host_dirs)):
+            self._spawn_host(i)
         deadline = time.perf_counter() + timeout
         for i, host_dir in enumerate(self.host_dirs):
             in_dir = os.path.join(host_dir, "in")
             while not os.path.isdir(in_dir):
-                self._check_alive()
+                # strict at boot: a host that cannot START is a config
+                # error the caller must see, not a runtime fault for
+                # the supervisor to mask by restarting forever
+                self._check_alive(strict=True)
                 if time.perf_counter() > deadline:
                     raise FleetError(
                         f"host {i} did not open its spool within "
                         f"{timeout}s (log: {self._logs[i]})")
                 time.sleep(_POLL_SECS)
+        if self.fault.supervise:
+            self._supervisor = Supervisor(
+                self._fault_tick, self.fault.poll_interval_s).start()
         return self
 
-    def _check_alive(self) -> None:
+    def _check_alive(self, strict: bool = False) -> None:
+        """With supervision on, a dead host is the SUPERVISOR's problem
+        (restart/quarantine) and callers only fail when every host is
+        quarantined — nothing left to requeue to. ``strict`` (boot, or
+        supervision off) keeps the PR-12 behavior: any dead host
+        raises."""
+        if self.fault.supervise and not strict:
+            with self._lock:
+                states = list(self._host_state)
+            if all(s == fault.QUARANTINED for s in states):
+                raise FleetError(
+                    "every fleet host is quarantined (died "
+                    f"> {self.fault.max_restarts} times inside "
+                    f"{self.fault.quarantine_window_s}s); logs: "
+                    f"{self._logs}")
+            return
         for i, proc in enumerate(self._procs):
-            rc = proc.poll()
+            rc = proc.poll() if proc is not None else None
             if rc is not None and rc != 0:
                 tail = _tail(self._logs[i])
                 raise FleetError(
                     f"fleet host {i} exited rc={rc}; log tail:\n{tail}")
+
+    def host_pid(self, i: int) -> Optional[int]:
+        """Host `i`'s live process id (None while dead/quarantined) —
+        the chaos harness's SIGKILL target."""
+        with self._lock:
+            proc = self._procs[i]
+        return proc.pid if proc is not None else None
+
+    def host_state(self, i: int) -> str:
+        with self._lock:
+            return self._host_state[i]
+
+    def reinstate(self, i: int) -> None:
+        """Operator reintegration of a quarantined host: clear its
+        death record and respawn it. The sticky map is NOT restored —
+        the host re-earns affinity through fresh hits, so a flapping
+        host cannot yank corpora back and forth."""
+        with self._lock:
+            if self._host_state[i] != fault.QUARANTINED:
+                raise FleetError(
+                    f"host {i} is {self._host_state[i]}, not "
+                    f"quarantined")
+            self._trackers[i] = RestartTracker(self.fault)
+        self._spawn_host(i)
+        with self._lock:
+            self._restart_counts[i] += 1
+        self._set_host_state(i, fault.SERVING)
 
     def __enter__(self) -> "Fleet":
         return self.start()
@@ -302,10 +420,15 @@ class Fleet:
                                           priced, cost)
         return self._spool_to(placement, obj)
 
-    def _spool_to(self, placement: Placement, obj: Dict) -> str:
+    def _next_name(self) -> str:
         with self._lock:
             self._seq += 1
-            name = f"r{self._seq:06d}.json"
+            return f"r{self._seq:06d}.json"
+
+    def _write_copy(self, placement: Placement, name: str,
+                    obj: Dict) -> _Copy:
+        """Spool one copy of `obj` into its placed host's ``in/``
+        (atomic tmp+rename) and return the copy record."""
         host_dir = self.host_dirs[placement.host]
         out_name = nonce_result_name(name, obj.get("nonce"))
         out_path = os.path.join(host_dir, "out", out_name)
@@ -313,9 +436,20 @@ class Fleet:
         with open(tmp, "w") as fh:
             json.dump(obj, fh)
         os.replace(tmp, os.path.join(host_dir, "in", name))
+        return _Copy(placement, name, out_path)
+
+    def _spool_to(self, placement: Placement, obj: Dict) -> str:
+        name = self._next_name()
+        now = time.time()
+        lease = Lease(name=name, host=placement.host, claimed_at=now,
+                      ttl_s=self.fault.lease_ttl_s,
+                      hosts=[placement.host], nonce=obj.get("nonce"))
+        # lease BEFORE the spool write: the supervisor must never see a
+        # claimed request it has no lease record for
+        self._leases.write(lease)
+        copy = self._write_copy(placement, name, obj)
         with self._lock:
-            self._outstanding[name] = _Outstanding(placement, out_path,
-                                                   out_name)
+            self._outstanding[name] = _Outstanding(copy, obj, now, lease)
         return name
 
     # ------------------------------------------------------------ collecting
@@ -324,29 +458,41 @@ class Fleet:
         (already swept, or on disk) — what a non-blocking front sweep
         collects."""
         with self._lock:
-            entries = list(self._outstanding.items())
+            entries = [(n, [c.out_path for c in e.copies])
+                       for n, e in self._outstanding.items()]
             banked = list(self._collected)
-        return banked + [n for n, e in entries
-                         if os.path.exists(e.out_path)]
+        return banked + [n for n, paths in entries
+                         if any(os.path.exists(p) for p in paths)]
 
     def _sweep(self) -> int:
         """Move every finished request's row off disk into the
-        collected bank and release its router accounting. Returns how
-        many were swept. Idempotent and safe to call from the submit
-        loop — a banked row waits for its named ``collect``."""
+        collected bank and release its router accounting — the FIRST
+        copy (original, requeue or mirror) whose row landed wins; the
+        others' late identical writes are ignored. Returns how many
+        were swept. Idempotent and safe to call from the submit loop,
+        the collect loop and the supervisor tick — a banked row waits
+        for its named ``collect``."""
         with self._lock:
-            entries = list(self._outstanding.items())
+            entries = [(n, e, list(e.copies))
+                       for n, e in self._outstanding.items()]
         swept = 0
-        for name, entry in entries:
-            if not os.path.exists(entry.out_path):
+        for name, entry, copies in entries:
+            row = None
+            for copy in copies:
+                if not os.path.exists(copy.out_path):
+                    continue
+                with open(copy.out_path) as fh:
+                    row = json.load(fh)
+                break                     # first-write-wins
+            if row is None:
                 continue
-            with open(entry.out_path) as fh:
-                row = json.load(fh)
             with self._lock:
                 if self._outstanding.pop(name, None) is None:
                     continue              # raced another sweeper
                 self._collected[name] = row
-            self.router.release(entry.placement)
+                copies = list(entry.copies)
+            _release_placements(self.router, copies)
+            self._leases.remove(name)
             swept += 1
         return swept
 
@@ -381,6 +527,322 @@ class Fleet:
                     f"{timeout}s")
             time.sleep(_POLL_SECS)
 
+    # -------------------------------------------------------- fault tolerance
+    def _fault_tick(self) -> None:
+        """One supervisor pass (fault.Supervisor drives this every
+        ``poll_interval_s``): sweep finished results, watch the host
+        processes, sweep/renew leases, hedge the hot tail."""
+        now = time.time()
+        self._sweep()
+        self._supervise_hosts(now)
+        self._sweep_leases(now)
+        if self.fault.hedge:
+            self._hedge(now)
+
+    def _set_host_state(self, i: int, state: str) -> None:
+        with self._lock:
+            self._host_state[i] = state
+        self.router.set_host_state(i, state)
+
+    def _supervise_hosts(self, now: float) -> None:
+        for i in range(len(self.host_dirs)):
+            with self._lock:
+                state = self._host_state[i]
+                proc = self._procs[i]
+                restart_at = self._restart_at[i]
+                spawned_at = self._spawned_at[i]
+            if state in (fault.QUARANTINED, fault.STOPPED):
+                continue
+            rc = proc.poll() if proc is not None else None
+            if proc is not None and rc is not None:
+                # death is certain (exit code in hand): requeue its
+                # leases NOW — waiting out the TTL buys nothing
+                verdict = self._trackers[i].record_death(now)
+                with self._lock:
+                    self._procs[i] = None
+                if verdict == fault.QUARANTINED:
+                    self._set_host_state(i, fault.QUARANTINED)
+                    with self._lock:
+                        self._fault_stats["quarantined"] += 1
+                else:
+                    self._set_host_state(i, fault.RESTARTING)
+                    with self._lock:
+                        self._restart_at[i] = \
+                            now + self._trackers[i].backoff_s()
+                continue
+            if state == fault.RESTARTING:
+                if proc is None and restart_at is not None \
+                        and now >= restart_at:
+                    self._spawn_host(i)
+                    with self._lock:
+                        self._fault_stats["restarts"] += 1
+                        self._restart_counts[i] += 1
+                        self._restart_at[i] = None
+                elif proc is not None:
+                    # booted when the spool is back: placements resume;
+                    # affinity is re-EARNED through hits, never reset
+                    if os.path.isdir(os.path.join(self.host_dirs[i],
+                                                  "in")):
+                        self._set_host_state(i, fault.SERVING)
+                continue
+            # alive host: the spool heartbeat (metrics.json mtime) is
+            # the liveness signal — a live process that stopped
+            # refreshing it is wedged or stopped (SIGSTOP, hard IO
+            # stall) and must not take new placements
+            age = fault.heartbeat_age_s(
+                os.path.join(self.host_dirs[i], "metrics.json"), now)
+            if age is None:
+                age = now - spawned_at
+            booting = now - spawned_at <= self._hb_timeout
+            if state == fault.SERVING and age > self._hb_timeout \
+                    and not booting:
+                self._set_host_state(i, fault.STALLED)
+            elif state == fault.STALLED and age <= self._hb_timeout:
+                self._set_host_state(i, fault.SERVING)
+
+    @staticmethod
+    def _copy_on(entry: _Outstanding, host: int) -> _Copy:
+        """The entry's newest copy spooled AT `host` (the lease host's
+        own spool file — requeues and mirrors live elsewhere)."""
+        for copy in reversed(entry.copies):
+            if copy.placement.host == host:
+                return copy
+        return entry.copies[-1]
+
+    def _sweep_leases(self, now: float) -> None:
+        """Renew the leases of requests sitting on healthy hosts;
+        requeue the ones whose host died (immediately) or went
+        stale/stalled past the lease TTL. A lease predating its host's
+        CURRENT incarnation is stranded even though the host looks
+        healthy: a claim taken by the dead process sits in its old
+        ``work/`` dir, which a restarted host never re-adopts — those
+        requeue too (or re-spool to the restarted host when no other
+        host can take them)."""
+        with self._lock:
+            entries = list(self._outstanding.items())
+        for name, entry in entries:
+            lease = entry.lease
+            with self._lock:
+                state = self._host_state[lease.host]
+                dead = self._procs[lease.host] is None
+                spawned_at = self._spawned_at[lease.host]
+            healthy = state == fault.SERVING and not dead
+            if healthy and lease.claimed_at < spawned_at:
+                # pre-restart lease: if the spool file still sits in
+                # in/, the new incarnation will claim it normally —
+                # restamp and move on; otherwise the old process died
+                # holding the claim and the request must move
+                copy = self._copy_on(entry, lease.host)
+                in_path = os.path.join(self.host_dirs[lease.host],
+                                       "in", copy.name)
+                if os.path.exists(in_path):
+                    self._leases.renew(lease, now)
+                elif not self._requeue(name, entry, now):
+                    self._respool(name, entry, now)
+                continue
+            if healthy:
+                if now - lease.claimed_at > lease.ttl_s / 2.0:
+                    self._leases.renew(lease, now)
+                continue
+            if dead or state in (fault.RESTARTING, fault.QUARANTINED) \
+                    or lease.expired(now):
+                self._requeue(name, entry, now)
+
+    def _requeue(self, name: str, entry: _Outstanding,
+                 now: float) -> bool:
+        """Move one stranded request to a different healthy host,
+        excluding every host it already failed on. Capped at
+        ``max_requeues`` attempts — a request that kills every host it
+        touches becomes an in-band failure row, never a fleet-wide
+        crash loop. Returns True when the request was handled (moved
+        or abandoned), False when no excluded-compliant host had
+        headroom this tick."""
+        lease = entry.lease
+        if lease.attempts > self.fault.max_requeues:
+            row = {"ok": False, "error":
+                   f"request abandoned after {lease.attempts} attempts "
+                   f"across hosts {lease.hosts} (max_requeues="
+                   f"{self.fault.max_requeues})"}
+            if lease.nonce:
+                row["nonce"] = lease.nonce
+            with self._lock:
+                if self._outstanding.pop(name, None) is None:
+                    return True
+                self._collected[name] = row
+                self._fault_stats["abandoned"] += 1
+                copies = list(entry.copies)
+            _release_placements(self.router, copies)
+            self._leases.remove(name)
+            return True
+        req, priced, cost = self.price(entry.obj)
+        placement = self.router.place(affinity_key(req), priced, cost,
+                                      count_held=False,
+                                      exclude=lease.hosts)
+        if placement is None:
+            return False           # no healthy headroom yet: next tick
+        stranded = self._copy_on(entry, lease.host)
+        new_name = self._next_name()
+        copy = self._write_copy(placement, new_name, entry.obj)
+        with self._lock:
+            # append-under-membership: a sweep that popped the entry
+            # already released every copy it could SEE, so a late copy
+            # must release itself instead of joining the entry
+            landed = name not in self._outstanding
+            if not landed:
+                entry.copies.append(copy)
+                self._fault_stats["requeues"] += 1
+        if landed:
+            self.router.release(placement)
+            try:
+                os.remove(os.path.join(
+                    self.host_dirs[placement.host], "in", new_name))
+            except OSError:
+                pass
+            return True
+        # best-effort unspool of the stranded copy: if the old host's
+        # in/ file is still unclaimed, removing it stops a restarted
+        # host from re-running work that now lives elsewhere (a claimed
+        # copy is beyond reach — its late result is a harmless
+        # duplicate write)
+        try:
+            os.remove(os.path.join(self.host_dirs[lease.host], "in",
+                                   stranded.name))
+        except OSError:
+            pass
+        lease.host = placement.host
+        lease.claimed_at = now
+        lease.attempts += 1
+        lease.hosts.append(placement.host)
+        # the hedge's pending-age clock restarts with the new host: an
+        # inherited age would make a fresh requeue target look hot
+        entry.submitted_at = now
+        self._leases.write(lease)
+        return True
+
+    def _respool(self, name: str, entry: _Outstanding,
+                 now: float) -> None:
+        """Re-spool a stranded request into its (restarted) lease
+        host's OWN in/ — the fallback when the requeue exclusion
+        leaves no other host: the new incarnation never saw the claim
+        the old one died holding, and re-execution is safe, so handing
+        it the request again beats never serving it. The copy rides
+        the ORIGINAL placement's budget charge (same host, same
+        request — not new load)."""
+        lease = entry.lease
+        if lease.attempts > self.fault.max_requeues:
+            return                 # the requeue cap will abandon it
+        prior = self._copy_on(entry, lease.host)
+        new_name = self._next_name()
+        copy = self._write_copy(prior.placement, new_name, entry.obj)
+        with self._lock:
+            landed = name not in self._outstanding
+            if not landed:
+                entry.copies.append(copy)
+                self._fault_stats["respools"] += 1
+        if landed:                 # raced a sweep: just unspool it
+            try:
+                os.remove(os.path.join(
+                    self.host_dirs[lease.host], "in", new_name))
+            except OSError:
+                pass
+            return
+        lease.claimed_at = now
+        lease.attempts += 1
+        entry.submitted_at = now
+        self._leases.write(lease)
+
+    def _rolled_p99(self) -> Dict[int, Tuple[float, int]]:
+        """Each host's rolled-up (queue-wait p99 ms, served count)
+        from its own metrics snapshot — the hedging signal's served
+        half. The count gates hedging: a host that has never finished
+        a request has no measured tail to run hot — it is warming up,
+        not straggling."""
+        out: Dict[int, Tuple[float, int]] = {}
+        for i, host_dir in enumerate(self.host_dirs):
+            try:
+                with open(os.path.join(host_dir, "metrics.json")) as fh:
+                    snap = json.load(fh)
+                hist = (snap.get("hists") or {}).get("queue_wait_ms",
+                                                     {})
+                out[i] = (float(hist.get("p99", 0.0)),
+                          int(hist.get("count", 0)))
+            except (OSError, ValueError):
+                out[i] = (0.0, 0)
+        return out
+
+    def _hedge(self, now: float) -> None:
+        """Hedged tail dispatch: when one host's queue-wait tail runs
+        past ``hedge_multiple``x the fleet median, mirror its queued
+        requests onto the least-loaded compatible host and let the
+        first result win (module docstring; fault.hot_hosts is the
+        decision)."""
+        with self._lock:
+            healthy = [i for i, s in enumerate(self._host_state)
+                       if s == fault.SERVING]
+            entries = list(self._outstanding.items())
+        pending_age: Dict[int, float] = {}
+        for _name, entry in entries:
+            if entry.mirrored:
+                continue
+            age_ms = (now - entry.submitted_at) * 1000.0
+            host = entry.lease.host
+            pending_age[host] = max(pending_age.get(host, 0.0), age_ms)
+        rolled = self._rolled_p99()
+        hot = fault.hot_hosts({h: p99 for h, (p99, _n) in rolled.items()},
+                              pending_age, self.fault, healthy)
+        # only a host with a MEASURED tail (>=1 served request) can be
+        # "hot": a host still compiling its first request is cold, and
+        # mirroring its queue would just double the warmup bill
+        hot = [h for h in hot if rolled.get(h, (0.0, 0))[1] > 0]
+        if not hot:
+            return
+        for name, entry in entries:
+            if entry.mirrored or entry.lease.host not in hot:
+                continue
+            req, priced, cost = self.price(entry.obj)
+            placement = self.router.place_mirror(
+                affinity_key(req), priced, cost,
+                exclude=entry.lease.hosts)
+            if placement is None:
+                continue           # no headroom: hedging never holds
+            mirror_name = self._next_name()
+            copy = self._write_copy(placement, mirror_name, entry.obj)
+            with self._lock:
+                landed = name not in self._outstanding
+                if not landed:
+                    entry.copies.append(copy)
+                    entry.mirrored = True
+            if landed:             # raced a sweep: release the mirror
+                self.router.release(placement)
+                try:
+                    os.remove(os.path.join(
+                        self.host_dirs[placement.host], "in",
+                        mirror_name))
+                except OSError:
+                    pass
+                continue
+            entry.lease.hosts.append(placement.host)
+            self._leases.write(entry.lease)
+
+    def fault_snapshot(self) -> Dict:
+        """The supervision view the merged fleet metrics carry: per-
+        host state + restart counts, the requeue/hedge counters, and
+        any errors the supervisor loop survived."""
+        with self._lock:
+            states = list(self._host_state)
+            stats = dict(self._fault_stats)
+            restarts = list(self._restart_counts)
+        return {
+            "hosts": [{"host": i, "state": s, "restarts": restarts[i],
+                       "recent_deaths":
+                           self._trackers[i].recent_deaths}
+                      for i, s in enumerate(states)],
+            "stats": stats,
+            "leases_outstanding": len(self._leases.names()),
+            "supervisor_errors": (self._supervisor.errors()
+                                  if self._supervisor else []),
+        }
+
     # --------------------------------------------------------------- metrics
     def merged_metrics(self) -> Dict:
         """The fleet snapshot: per-host metrics.json files folded into
@@ -399,6 +861,7 @@ class Fleet:
                 continue            # host not up yet / mid-rename
         merged = merge_snapshots(snaps)
         merged["router"] = self.router.snapshot()
+        merged["supervision"] = self.fault_snapshot()
         return merged
 
     def write_metrics(self, path: Optional[str] = None) -> str:
@@ -410,32 +873,61 @@ class Fleet:
         return path
 
     # ------------------------------------------------------------- stopping
-    def stop(self, timeout: float = 120.0) -> List[int]:
-        """Graceful fleet shutdown: SIGTERM every host (their handlers
-        drain: finish claimed work, final per-host metrics.json, exit
-        0), join, write the final merged metrics. Returns the per-host
-        exit codes; a host that needed SIGKILL reports rc < 0."""
-        for proc in self._procs:
-            if proc.poll() is None:
+    def stop(self, timeout: float = 120.0) -> List[Optional[int]]:
+        """Graceful fleet shutdown: stop the supervisor (no restarts
+        racing the teardown), SIGCONT + SIGTERM every live host (their
+        handlers drain: finish claimed work, final per-host
+        metrics.json, exit 0 — the SIGCONT first so a stopped/stalled
+        host can even SEE the signal), join, write the final merged
+        metrics. Returns the per-host exit codes; a host that needed
+        SIGKILL reports rc < 0, a host already dead/quarantined reports
+        None."""
+        if self._supervisor is not None:
+            self._supervisor.stop()
+            self._supervisor = None
+        with self._lock:
+            self._host_state = [fault.STOPPED] * len(self.host_dirs)
+            procs = list(self._procs)
+        for proc in procs:
+            if proc is not None and proc.poll() is None:
                 try:
+                    proc.send_signal(signal.SIGCONT)
                     proc.send_signal(signal.SIGTERM)
                 except OSError:
                     pass
-        codes: List[int] = []
+        codes: List[Optional[int]] = []
         deadline = time.perf_counter() + timeout
-        for proc in self._procs:
+        for proc in procs:
+            if proc is None:
+                codes.append(None)
+                continue
             remaining = max(deadline - time.perf_counter(), 0.1)
             try:
                 codes.append(proc.wait(timeout=remaining))
             except subprocess.TimeoutExpired:
                 proc.kill()
                 codes.append(proc.wait())
-        self._procs = []
+        with self._lock:
+            self._procs = [None] * len(self.host_dirs)
         try:
             self.write_metrics()
         except OSError:
             pass
         return codes
+
+
+def _release_placements(router: AffinityRouter,
+                        copies: Sequence[_Copy]) -> None:
+    """Release every DISTINCT placement behind an entry's copies — a
+    re-spooled copy shares its predecessor's placement (same host,
+    same charge), so releasing per copy would double-credit the
+    budget vector."""
+    seen: set = set()
+    for copy in copies:
+        if id(copy.placement) in seen:
+            continue
+        seen.add(id(copy.placement))
+        router.release(copy.placement)
 
 
 def _tail(path: str, nbytes: int = 800) -> str:
@@ -456,7 +948,8 @@ def fleet_main(argv) -> int:
     fleet-level spool session (module docstring)."""
     import argparse
 
-    from avenir_tpu.server.spool import _claim, install_drain_handlers
+    from avenir_tpu.server.spool import (_claim, install_drain_handlers,
+                                         load_claimed)
 
     ap = argparse.ArgumentParser(prog="avenir_tpu fleet")
     ap.add_argument("--root", required=True,
@@ -474,12 +967,31 @@ def fleet_main(argv) -> int:
                     help="autotune profile store consulted for "
                          "fold-cost-weighted placement")
     ap.add_argument("--metrics-interval", type=float, default=1.0)
+    ap.add_argument("--no-supervise", action="store_true",
+                    help="disable host supervision/leases/hedging "
+                         "(PR-12 behavior: a dead host is fatal)")
+    ap.add_argument("--lease-ttl", type=float,
+                    default=FaultPolicy.lease_ttl_s,
+                    help="request lease TTL in seconds before an "
+                         "unhealthy host's claims requeue (default "
+                         f"{FaultPolicy.lease_ttl_s})")
+    ap.add_argument("--hedge-multiple", type=float,
+                    default=FaultPolicy.hedge_multiple,
+                    help="mirror a host's queued requests when its "
+                         "queue-wait p99 exceeds this multiple of the "
+                         "fleet median (default "
+                         f"{FaultPolicy.hedge_multiple}; <=0 disables)")
     args = ap.parse_args(argv)
 
     in_dir, work_dir, out_dir = spool_dirs(args.root)
+    policy = FaultPolicy(
+        supervise=not args.no_supervise, lease_ttl_s=args.lease_ttl,
+        hedge=args.hedge_multiple > 0,
+        hedge_multiple=max(args.hedge_multiple, 0.1))
     fleet = Fleet(args.root, hosts=args.hosts, budget_mb=args.budget_mb,
                   workers=args.workers, profile_dir=args.profile_dir,
-                  metrics_interval_s=min(args.metrics_interval, 1.0))
+                  metrics_interval_s=min(args.metrics_interval, 1.0),
+                  fault_policy=policy)
     stop_event = threading.Event()
     should_stop = install_drain_handlers(stop_event)
     failures = 0
@@ -524,10 +1036,11 @@ def fleet_main(argv) -> int:
                 for name, work_path in _claim(in_dir, work_dir):
                     obj = None
                     try:
-                        with open(work_path) as fh:
-                            obj = json.load(fh)
-                        # validate before routing so a bad request is
-                        # reported in-band, not a front crash
+                        # torn bytes dead-letter (never re-claimed);
+                        # validation runs before routing so a bad
+                        # request is reported in-band, not a front
+                        # crash
+                        obj = load_claimed(args.root, name, work_path)
                         request_from_json(obj)
                         backlog.append((name, obj, work_path, True))
                     except Exception as exc:  # noqa: BLE001 — in-band

@@ -19,7 +19,10 @@ Surface:
   (``JobServer.metrics_snapshot``) plus an ``edge`` section.
 - ``GET /healthz`` — 200 ``{"status": "serving"}`` /
   503 ``{"status": "draining"}``: the drain state a fleet router or
-  load balancer health-checks.
+  load balancer health-checks. Supervision can overlay
+  ``"quarantined"`` / ``"restarting"`` via :meth:`set_health_state`
+  (503 as well) so operators and a fleet front probing the edge see
+  the same state the supervisor acted on.
 
 **Backpressure is wired to the admission model, at the edge.** The
 single server already refuses to RUN over budget (the priced-bytes
@@ -76,7 +79,11 @@ class EdgePolicy:
     shed_mode: str = "reject"
     budget_bytes: Optional[int] = None
     max_tenant_depth: int = 64
+    #: the 429 Retry-After hint, jittered ±`retry_jitter` per response
+    #: so a synchronized cohort of shed clients does not retry in
+    #: lockstep and re-stampede the edge at one instant
     retry_after_s: float = 1.0
+    retry_jitter: float = 0.2
     hold_timeout_s: float = 30.0
     wait_timeout_s: float = 600.0
     #: a served-but-never-fetched result is dropped after this long —
@@ -136,6 +143,7 @@ class NetListener:
         self._outstanding: Dict[str, _EdgeEntry] = {}
         self._outstanding_priced = 0
         self._draining = False
+        self._health_state: Optional[str] = None
         self._stop = threading.Event()
         self._threads: list = []
         self._stats: Dict[str, int] = {
@@ -175,6 +183,39 @@ class NetListener:
             self._draining = True
             self._capacity.notify_all()
         self.server.begin_drain()
+
+    def set_health_state(self, state: Optional[str]) -> None:
+        """Overlay a supervision state on ``/healthz`` —
+        ``"quarantined"`` / ``"restarting"`` (503, new submissions
+        refused with the state in-band) or None to return to normal
+        serving. This is how a supervisor makes its verdict visible to
+        the operators and fleet fronts health-checking the edge."""
+        if state is not None and state not in ("quarantined",
+                                               "restarting"):
+            raise ValueError(
+                f"unknown health state {state!r} (expected "
+                f"'quarantined', 'restarting' or None)")
+        with self._lock:
+            self._health_state = state
+            self._capacity.notify_all()
+
+    def health_state(self) -> str:
+        """The /healthz status string: draining wins (an operator
+        decision), then the supervision overlay, then serving."""
+        with self._lock:
+            if self._draining:
+                return "draining"
+            return self._health_state or "serving"
+
+    def retry_after_s(self) -> float:
+        """One 429's Retry-After hint: the policy value jittered
+        ±``retry_jitter`` so shed clients spread their retries instead
+        of re-stampeding in lockstep."""
+        import random
+
+        jitter = max(min(self.policy.retry_jitter, 1.0), 0.0)
+        return self.policy.retry_after_s * random.uniform(1.0 - jitter,
+                                                          1.0 + jitter)
 
     def stop(self) -> None:
         """Stop accepting and join the accept/reaper threads. Does NOT
@@ -228,6 +269,10 @@ class NetListener:
             while True:
                 if self._draining:
                     return False, "draining"
+                if self._health_state is not None:
+                    # supervision overlay: a quarantined/restarting
+                    # edge refuses new work in-band, like draining
+                    return False, self._health_state
                 reason = self._over_limit_locked(tenant, priced)
                 if reason is None:
                     self._outstanding_priced += priced
@@ -306,6 +351,9 @@ class NetListener:
                 "max_tenant_depth": int(self.policy.max_tenant_depth),
                 "shed_mode": self.policy.shed_mode,
                 "draining": self._draining,
+                "health_state": (self._health_state
+                                 if not self._draining else "draining")
+                or "serving",
             }
 
     @property
@@ -371,13 +419,14 @@ class _Handler(BaseHTTPRequestHandler):
             return
         accepted, reason = listener.try_accept(req.tenant, priced)
         if not accepted:
-            if reason == "draining":
-                self._reply(503, {"ok": False, "status": "draining"})
+            if reason in ("draining", "quarantined", "restarting"):
+                self._reply(503, {"ok": False, "status": reason})
                 return
-            retry = max(int(math.ceil(listener.policy.retry_after_s)), 1)
+            retry_s = listener.retry_after_s()
             self._reply(429, {"ok": False, "error": reason,
-                              "retry_after_s": retry},
-                        headers={"Retry-After": str(retry)})
+                              "retry_after_s": round(retry_s, 3)},
+                        headers={"Retry-After":
+                                 str(max(int(math.ceil(retry_s)), 1))})
             return
         try:
             ticket = listener.server.submit(req)
@@ -408,9 +457,9 @@ class _Handler(BaseHTTPRequestHandler):
         listener: NetListener = self.server.listener
         path = urlsplit(self.path).path
         if path == "/healthz":
-            draining = listener.draining
-            self._reply(503 if draining else 200,
-                        {"status": "draining" if draining else "serving",
+            status = listener.health_state()
+            self._reply(200 if status == "serving" else 503,
+                        {"status": status,
                          "queued": listener.server.queue_depth(),
                          "edge": listener.edge_stats()})
             return

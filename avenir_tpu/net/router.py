@@ -24,6 +24,18 @@ paths component ``server.compat_key`` batches on) and routes:
    caller holds (fleet front) or sheds (listener edge) the request;
    the budget vector is NEVER breached by placement.
 
+Fault awareness (avenir-fault, :mod:`avenir_tpu.net.fault`): each host
+carries a supervision state (``serving`` / ``restarting`` / ``stalled``
+/ ``quarantined``); only ``serving`` hosts take new placements. A
+sticky mapping whose host left ``serving`` is DROPPED on the next
+placement for that corpus (counted as a ``failover``) and the corpus
+re-places by the normal least-loaded rule — so when the host recovers
+it re-earns affinity through fresh hits, never through a map reset.
+``place_mirror`` is the hedged-dispatch placement: least-loaded serving
+host outside an exclusion set, charged against the budget vector like
+any placement but never touching the sticky map (the corpus still
+belongs to its slow warm host; the mirror is insurance, not a move).
+
 "Least loaded" orders hosts by priced-bytes utilisation
 (``assigned/budget``), tie-broken by pending fold cost — the autotune
 profile store's measured per-chunk fold means (``tune.placement_cost_ms``)
@@ -57,6 +69,13 @@ class HostLoad:
     pending_cost_ms: float = 0.0
     peak_assigned_bytes: int = 0
     placed_total: int = 0
+    #: supervision state (avenir_tpu.net.fault); only "serving" hosts
+    #: take new placements
+    state: str = "serving"
+
+    @property
+    def available(self) -> bool:
+        return self.state == "serving"
 
     def utilisation(self) -> float:
         return self.assigned_bytes / self.budget_bytes \
@@ -74,7 +93,7 @@ class Placement:
     host: int
     priced_bytes: int
     cost_ms: float = 0.0
-    kind: str = "miss"               # "hit" | "spill" | "miss"
+    kind: str = "miss"   # "hit" | "spill" | "miss" | "pinned" | "hedge"
     key: Hashable = field(default=None, repr=False)
 
 
@@ -90,13 +109,14 @@ class AffinityRouter:
         self._lock = threading.Lock()
         self.stats: Dict[str, int] = {
             "placed": 0, "affinity_hits": 0, "affinity_misses": 0,
-            "spills": 0, "held": 0,
+            "spills": 0, "held": 0, "failovers": 0, "hedges": 0,
         }
 
     # ------------------------------------------------------------ placing
     def place(self, key: Hashable, priced_bytes: int,
               cost_ms: Optional[float] = None,
-              count_held: bool = True) -> Optional[Placement]:
+              count_held: bool = True,
+              exclude: Sequence[int] = ()) -> Optional[Placement]:
         """Place one request of `priced_bytes` with affinity `key`;
         None when every host is over its vector entry (caller holds or
         sheds). Raises :class:`RouterError` when the request exceeds
@@ -105,9 +125,15 @@ class AffinityRouter:
         ``count_held=False`` marks a RETRY of an arrival already
         counted held — pollers re-placing every 0.1s must not inflate
         the held stat 10x per second held (the same transition-not-
-        re-check rule the server's admission_holds counter follows)."""
+        re-check rule the server's admission_holds counter follows).
+
+        ``exclude`` removes hosts from consideration for THIS placement
+        (the requeue path excludes every host a request already failed
+        on); an excluded sticky host keeps its mapping — exclusion is
+        per-request, failover is per-host-state."""
         priced = max(int(priced_bytes), 0)
         cost = float(cost_ms) if cost_ms else 0.0
+        banned = set(exclude)
         with self._lock:
             if not any(priced <= h.budget_bytes for h in self.hosts):
                 raise RouterError(
@@ -115,11 +141,20 @@ class AffinityRouter:
                     f"host budget "
                     f"{[h.budget_bytes for h in self.hosts]}")
             sticky = self._affinity.get(key)
-            if sticky is not None and self.hosts[sticky].fits(priced):
+            if sticky is not None and not self.hosts[sticky].available:
+                # the warm host is down/quarantined: drop the mapping —
+                # the corpus re-places least-loaded and the recovered
+                # host re-earns affinity through hits, never a map reset
+                self._affinity.pop(key, None)
+                self.stats["failovers"] += 1
+                sticky = None
+            if sticky is not None and sticky not in banned \
+                    and self.hosts[sticky].fits(priced):
                 self.stats["affinity_hits"] += 1
                 return self._assign(sticky, priced, cost, "hit", key)
             candidates = [i for i, h in enumerate(self.hosts)
-                          if h.fits(priced)]
+                          if h.available and h.fits(priced)
+                          and i not in banned]
             if not candidates:
                 if count_held:
                     self.stats["held"] += 1
@@ -132,8 +167,9 @@ class AffinityRouter:
                 self._affinity[key] = best
                 self.stats["affinity_misses"] += 1
                 return self._assign(best, priced, cost, "miss", key)
-            # sticky host over budget: spill WITHOUT moving the sticky
-            # mapping — the corpus returns to its warm host later
+            # sticky host over budget (or excluded for this request):
+            # spill WITHOUT moving the sticky mapping — the corpus
+            # returns to its warm host later
             self.stats["spills"] += 1
             return self._assign(best, priced, cost, "spill", key)
 
@@ -160,6 +196,46 @@ class AffinityRouter:
                                 float(cost_ms) if cost_ms else 0.0,
                                 "pinned", key)
 
+    def place_mirror(self, key: Hashable, priced_bytes: int,
+                     cost_ms: Optional[float] = None,
+                     exclude: Sequence[int] = ()
+                     ) -> Optional[Placement]:
+        """The hedged-dispatch placement: least-loaded SERVING host
+        outside `exclude` (the slow host and any host already carrying
+        a copy) with budget headroom, charged against the vector like
+        any placement, never touching the sticky map. None when no
+        compatible host has headroom — a hedge is opportunistic
+        insurance, never worth holding for."""
+        priced = max(int(priced_bytes), 0)
+        cost = float(cost_ms) if cost_ms else 0.0
+        banned = set(exclude)
+        with self._lock:
+            candidates = [i for i, h in enumerate(self.hosts)
+                          if h.available and h.fits(priced)
+                          and i not in banned]
+            if not candidates:
+                return None
+            best = min(candidates, key=lambda i: (
+                self.hosts[i].utilisation(),
+                self.hosts[i].pending_cost_ms, i))
+            self.stats["hedges"] += 1
+            return self._assign(best, priced, cost, "hedge", key)
+
+    def set_host_state(self, host: int, state: str) -> None:
+        """Record host `host`'s supervision state (``serving`` /
+        ``restarting`` / ``stalled`` / ``quarantined``). Any state but
+        ``serving`` removes the host from NEW placements; its sticky
+        mappings fail over lazily on the next placement that needs
+        them. Existing assignments keep their accounting until
+        released — a dead host's priced bytes come back when its
+        requests complete elsewhere."""
+        with self._lock:
+            self.hosts[host].state = str(state)
+
+    def host_state(self, host: int) -> str:
+        with self._lock:
+            return self.hosts[host].state
+
     def release(self, placement: Placement) -> None:
         """The placed request finished (or was abandoned): return its
         budget slice and pending cost to the host."""
@@ -181,6 +257,7 @@ class AffinityRouter:
                 "affinity_keys": len(self._affinity),
                 "hosts": [{
                     "host": i,
+                    "state": h.state,
                     "budget_bytes": h.budget_bytes,
                     "assigned_bytes": h.assigned_bytes,
                     "assigned_requests": h.assigned_requests,

@@ -15,6 +15,10 @@ Two hermetic transports, both JSON request objects with the
   ``<spool>/work/``, serves it, and writes the result to
   ``<spool>/out/<name>``. ``--once`` processes what is spooled, drains
   and exits; without it the loop polls until the process is signalled.
+  A claimed file whose bytes cannot parse as JSON is moved to
+  ``<spool>/dead/`` with a ``.reason`` file (:func:`dead_letter`) —
+  never re-claimable, so a torn request cannot crash-loop a restarted
+  host — while the in-band failure row still goes out.
 
 Result namespacing: a request may carry a client ``nonce`` token; its
 result then lands at ``<spool>/out/<nonce>.<name>`` instead of
@@ -150,6 +154,50 @@ def _claim(in_dir: str, work_dir: str) -> List[Tuple[str, str]]:
     return claimed
 
 
+def dead_letter(spool: str, name: str, work_path: str,
+                reason: str) -> str:
+    """Move a torn/unparseable claimed request to ``<spool>/dead/``
+    with a ``.reason`` file beside it, and return the dead path. A
+    request whose BYTES cannot even parse must leave the claim loop
+    for good — requeueing it (a restarted host re-adopting its work
+    dir, a fleet front retrying a lease) would fail identically
+    forever, a crash loop with no exit. The payload is preserved for
+    the operator (the reason file says why it landed there); the
+    in-band failure row still goes out so a polling client sees the
+    failure."""
+    dead_dir = os.path.join(spool, "dead")
+    os.makedirs(dead_dir, exist_ok=True)
+    dead_path = os.path.join(dead_dir, os.path.basename(work_path))
+    try:
+        os.replace(work_path, dead_path)
+    except OSError:
+        dead_path = work_path          # already gone: report in place
+    reason_path = os.path.join(dead_dir, f"{name}.reason")
+    tmp = f"{reason_path}.tmp"
+    try:
+        with open(tmp, "w") as fh:
+            fh.write(reason + "\n")
+        os.replace(tmp, reason_path)
+    except OSError:
+        pass
+    return dead_path
+
+
+def load_claimed(spool: str, name: str, work_path: str) -> Dict:
+    """Parse one claimed request file — THE torn-request policy, shared
+    by ``serve_spool`` and the fleet front's claim loop: bytes that
+    cannot parse are dead-lettered (moved out of the claim loop for
+    good) and the error re-raised for the caller's in-band failure
+    row."""
+    try:
+        with open(work_path) as fh:
+            return json.load(fh)
+    except (OSError, ValueError, UnicodeDecodeError) as exc:
+        dead_letter(spool, name, work_path,
+                    f"{type(exc).__name__}: {exc}")
+        raise
+
+
 def nonce_result_name(name: str, nonce: Optional[str]) -> str:
     """THE (client nonce, id) result-file recipe — the one place the
     ``<nonce>.<name>`` join lives, shared by the host-side spool
@@ -189,8 +237,7 @@ def serve_spool(server: JobServer, spool: str, once: bool = False,
             for name, work_path in _claim(in_dir, work_dir):
                 obj = None
                 try:
-                    with open(work_path) as fh:
-                        obj = json.load(fh)
+                    obj = load_claimed(spool, name, work_path)
                     req = request_from_json(obj)
                     pending.append((name, work_path, server.submit(req)))
                 except Exception as exc:  # noqa: BLE001 — reported in-band

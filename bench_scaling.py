@@ -1078,6 +1078,227 @@ def fleet_tripwire(rows: int = 10_000_000, floor: float = 1.5,
         shutil.rmtree(d, ignore_errors=True)
 
 
+def fleet_fault_tripwire(rows: int = 10_000_000,
+                         budget_mb: float = 3072.0) -> dict:
+    """Chaos harness for avenir-fault: the fleet's results contract
+    must hold under dying hosts. Two deterministic legs (no throughput
+    floor — re-execution is licensed by idempotency, so the claims are
+    about LOSS and CONFLICT, not speed):
+
+    **Chaos leg** — a 2-host fleet serves the churn trio over two
+    corpora (6 requests); once the first result lands (mid-batch), the
+    host holding the most unfinished leases is SIGKILLed. Every
+    submitted request must still yield a result row (zero lost: the
+    lease sweep requeues the stranded claims to the survivor), every
+    artifact must be byte-identical to its solo-runner twin (zero
+    conflicting: a late duplicate write is an identical write), at
+    least one requeue must have fired, every lease must be released,
+    and the killed host must restart and reintegrate (supervision
+    restarts >= 1, state back to serving).
+
+    **Hedging leg** — both hosts warmed (a measured served tail each),
+    then one host SIGSTOPped and a fresh corpus submitted: the router
+    places it on the stalled host, the front's pending-age signal
+    blows past the fleet median, the request is MIRRORED to the
+    healthy host (router hedges >= 1) and the first result wins — the
+    row collects while the original host is still stopped, with zero
+    requeues/restarts (a stall is not a death). After SIGCONT the late
+    original rewrites identical bytes, asserted against the twin.
+
+    Quick mode runs the 1M-row proxy; the full round the 10M one."""
+    import os
+    import shutil
+    import signal
+    import time
+
+    from avenir_tpu.data import churn_schema, generate_churn
+    from avenir_tpu.net.fault import FaultPolicy
+    from avenir_tpu.net.fleet import Fleet
+    from avenir_tpu.runner import run_job
+
+    d = tempfile.mkdtemp(prefix="avenir_fleet_fault_")
+    try:
+        corpora = []
+        for i, seed in enumerate((61, 67)):
+            path = os.path.join(d, f"churn_{i}.csv")
+            blob = generate_churn(100_000, seed=seed, as_csv=True)
+            with open(path, "w") as fh:
+                for _ in range(max(rows // 100_000, 1)):
+                    fh.write(blob)
+            corpora.append(path)
+        schema = os.path.join(d, "churn.json")
+        churn_schema().save(schema)
+        conf = lambda p: {f"{p}.feature.schema.file.path": schema}  # noqa: E731
+        mi_conf = {**conf("mut"), "mut.mutual.info.score.algorithms":
+                   "mutual.info.maximization"}
+        trio = [("bayesianDistr", "bad", conf("bad"), "nb"),
+                ("mutualInformation", "mut", mi_conf, "mi"),
+                ("fisherDiscriminant", "fid", conf("fid"), "fid")]
+        load = []
+        for ci, corpus in enumerate(corpora):
+            for job, _prefix, cf, short in trio:
+                tag = f"{short}_c{ci}"
+                load.append((tag, {
+                    "job": job, "conf": cf, "inputs": [corpus],
+                    "tenant": f"tenant_{short}",
+                    "output": os.path.join(d, "served", tag)}))
+        warm = os.path.join(d, "warm.csv")
+        with open(warm, "w") as fh:
+            fh.write(generate_churn(500, seed=71, as_csv=True))
+        n_cores = os.cpu_count() or 2
+        pin = [i % n_cores for i in range(2)]
+
+        # ---------------------------------------------------- chaos leg
+        chaos_policy = FaultPolicy(
+            poll_interval_s=0.1, lease_ttl_s=2.0,
+            restart_backoff_base_s=0.5, heartbeat_timeout_s=60.0,
+            hedge=False)
+        fleet = Fleet(os.path.join(d, "chaos"), hosts=2, workers=1,
+                      budget_mb=budget_mb, metrics_interval_s=0.5,
+                      pin_cores=pin, fault_policy=chaos_policy)
+        with fleet:
+            warm_names = [fleet.submit_to(h, {
+                "job": job, "conf": cf, "inputs": [warm],
+                "output": os.path.join(d, "chaos", f"w_{h}_{short}")})
+                for h in range(2) for job, _p, cf, short in trio]
+            fleet.collect(warm_names, timeout=600)
+            names = {tag: fleet.submit(obj) for tag, obj in load}
+            # mid-batch: wait for the FIRST result, then kill the host
+            # holding the most unfinished leases
+            deadline = time.perf_counter() + 3600
+            while not fleet.ready():
+                if time.perf_counter() > deadline:
+                    raise RuntimeError("no fleet result within 3600s")
+                time.sleep(0.05)
+            held: dict = {}
+            for lease_name in fleet._leases.names():
+                lease = fleet._leases.load(lease_name)
+                if lease is not None:
+                    held[lease.host] = held.get(lease.host, 0) + 1
+            victim = max(held, key=held.get)
+            victim_pid = fleet.host_pid(victim)
+            os.kill(victim_pid, signal.SIGKILL)
+            name_rows = fleet.collect(list(names.values()),
+                                      timeout=7200)
+            rows_by_tag = {tag: name_rows[n] for tag, n in names.items()}
+            bad = [t for t, r in rows_by_tag.items() if not r.get("ok")]
+            if bad:
+                raise RuntimeError(
+                    f"chaos leg lost/failed requests {bad}: "
+                    f"{rows_by_tag[bad[0]].get('error')}")
+            chaos_snap = fleet.fault_snapshot()
+            if chaos_snap["stats"]["requeues"] < 1:
+                raise RuntimeError(
+                    "chaos leg: SIGKILL stranded no lease — the "
+                    "requeue path never exercised")
+            if chaos_snap["leases_outstanding"] != 0:
+                raise RuntimeError(
+                    f"chaos leg leaked "
+                    f"{chaos_snap['leases_outstanding']} lease(s)")
+            t0 = time.perf_counter()
+            while True:
+                snap = fleet.fault_snapshot()
+                ok_restart = (snap["stats"]["restarts"] >= 1
+                              and snap["hosts"][victim]["state"]
+                              == "serving")
+                if ok_restart:
+                    break
+                if time.perf_counter() - t0 > 120:
+                    raise RuntimeError(
+                        f"killed host {victim} never reintegrated: "
+                        f"{snap}")
+                time.sleep(0.1)
+        # stop() drained any late duplicate claims: compare EVERY
+        # artifact (first-won rows and late identical rewrites alike)
+        # against the solo twin — zero conflicting results
+        for tag, obj in load:
+            twin = run_job(obj["job"], obj["conf"], obj["inputs"],
+                           os.path.join(d, "twin", tag))
+            served = rows_by_tag[tag]["outputs"]
+            if len(served) != len(twin.outputs):
+                raise RuntimeError(
+                    f"chaos leg {tag}: {len(served)} outputs vs twin's "
+                    f"{len(twin.outputs)}")
+            for pa, pb in zip(sorted(twin.outputs), sorted(served)):
+                with open(pa, "rb") as fa, open(pb, "rb") as fb:
+                    if fa.read() != fb.read():
+                        raise RuntimeError(
+                            f"chaos leg artifact of {tag} differs from "
+                            f"its solo twin ({pb} vs {pa}) — a "
+                            f"conflicting result")
+
+        # -------------------------------------------------- hedging leg
+        hedge_policy = FaultPolicy(
+            poll_interval_s=0.1, hedge_multiple=2.0,
+            hedge_floor_ms=500.0, lease_ttl_s=3600.0,
+            heartbeat_timeout_s=3600.0)
+        hedge_fleet = Fleet(os.path.join(d, "hedge"), hosts=2,
+                            workers=1, budget_mb=budget_mb,
+                            metrics_interval_s=0.5, pin_cores=pin,
+                            fault_policy=hedge_policy)
+        job, _prefix, cf, short = trio[0]
+        with hedge_fleet:
+            warm_names = [hedge_fleet.submit_to(h, {
+                "job": job, "conf": cf, "inputs": [warm],
+                "output": os.path.join(d, "hedge", f"w_{h}")})
+                for h in range(2)]
+            hedge_fleet.collect(warm_names, timeout=600)
+            # the hedge gate reads each host's SERVED tail from its
+            # heartbeat snapshot: let both catch up with the warmups
+            # before freezing one (a stopped host cannot refresh its
+            # own)
+            t0 = time.perf_counter()
+            while not all(n >= 1 for _p, n
+                          in hedge_fleet._rolled_p99().values()):
+                if time.perf_counter() - t0 > 60:
+                    raise RuntimeError(
+                        "host heartbeats never reflected the warmups")
+                time.sleep(0.1)
+            os.kill(hedge_fleet.host_pid(0), signal.SIGSTOP)
+            try:
+                # fresh corpus on an idle fleet -> host 0, which is
+                # stopped: only the mirror can serve it
+                hname = hedge_fleet.submit({
+                    "job": job, "conf": cf, "inputs": [corpora[0]],
+                    "tenant": "hedge",
+                    "output": os.path.join(d, "served", "hedged")})
+                hrow = hedge_fleet.collect([hname],
+                                           timeout=7200)[hname]
+            finally:
+                os.kill(hedge_fleet.host_pid(0), signal.SIGCONT)
+            if not hrow.get("ok"):
+                raise RuntimeError(
+                    f"hedging leg request failed: {hrow.get('error')}")
+            hedges = hedge_fleet.router.stats["hedges"]
+            hsnap = hedge_fleet.fault_snapshot()
+            if hedges < 1:
+                raise RuntimeError(
+                    "hedging leg: stalled host never triggered a "
+                    "mirror")
+            if hsnap["stats"]["requeues"] or hsnap["stats"]["restarts"]:
+                raise RuntimeError(
+                    f"hedging leg: a stall must hedge, not "
+                    f"requeue/restart ({hsnap['stats']})")
+        twin = run_job(job, cf, [corpora[0]],
+                       os.path.join(d, "twin", "hedged"))
+        served = hrow["outputs"]
+        for pa, pb in zip(sorted(twin.outputs), sorted(served)):
+            with open(pa, "rb") as fa, open(pb, "rb") as fb:
+                if fa.read() != fb.read():
+                    raise RuntimeError(
+                        f"hedged artifact differs from its solo twin "
+                        f"({pb} vs {pa})")
+        return {"rows": rows, "requests": len(load),
+                "chaos_requeues": int(chaos_snap["stats"]["requeues"]),
+                "chaos_restarts": int(chaos_snap["stats"]["restarts"]),
+                "victim_host": int(victim),
+                "hedges": int(hedges),
+                "zero_lost": True, "zero_conflicting": True,
+                "outputs_byte_identical": True}
+    finally:
+        shutil.rmtree(d, ignore_errors=True)
+
+
 def main(n_devices: int = 8, quick: bool = False):
     from __graft_entry__ import _bootstrap_devices
 
@@ -1132,6 +1353,12 @@ def main(n_devices: int = 8, quick: bool = False):
     line["fleet_tripwire"] = (
         fleet_tripwire(1_000_000, parallel_efficiency_floor=0.7)
         if quick else fleet_tripwire())
+    # the fault legs are deterministic (zero lost / zero conflicting /
+    # mirror fires — no throughput floor), so quick differs only in
+    # corpus scale: the 1M proxy vs the full round's 10M
+    line["fleet_fault_tripwire"] = (
+        fleet_fault_tripwire(1_000_000) if quick
+        else fleet_fault_tripwire())
     # quick mode's runs are short enough that scheduler jitter swamps
     # the 3% overhead bound; the real <=1.03x gate runs at the 10M-row
     # proxy every full round

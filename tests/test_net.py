@@ -33,7 +33,9 @@ import urllib.request
 import numpy as np
 import pytest
 
-from avenir_tpu.net.fleet import Fleet, affinity_key
+from avenir_tpu.net.fault import (FaultPolicy, Lease, LeaseStore,
+                                  RestartTracker, hot_hosts)
+from avenir_tpu.net.fleet import Fleet, FleetError, affinity_key
 from avenir_tpu.net.listener import EdgePolicy, NetListener
 from avenir_tpu.net.router import AffinityRouter, RouterError
 from avenir_tpu.runner import run_job
@@ -154,6 +156,150 @@ def test_router_fold_cost_breaks_byte_ties():
     assert r2.affinity_hit_rate() == 0.5
 
 
+# ------------------------------------------------------------ avenir-fault
+def test_restart_tracker_backoff_and_quarantine():
+    p = FaultPolicy(restart_backoff_base_s=0.5,
+                    restart_backoff_cap_s=4.0, max_restarts=2,
+                    quarantine_window_s=60.0)
+    t = RestartTracker(p)
+    assert t.record_death(0.0) == "restarting"
+    assert t.backoff_s() == 0.5
+    assert t.record_death(1.0) == "restarting"
+    assert t.backoff_s() == 1.0          # capped exponential
+    assert t.record_death(2.0) == "quarantined"
+    # deaths OUTSIDE the window age out: a host that dies once an hour
+    # is restarted every time, never quarantined
+    t2 = RestartTracker(p)
+    for now in (0.0, 100.0, 200.0, 300.0, 400.0):
+        assert t2.record_death(now) == "restarting"
+    # ... and the backoff caps
+    t3 = RestartTracker(FaultPolicy(restart_backoff_base_s=1.0,
+                                    restart_backoff_cap_s=4.0,
+                                    max_restarts=100))
+    for now in range(6):
+        t3.record_death(float(now))
+    assert t3.backoff_s() == 4.0
+
+
+def test_lease_store_roundtrip_renew_expiry(tmp_path):
+    store = LeaseStore(str(tmp_path))
+    lease = Lease(name="r1.json", host=0, claimed_at=100.0, ttl_s=5.0,
+                  hosts=[0], nonce="n1")
+    store.write(lease)
+    assert store.names() == ["r1.json"]
+    back = store.load("r1.json")
+    assert (back.host, back.nonce, back.hosts) == (0, "n1", [0])
+    assert not back.expired(104.9) and back.expired(105.1)
+    store.renew(back, 200.0)
+    assert store.load("r1.json").claimed_at == 200.0
+    store.remove("r1.json")
+    assert store.names() == [] and store.load("r1.json") is None
+
+
+def test_hot_hosts_hedge_decision():
+    p = FaultPolicy(hedge_multiple=4.0, hedge_floor_ms=100.0)
+    # symmetric load: nobody is hot
+    assert hot_hosts({0: 500.0, 1: 520.0}, {}, p, [0, 1]) == []
+    # one straggler past 4x the median (lower middle for 2 hosts)
+    assert hot_hosts({0: 5000.0, 1: 200.0}, {}, p, [0, 1]) == [0]
+    # the pending-age live lower bound counts with no served p99 yet
+    assert hot_hosts({}, {0: 5000.0}, p, [0, 1]) == [0]
+    # idle fleet: the floor keeps microscopic wobbles from hedging
+    assert hot_hosts({0: 2.0, 1: 0.1}, {}, p, [0, 1]) == []
+    # fewer than two healthy hosts: nowhere to mirror
+    assert hot_hosts({0: 5000.0, 1: 1.0}, {}, p, [0]) == []
+    off = FaultPolicy(hedge=False, hedge_floor_ms=100.0)
+    assert hot_hosts({0: 5000.0, 1: 1.0}, {}, off, [0, 1]) == []
+
+
+def test_router_failover_and_reintegration():
+    r = AffinityRouter([100, 100])
+    a = r.place(("a",), 10)
+    assert a.kind == "miss"
+    r.release(a)
+    # warm host leaves serving: the sticky mapping DROPS (failover)
+    # and the corpus re-places on a serving host
+    r.set_host_state(a.host, "restarting")
+    b = r.place(("a",), 10)
+    assert b.host != a.host and b.kind == "miss"
+    assert r.stats["failovers"] == 1
+    # reintegration: the recovered host re-EARNS affinity through new
+    # placements, never a map reset — corpus a stays with its new home
+    r.set_host_state(a.host, "serving")
+    c = r.place(("a",), 10)
+    assert (c.host, c.kind) == (b.host, "hit")
+    # ... and a new corpus lands on the recovered least-loaded host
+    d = r.place(("new",), 10)
+    assert (d.host, d.kind) == (a.host, "miss")
+    # per-request exclusion (the requeue path): never back to a host
+    # the request already failed on, sticky mapping unmoved
+    e = r.place(("a",), 10, exclude=[b.host])
+    assert e.host != b.host and e.kind == "spill"
+    # mirrors: least-loaded serving host outside the exclusion set;
+    # a quarantined fleet-mate can never take one
+    r.set_host_state(a.host, "quarantined")
+    assert r.place_mirror(("a",), 10, exclude=[b.host]) is None
+    m = r.place_mirror(("a",), 10)
+    assert (m.host, m.kind) == (b.host, "hedge")
+    assert r.stats["hedges"] == 1
+    assert r.snapshot()["hosts"][a.host]["state"] == "quarantined"
+
+
+def test_fleet_quarantine_and_reinstate(tmp_path, monkeypatch):
+    """Supervision policy end to end over stand-in host processes: a
+    host that keeps dying is restarted with backoff, quarantined past
+    max_restarts, routed around, and re-earns service on operator
+    reinstate — all driven through the real _fault_tick."""
+
+    class FakeProc:
+        def __init__(self, rc=None):
+            self.rc = rc
+            self.pid = 4242
+
+        def poll(self):
+            return self.rc
+
+    policy = FaultPolicy(poll_interval_s=0.05, max_restarts=1,
+                         restart_backoff_base_s=0.0,
+                         quarantine_window_s=60.0, hedge=False)
+    fleet = Fleet(str(tmp_path / "fleet"), hosts=2,
+                  fault_policy=policy)
+
+    def fake_spawn_dying(i):
+        with fleet._lock:
+            fleet._procs[i] = FakeProc(rc=137)   # dies again instantly
+            fleet._spawned_at[i] = time.time()
+
+    monkeypatch.setattr(fleet, "_spawn_host", fake_spawn_dying)
+    with fleet._lock:
+        fleet._procs[0] = FakeProc(rc=137)       # dead on arrival
+        fleet._procs[1] = FakeProc()             # healthy
+        fleet._spawned_at = [time.time()] * 2
+    fleet._fault_tick()              # death 1 -> restarting
+    assert fleet.host_state(0) == "restarting"
+    fleet._fault_tick()              # backoff elapsed -> respawn
+    assert fleet.fault_snapshot()["stats"]["restarts"] == 1
+    fleet._fault_tick()              # death 2 in-window -> quarantine
+    assert fleet.host_state(0) == "quarantined"
+    assert fleet.router.snapshot()["hosts"][0]["state"] == "quarantined"
+    assert fleet.fault_snapshot()["stats"]["quarantined"] == 1
+    # placement routes around the quarantined host
+    placed = fleet.router.place(("k",), 10)
+    assert placed.host == 1
+    fleet.router.release(placed)
+    # operator reinstate: record cleared, host serves again
+    def fake_spawn_ok(i):
+        with fleet._lock:
+            fleet._procs[i] = FakeProc()
+            fleet._spawned_at[i] = time.time()
+
+    monkeypatch.setattr(fleet, "_spawn_host", fake_spawn_ok)
+    fleet.reinstate(0)
+    assert fleet.host_state(0) == "serving"
+    with pytest.raises(FleetError):
+        fleet.reinstate(1)           # only quarantined hosts reinstate
+
+
 # ---------------------------------------------------------------- listener
 def test_listener_round_trip_byte_identical(tmp_path):
     csv = _seq(tmp_path)
@@ -208,9 +354,13 @@ def test_edge_sheds_flood_and_recovers_after_drain(tmp_path):
     exceed its budget, and a previously-shed request succeeds on retry
     once in-flight work drains."""
     csv = _seq(tmp_path)
+    # deliberately NOT started yet: the first request stays queued, so
+    # the flood's 429s below are deterministic — a warm process can
+    # otherwise serve the first request between two POSTs and free the
+    # edge capacity the flood was meant to breach
     srv = _server(tmp_path, budget_bytes=150 << 20,
                   pricer=lambda reqs, reserve: (100 << 20) * len(reqs),
-                  rss_probe=lambda: 0).start()
+                  rss_probe=lambda: 0)
     with NetListener(srv, port=0) as lis:
         url = f"http://127.0.0.1:{lis.port}"
         code, first, _ = _post(url + "/submit",
@@ -228,8 +378,10 @@ def test_edge_sheds_flood_and_recovers_after_drain(tmp_path):
             assert "budget" in err["error"]
             shed += 1
         assert shed == 4
-        # the in-flight request finishes; the edge frees its priced
-        # bytes; the SAME previously-shed request now succeeds
+        # the server starts, the in-flight request finishes, the edge
+        # frees its priced bytes — the SAME previously-shed request
+        # now succeeds
+        srv.start()
         code, row = _get(url + f"/result/{first['req_id']}?timeout=240")
         assert code == 200 and row["ok"]
         deadline = time.perf_counter() + 30
@@ -387,6 +539,65 @@ def test_edge_policy_not_mutated_across_listeners(tmp_path):
         lis_b._httpd.server_close()
         srv_a.shutdown(drain=False)
         srv_b.shutdown(drain=False)
+
+
+def test_listener_retry_after_jitter(tmp_path):
+    """Shed responses carry a ±20%-jittered Retry-After so a cohort of
+    synchronized shed clients does not retry in lockstep and
+    re-stampede the edge at one instant."""
+    csv = _seq(tmp_path)
+    srv = _server(tmp_path, budget_bytes=150 << 20,
+                  pricer=lambda reqs, reserve: (100 << 20) * len(reqs),
+                  rss_probe=lambda: 0)     # not started: first queues
+    policy = EdgePolicy(retry_after_s=10.0)
+    with NetListener(srv, port=0, policy=policy) as lis:
+        url = f"http://127.0.0.1:{lis.port}"
+        code, _row, _ = _post(url + "/submit",
+                              _req_obj(csv, str(tmp_path / "j0.txt")))
+        assert code == 202
+        hints = []
+        for i in range(12):
+            code, err, headers = _post(
+                url + "/submit",
+                _req_obj(csv, str(tmp_path / f"j{i}.txt"),
+                         tenant=f"t{i}"),
+                expect_error=True)
+            assert code == 429
+            hint = err["retry_after_s"]
+            assert 8.0 <= hint <= 12.0        # ±20% of the 10s policy
+            assert int(headers["Retry-After"]) >= 8
+            hints.append(hint)
+        assert min(hints) < max(hints)        # jittered, not lockstep
+    srv.shutdown(drain=False)
+
+
+def test_listener_healthz_supervision_states(tmp_path):
+    """/healthz surfaces the supervision overlay: quarantined and
+    restarting answer 503 with the state in-band (and refuse new
+    submissions the same way draining does); clearing the overlay
+    returns the edge to serving."""
+    csv = _seq(tmp_path)
+    srv = _server(tmp_path).start()
+    with NetListener(srv, port=0) as lis:
+        url = f"http://127.0.0.1:{lis.port}"
+        code, health = _get(url + "/healthz")
+        assert code == 200 and health["status"] == "serving"
+        for state in ("quarantined", "restarting"):
+            lis.set_health_state(state)
+            code, health = _get(url + "/healthz", expect_error=True)
+            assert code == 503 and health["status"] == state
+            code, err, _ = _post(url + "/submit",
+                                 _req_obj(csv, str(tmp_path / "hs.txt")),
+                                 expect_error=True)
+            assert code == 503 and err["status"] == state
+        with pytest.raises(ValueError):
+            lis.set_health_state("weird")
+        lis.set_health_state(None)
+        code, row, _ = _post(url + "/submit?wait=1",
+                             _req_obj(csv, str(tmp_path / "hs2.txt")))
+        assert code == 200 and row["ok"]
+        assert lis.edge_stats()["health_state"] == "serving"
+    srv.shutdown()
 
 
 def test_listener_drain_state(tmp_path):
@@ -656,6 +867,269 @@ def test_spool_failure_row_keeps_nonce(tmp_path):
     assert "noSuchJob" in row["error"]
 
 
+def test_spool_dead_letters_torn_request(tmp_path):
+    """A truncated request JSON leaves the claim loop FOR GOOD: moved
+    to <spool>/dead/ with a reason file (the crash-loop fix), the
+    in-band failure row still written, and the session keeps serving
+    the next request."""
+    import threading
+
+    from avenir_tpu.server.spool import serve_spool
+
+    csv = _seq(tmp_path)
+    spool = str(tmp_path / "spool")
+    os.makedirs(os.path.join(spool, "in"), exist_ok=True)
+    stop = threading.Event()
+    srv = _server(tmp_path)
+    with srv:
+        t = threading.Thread(target=lambda: serve_spool(
+            srv, spool, should_stop=stop.is_set))
+        t.start()
+        try:
+            tmp = os.path.join(spool, "torn.tmp")
+            with open(tmp, "w") as fh:     # truncated mid-object
+                fh.write('{"job": "markovStateTransitionModel", "inp')
+            os.replace(tmp, os.path.join(spool, "in", "torn.json"))
+            out = os.path.join(spool, "out", "torn.json")
+            _wait_for(lambda: os.path.exists(out), 60,
+                      "failure row for the torn request")
+            dead_dir = os.path.join(spool, "dead")
+            dead = [n for n in os.listdir(dead_dir)
+                    if n.startswith("torn.json")
+                    and not n.endswith(".reason")]
+            assert len(dead) == 1
+            with open(os.path.join(dead_dir, dead[0])) as fh:
+                assert fh.read().startswith('{"job"')  # bytes preserved
+            with open(os.path.join(dead_dir, "torn.json.reason")) as fh:
+                assert "JSONDecodeError" in fh.read()
+            # never re-claimable: nothing left in work/ or in/
+            assert not os.listdir(os.path.join(spool, "work"))
+            assert not os.listdir(os.path.join(spool, "in"))
+            # the loop survived: a well-formed request still serves
+            good = _req_obj(csv, str(tmp_path / "after.txt"))
+            tmp2 = os.path.join(spool, "good.tmp")
+            with open(tmp2, "w") as fh:
+                json.dump(good, fh)
+            os.replace(tmp2, os.path.join(spool, "in", "good.json"))
+            good_out = os.path.join(spool, "out", "good.json")
+            _wait_for(lambda: os.path.exists(good_out), 240,
+                      "request served after the dead-letter")
+        finally:
+            stop.set()
+            t.join(30)
+        assert not t.is_alive()
+    with open(out) as fh:
+        row = json.load(fh)
+    assert not row["ok"] and "JSONDecodeError" in row["error"]
+    with open(good_out) as fh:
+        assert json.load(fh)["ok"]
+
+
+def test_fleet_survives_host_sigkill(tmp_path):
+    """The chaos contract at test scale: SIGKILL one host right after
+    its requests were placed; supervision detects the death, requeues
+    the stranded leases to the healthy host (zero lost), restarts the
+    dead host, and every row is byte-identical to its solo twin (zero
+    conflicting)."""
+    a = _seq(tmp_path, seed=1, name="a.csv")
+    b = _seq(tmp_path, seed=2, name="b.csv")
+    policy = FaultPolicy(poll_interval_s=0.1, lease_ttl_s=1.0,
+                         restart_backoff_base_s=0.2,
+                         heartbeat_timeout_s=60.0, hedge=False)
+    fleet = Fleet(str(tmp_path / "fleet"), hosts=2, workers=1,
+                  env=_SUB_ENV, fault_policy=policy)
+    fleet.start()
+    try:
+        names = {}
+        for i, corpus in enumerate([a, b, a, b]):
+            names[i] = fleet.submit(_req_obj(
+                corpus, str(tmp_path / f"ck{i}.txt"), tenant=f"t{i}"))
+        # corpus a's sticky host is 0 (first miss on an idle fleet)
+        os.kill(fleet.host_pid(0), signal.SIGKILL)
+        rows = fleet.collect(list(names.values()), timeout=240)
+        assert all(r["ok"] for r in rows.values())
+        snap = fleet.fault_snapshot()
+        assert snap["stats"]["requeues"] >= 1       # leases swept over
+        assert snap["leases_outstanding"] == 0      # ... and released
+        _wait_for(lambda: fleet.fault_snapshot()["stats"]["restarts"]
+                  >= 1 and fleet.host_state(0) == "serving", 120,
+                  "killed host restarted and reintegrated")
+    finally:
+        codes = fleet.stop()
+    # the surviving host drained gracefully; the restarted one may
+    # still have been mid-boot when the TERM landed
+    assert codes[1] == 0
+    twins = {
+        a: run_job("markovStateTransitionModel", MST_CONF, [a],
+                   str(tmp_path / "cka_ref.txt")),
+        b: run_job("markovStateTransitionModel", MST_CONF, [b],
+                   str(tmp_path / "ckb_ref.txt")),
+    }
+    for i, corpus in enumerate([a, b, a, b]):
+        with open(tmp_path / f"ck{i}.txt", "rb") as fa, \
+                open(twins[corpus].outputs[0], "rb") as fb:
+            assert fa.read() == fb.read()
+
+
+def test_fleet_hedges_stalled_host(tmp_path):
+    """Hedged tail dispatch: a SIGSTOPped host's queued request is
+    mirrored to the least-loaded healthy host once its pending age
+    blows past the fleet median, and the FIRST result wins — the fleet
+    answers while the stalled original never finishes. After SIGCONT
+    the late duplicate is an identical write, never a conflict."""
+    csv = _seq(tmp_path)
+    policy = FaultPolicy(poll_interval_s=0.1, hedge_multiple=2.0,
+                         hedge_floor_ms=300.0, lease_ttl_s=3600.0,
+                         heartbeat_timeout_s=3600.0)
+    fleet = Fleet(str(tmp_path / "fleet"), hosts=2, workers=1,
+                  env=_SUB_ENV, fault_policy=policy)
+    fleet.start()
+    try:
+        # warm both hosts: each needs a MEASURED served tail (the
+        # hedge gate) and resident compiles
+        warm = [fleet.submit_to(h, _req_obj(
+            csv, str(tmp_path / f"wh{h}.txt"))) for h in (0, 1)]
+        fleet.collect(warm, timeout=240)
+        # the hedge gate reads the SERVED tail from each host's
+        # heartbeat snapshot: let both heartbeats catch up with the
+        # warmups before freezing one (a stopped host can never
+        # refresh its own)
+        _wait_for(lambda: all(n >= 1 for _p, n in
+                              fleet._rolled_p99().values()), 60,
+                  "host heartbeats reflect the served warmups")
+        os.kill(fleet.host_pid(0), signal.SIGSTOP)
+        try:
+            # fresh corpus on an idle fleet routes to host 0 — which
+            # is stopped and will never serve it
+            name = fleet.submit(_req_obj(csv, str(tmp_path / "hg.txt"),
+                                         tenant="hg"))
+            rows = fleet.collect([name], timeout=240)
+            assert rows[name]["ok"]
+            assert fleet.router.stats["hedges"] >= 1
+            # the stall never looked like a death: no requeue, no
+            # restart — hedging alone carried the tail
+            snap = fleet.fault_snapshot()
+            assert snap["stats"]["requeues"] == 0
+            assert snap["stats"]["restarts"] == 0
+            assert fleet.host_state(0) == "serving"
+        finally:
+            os.kill(fleet.host_pid(0), signal.SIGCONT)
+    finally:
+        codes = fleet.stop()
+    assert codes == [0, 0]        # SIGCONT'd host drained gracefully
+    twin = run_job("markovStateTransitionModel", MST_CONF, [csv],
+                   str(tmp_path / "hg_ref.txt"))
+    # byte-identical even though BOTH copies may have run (the resumed
+    # original rewrites the same bytes — zero conflicting results)
+    with open(tmp_path / "hg.txt", "rb") as fa, \
+            open(twin.outputs[0], "rb") as fb:
+        assert fa.read() == fb.read()
+
+
+def test_stranded_lease_after_restart_respools(tmp_path):
+    """The restart gap: a claim taken by a DEAD incarnation sits in
+    its old work/ dir, which the restarted host never re-adopts. The
+    lease sweep must detect a lease predating the current incarnation
+    — and with no other host to requeue to, re-spool the request into
+    the restarted host's own in/, riding the original budget charge
+    (released exactly once when the result lands)."""
+
+    class FakeProc:
+        pid = 4242
+
+        def poll(self):
+            return None
+
+    csv = _seq(tmp_path, rows=50)
+    fleet = Fleet(str(tmp_path / "fleet"), hosts=1,
+                  fault_policy=FaultPolicy(hedge=False))
+    for sub in ("in", "out", "work"):
+        os.makedirs(os.path.join(fleet.host_dirs[0], sub),
+                    exist_ok=True)
+    with fleet._lock:
+        fleet._procs[0] = FakeProc()
+    obj = _req_obj(csv, str(tmp_path / "st.txt"))
+    req, priced, cost = fleet.price(obj)
+    placement = fleet.router.assign_to(0, affinity_key(req), priced,
+                                       cost)
+    name = fleet._spool_to(placement, obj)
+    entry = fleet._outstanding[name]
+    spool_file = os.path.join(fleet.host_dirs[0], "in",
+                              entry.copies[0].name)
+    # restart happened AFTER the lease was claimed, but the spooled
+    # file still sits in in/: the new incarnation will claim it, so
+    # the sweep restamps instead of moving the request
+    with fleet._lock:
+        fleet._spawned_at[0] = entry.lease.claimed_at + 10.0
+    fleet._sweep_leases(time.time() + 20.0)
+    assert fleet.fault_snapshot()["stats"]["respools"] == 0
+    assert os.path.exists(spool_file)
+    # now the claim is GONE from in/ (the dead incarnation took it to
+    # its grave): the sweep must re-spool — requeueing is impossible,
+    # every other host is on the lease's exclusion trail
+    os.remove(spool_file)
+    with fleet._lock:
+        fleet._spawned_at[0] = time.time() + 100.0
+    fleet._sweep_leases(time.time() + 200.0)
+    snap = fleet.fault_snapshot()
+    assert snap["stats"]["respools"] == 1
+    assert snap["stats"]["requeues"] == 0
+    new_copy = fleet._outstanding[name].copies[-1]
+    assert os.path.exists(os.path.join(fleet.host_dirs[0], "in",
+                                       new_copy.name))
+    # a row landing on the re-spooled copy completes the request and
+    # releases the SINGLE shared budget charge exactly once
+    with open(new_copy.out_path + ".tmp", "w") as fh:
+        json.dump({"ok": True}, fh)
+    os.replace(new_copy.out_path + ".tmp", new_copy.out_path)
+    rows = fleet.collect([name], timeout=30)
+    assert rows[name]["ok"]
+    host = fleet.router.snapshot()["hosts"][0]
+    assert host["assigned_bytes"] == 0
+    assert host["assigned_requests"] == 0
+    assert fleet.fault_snapshot()["leases_outstanding"] == 0
+
+
+def test_requeued_refresh_cold_fallback(tmp_path):
+    """Crash-resume composition: a refresh request landing on a host
+    WITHOUT the corpus's checkpoint (what a lease requeue does after
+    the warm host dies) falls back to the cold scan — never a wrong
+    resume — and still writes byte-identical output."""
+    csv = _seq(tmp_path, rows=400)
+    fleet = Fleet(str(tmp_path / "fleet"), hosts=2, workers=1,
+                  env=_SUB_ENV)
+    fleet.start()
+    try:
+        # cold seed on the sticky host (host 0: first miss), writing
+        # its managed checkpoint
+        n1 = fleet.submit(_req_obj(csv, str(tmp_path / "rf1.txt"),
+                                   mode="refresh"))
+        r1 = fleet.collect([n1], timeout=240)[n1]
+        assert r1["ok"]
+        assert r1["counters"]["Resume:SkippedBytes"] == 0
+        # warm repeat on the SAME host restores the carry
+        n2 = fleet.submit(_req_obj(csv, str(tmp_path / "rf2.txt"),
+                                   mode="refresh"))
+        r2 = fleet.collect([n2], timeout=240)[n2]
+        assert r2["ok"] and r2["counters"]["Resume:SkippedBytes"] > 0
+        # the requeue shape: the same refresh forced onto the OTHER
+        # host finds no local checkpoint -> cold scan, not a wrong
+        # resume
+        n3 = fleet.submit_to(1, _req_obj(csv, str(tmp_path / "rf3.txt"),
+                                         mode="refresh"))
+        r3 = fleet.collect([n3], timeout=240)[n3]
+        assert r3["ok"] and r3["counters"]["Resume:SkippedBytes"] == 0
+    finally:
+        codes = fleet.stop()
+    assert codes == [0, 0]
+    twin = run_job("markovStateTransitionModel", MST_CONF, [csv],
+                   str(tmp_path / "rf_ref.txt"))
+    for out in ("rf1.txt", "rf2.txt", "rf3.txt"):
+        with open(tmp_path / out, "rb") as fa, \
+                open(twin.outputs[0], "rb") as fb:
+            assert fa.read() == fb.read()
+
+
 # ------------------------------------------------------------- stats merge
 def test_stats_merges_snapshots_and_fleet_dirs(tmp_path):
     from avenir_tpu.obs.report import (expand_metrics_paths,
@@ -711,5 +1185,64 @@ def test_fleet_load_harness_inproc(tmp_path, capsys):
     arm = lines[1]
     assert arm["arm"] == "inproc"
     assert arm["served"] == 4 and arm["shed"] == 0
+    assert arm["lost_requests"] == 0 and arm["retries"] == 0
     assert arm["jobs_per_min"] > 0
     assert arm["p99_queue_wait_ms"] >= arm["p50_queue_wait_ms"] >= 0.0
+    # the shed-retry backoff: Retry-After analog doubled per attempt,
+    # capped, ±20% jittered — the client half of the 429 contract
+    rng = np.random.default_rng(0)
+    first = [fleet_load._backoff_s(0, rng) for _ in range(16)]
+    assert all(0.8 <= v <= 1.2 for v in first)
+    assert min(first) < max(first)            # jittered, not lockstep
+    assert all(6.4 <= fleet_load._backoff_s(9, rng) <= 9.6
+               for _ in range(4))             # capped at 8s nominal
+
+
+def test_fleet_load_harness_retries_sheds(monkeypatch):
+    """A shed request is retried with backoff until served, never
+    dropped: the fleet arm reports shed>0, retries>0 and
+    lost_requests==0 — the soak contract."""
+    import types
+
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    try:
+        import fleet_load
+    finally:
+        sys.path.pop(0)
+
+    class FakeRouter:
+        def affinity_hit_rate(self):
+            return 1.0
+
+    class FakeFleet:
+        def __init__(self, root, hosts=2, workers=1, budget_mb=0.0):
+            self.router = FakeRouter()
+            self.n = 0
+            self.sheds_left = 2
+
+        def __enter__(self):
+            return self
+
+        def __exit__(self, *exc):
+            pass
+
+        def submit(self, obj, block=True, count_held=True):
+            if self.sheds_left > 0:
+                self.sheds_left -= 1
+                return None
+            self.n += 1
+            return f"r{self.n}"
+
+        def collect(self, names, timeout=0.0):
+            return {n: {"ok": True} for n in names}
+
+        def merged_metrics(self):
+            return {"hists": {}}
+
+    monkeypatch.setattr("avenir_tpu.net.fleet.Fleet", FakeFleet)
+    args = types.SimpleNamespace(workers=1, budget_mb=1.0, seed=3,
+                                 drain_timeout=30.0)
+    load = [(0.0, {"i": i}) for i in range(3)]
+    row = fleet_load.run_fleet(args, load, hosts=2)
+    assert row["shed"] == 2 and row["retries"] >= 2
+    assert row["served"] == 3 and row["lost_requests"] == 0
