@@ -646,6 +646,84 @@ def _fused_incremental_leg(workdir: str, jobs_ctx: List[tuple],
     }
 
 
+def _sharded_steal_leg(workdir: str, jobs_ctx: List[tuple], ctx: dict,
+                       baseline: bytes) -> dict:
+    """(f) sharded-steal leg, through the REAL dist primitives
+    (avenir_tpu.dist): the shard planner cuts the corpus into
+    newline-aligned blocks, worker 0 claims and commits EVERY block
+    through the block ledger (the fast-worker steal shape: half of
+    those blocks are worker 1's home run), then worker 1 redundantly
+    folds the BOUNDARY block — the first block of its own stolen home
+    run, the exact block a straggler and its mirror race over — and its
+    duplicate commit must be REJECTED first-commit-wins. The
+    plan-ordered merge of committed states must reproduce the cold
+    scan's bytes: the ledger folded every block into the final state
+    exactly once, although two workers computed one of them. This is
+    the overlap probe's contract made mechanical — every family is
+    NON-idempotent, so the dedup, not the fold, is what keeps redundant
+    execution safe."""
+    from avenir_tpu.dist.driver import merge_block_states
+    from avenir_tpu.dist.ledger import BlockLedger
+    from avenir_tpu.dist.plan import plan_shards
+    from avenir_tpu.dist.worker import fold_block
+
+    csv = ctx["csv"]
+    schema = _load_schema(ctx)
+    plan = plan_shards([csv], procs=2, factor=2)
+    boundary = plan.blocks_for(1)[0]
+    dup_rejected = True
+    committed_once = True
+    folds = []
+    for job, _prefix, _props, cfg, ops in jobs_ctx:
+        root = os.path.join(workdir, f"steal_{job}")
+        ledger = BlockLedger(root)
+        def close_src(f) -> None:
+            # a serialized-then-discarded MINER fold still owns its
+            # streaming source (spill cache, fds); drop it explicitly
+            src = getattr(f, "src", None)
+            if src is not None:
+                src.close()
+
+        for blk in plan.blocks:
+            if not ledger.claim(blk.id, worker=0):
+                raise MergeAuditError(
+                    f"{job}: worker 0 lost an uncontended claim on "
+                    f"block {blk.id}")
+            fold = fold_block(job, cfg, ops, schema, [csv], csv,
+                              blk.start, blk.end)
+            committed = ledger.commit(blk.id, 0,
+                                      ops.serialize_state(fold))
+            close_src(fold)
+            if not committed:
+                raise MergeAuditError(
+                    f"{job}: worker 0's first commit of block "
+                    f"{blk.id} was rejected")
+        # worker 1 redundantly computes the boundary block (the
+        # straggler-mirror shape); its commit MUST lose
+        fold = fold_block(job, cfg, ops, schema, [csv], csv,
+                          boundary.start, boundary.end)
+        won = ledger.commit(boundary.id, 1, ops.serialize_state(fold))
+        close_src(fold)
+        if won:
+            dup_rejected = False
+        if len(ledger.committed()) != len(plan.blocks) \
+                or ledger.dup_count() < 1:
+            committed_once = False
+        states = {bid: ledger.load_state(bid)
+                  for bid in ledger.committed()}
+        folds.append(merge_block_states(job, cfg, ops, plan, states,
+                                        [csv], root, schema=schema))
+    art = _finish_artifact(jobs_ctx, folds,
+                           os.path.join(workdir, "steal_merge"))
+    return {
+        "blocks": len(plan.blocks),
+        "boundary_block": boundary.id,
+        "dup_rejected": dup_rejected,
+        "committed_once": committed_once,
+        "byte_identical": art == baseline,
+    }
+
+
 def audit_merge(spec, shard_counts: Sequence[int] = AUDIT_SHARDS,
                 block_mb: float = AUDIT_BLOCK_MB
                 ) -> Tuple[dict, Optional[Finding]]:
@@ -673,6 +751,7 @@ def audit_merge(spec, shard_counts: Sequence[int] = AUDIT_SHARDS,
         checkpoint: Optional[dict] = None
         overlap: Optional[dict] = None
         incremental: Optional[dict] = None
+        sharded: Optional[dict] = None
         if enough:
             for P in shard_counts:
                 shards = _shard_files(workdir, blocks, P, "m")
@@ -742,6 +821,12 @@ def audit_merge(spec, shard_counts: Sequence[int] = AUDIT_SHARDS,
             # (d) incremental delta-scan + crash-resume, real driver
             incremental = _incremental_leg(workdir, jobs_ctx, blocks,
                                            baseline)
+
+            # (f) sharded-steal: two workers race one boundary block
+            # through the block ledger; first commit wins, the merge
+            # sees the block exactly once
+            sharded = _sharded_steal_leg(workdir, jobs_ctx, ctx,
+                                         baseline)
     except MergeAuditError:
         raise
     except Exception as e:
@@ -764,6 +849,15 @@ def audit_merge(spec, shard_counts: Sequence[int] = AUDIT_SHARDS,
                and fused["byte_identical"]
                and fused["resume_interrupted"]
                and fused["skipped_bytes"] > 0)
+    # the sharded-steal leg: a boundary block folded by two workers'
+    # redundant executions must commit exactly once through the block
+    # ledger AND the plan-ordered merge must reproduce the cold bytes —
+    # the dedup contract the multi-process sharded driver
+    # (avenir_tpu.dist) rests on, re-proven per stream entry per round
+    shard_ok = (sharded is not None
+                and sharded["dup_rejected"]
+                and sharded["committed_once"]
+                and sharded["byte_identical"])
     row = {
         "kernel": spec.name,
         "jobs": [j for j, _p, _pr, _c, _o in jobs_ctx],
@@ -772,11 +866,13 @@ def audit_merge(spec, shard_counts: Sequence[int] = AUDIT_SHARDS,
         "checkpoint": checkpoint,
         "overlap": overlap,
         "incremental": incremental,
+        "sharded": sharded,
         "merge_validated": ok,
         "incremental_validated": incr_ok,
+        "shard_dedup_validated": shard_ok,
     }
     finding = None
-    if not ok or not incr_ok:
+    if not ok or not incr_ok or not shard_ok:
         if not enough:
             why = (f"corpus cut into only {len(blocks)} blocks at "
                    f"{block_mb:g}MB — too few for P={max(shard_counts)} "
@@ -793,6 +889,8 @@ def audit_merge(spec, shard_counts: Sequence[int] = AUDIT_SHARDS,
                            and incremental["skipped_bytes"] > 0)
                 bad.append("fused-incremental-append/resume" if solo_ok
                            else "incremental-append/resume")
+            if not shard_ok:
+                bad.append("sharded-steal-dedup")
             why = f"output bytes drifted under: {', '.join(bad)}"
         finding = Finding(
             spec.path, spec.line, MERGE_AUDIT_RULE,

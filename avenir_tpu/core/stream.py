@@ -513,6 +513,30 @@ def _offset_byte_blocks(path: str, block_bytes: int,
             yield emit, carry
 
 
+def split_byte_ranges(total: int, n: int) -> list:
+    """`n` contiguous [lo, hi) ranges tiling ``[0, total)`` gap-free —
+    the ONE copy of the input-split arithmetic behind every multi-process
+    ingest surface (``parallel.multihost.host_shard_bounds``, the shard
+    planner's nominal block bounds). Ceil-division sizing, so a total
+    smaller than the split count yields trailing EMPTY ranges that still
+    tile (``(total, total)``) — consumers built on the LineRecordReader
+    boundary contract (``iter_byte_blocks``/``CsvBlockReader`` with
+    ``byte_range=``) then see zero lines for those, never a duplicated
+    or dropped boundary line. Pinned by the edge regression tests in
+    tests/test_stream.py (no trailing newline, single-line corpus,
+    corpus smaller than the split count)."""
+    if n < 1:
+        raise ValueError(f"split count must be positive, got {n}")
+    if total < 0:
+        raise ValueError(f"total must be non-negative, got {total}")
+    per = (total + n - 1) // n
+    ranges = []
+    for i in range(n):
+        lo = min(i * per, total)
+        ranges.append((lo, min(lo + per, total)))
+    return ranges
+
+
 def is_blank_block(data: bytes) -> bool:
     """True when a raw byte block holds no non-whitespace byte — the
     no-copy check delta-scan drivers use to skip folding the blank

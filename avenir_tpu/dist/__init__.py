@@ -1,0 +1,44 @@
+"""avenir-shard: multi-process sharded streaming with coded straggler
+tolerance.
+
+The streaming path, finally across processes: a shard planner
+over-partitions inputs into newline-aligned byte-range blocks
+(:mod:`avenir_tpu.dist.plan`), workers claim them through a
+first-commit-wins block ledger (:mod:`avenir_tpu.dist.ledger`) — fast
+workers steal the unclaimed tail, stragglers' in-flight blocks are
+redundantly re-dispatched past a telemetry-derived threshold
+(:mod:`avenir_tpu.dist.detect`) — and the coordinator merges committed
+block states in plan order through the registered fold-state algebra
+(:mod:`avenir_tpu.dist.driver`), byte-identical to the solo runner. The
+TPU/GPU psum merge lives behind the backend gate in
+:mod:`avenir_tpu.dist.collective`.
+
+Gated by ``bench_scaling.shard_tripwire``: 2-process byte-identity +
+capacity-scaled speedup floor, plus a SIGSTOP chaos leg asserting the
+tail completes redundantly with ``Shard:DedupBlocks >= 1`` and zero
+lost blocks.
+"""
+
+from avenir_tpu.dist.detect import StragglerPolicy, mirror_after_s
+from avenir_tpu.dist.driver import (ShardError, merge_block_states,
+                                    run_sharded)
+from avenir_tpu.dist.ledger import BlockLedger
+from avenir_tpu.dist.plan import (DEFAULT_FACTOR, PlanError, ShardBlock,
+                                  ShardPlan, load_plan, plan_shards,
+                                  write_plan)
+
+__all__ = [
+    "BlockLedger",
+    "DEFAULT_FACTOR",
+    "PlanError",
+    "ShardBlock",
+    "ShardError",
+    "ShardPlan",
+    "StragglerPolicy",
+    "load_plan",
+    "merge_block_states",
+    "mirror_after_s",
+    "plan_shards",
+    "run_sharded",
+    "write_plan",
+]

@@ -1,0 +1,95 @@
+"""Cross-process collective merge — the TPU/GPU path, behind a gate.
+
+The sharded driver's CPU merge path restores every block's serialized
+carry in the coordinator and chains the registered ``merge_states`` —
+correct everywhere, O(states) host work. On a real multi-process
+accelerator mesh the same sum is one collective: each process assembles
+its LOCAL merged carry as a flat vector, ``jax.make_array_from_process_
+local_data`` builds the globally process-sharded array without any host
+materializing the whole thing, and a ``psum`` over the data axis hands
+every process the fleet-wide sufficient statistics (the SNIPPETS.md
+partitioner template; the per-family payload sizes are the validated
+``collective_payload_model`` entries).
+
+The gate exists because jaxlib's CPU backend REFUSES compiled
+multiprocess computation ("Multiprocess computations aren't implemented
+on the CPU backend" — pinned by tests/test_multihost.py since PR 4), so
+this module is built and unit-gated on CPU rounds but EXERCISED only on
+TPU/GPU rounds: callers ask :func:`collective_ready` first and fall
+back to the serialized-state merge, which produces byte-identical
+artifacts by the proven merge algebra.
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+import numpy as np
+
+
+class CollectiveUnavailable(RuntimeError):
+    """The cross-process collective merge cannot run on this backend
+    (CPU multiprocess, or a single-process run with nothing to merge
+    across)."""
+
+
+def collective_ready() -> bool:
+    """True only where the psum merge can actually compile: a non-CPU
+    backend inside an initialized multi-process ``jax.distributed``
+    run. CPU multiprocess is the documented jaxlib refusal; CPU
+    single-process has nothing to merge across (the in-process
+    ``merge_states`` chain is strictly cheaper than a device
+    round-trip)."""
+    import jax
+
+    return jax.default_backend() != "cpu" and jax.process_count() > 1
+
+
+def allsum_carry(arrays: Dict[str, np.ndarray]) -> Dict[str, np.ndarray]:
+    """Sum each carry array across every process of the distributed
+    run: flatten this process's arrays into ONE local row, assemble the
+    (procs, L) process-sharded global array, psum over the data axis,
+    and unflatten. Additive carries only (counts/moments — exactly what
+    every registered ``state_dict`` stores besides ``meta``); the
+    caller merges ``meta`` by its own rules.
+
+    Raises :class:`CollectiveUnavailable` off-gate — callers fall back
+    to the serialized-state merge path, never silently compute a
+    different answer."""
+    if not collective_ready():
+        raise CollectiveUnavailable(
+            "collective merge needs a multi-process TPU/GPU backend; "
+            "CPU rounds merge via StreamFoldOps.merge_states "
+            "(jaxlib: multiprocess computations aren't implemented on "
+            "the CPU backend)")
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from avenir_tpu.parallel.mesh import DATA_AXIS
+    from avenir_tpu.parallel.multihost import global_mesh
+
+    keys = sorted(arrays)
+    shapes = {k: np.shape(arrays[k]) for k in keys}
+    # one widening AFTER the concat (not per-array in the loop): the
+    # carries are exact additive counts/moments, summed in float64 by
+    # the same contract every state_dict stores them under
+    flat = (np.concatenate([np.ravel(arrays[k]) for k in keys])
+            .astype(np.float64) if keys else np.zeros(0, np.float64))
+    mesh = global_mesh()
+    sharding = NamedSharding(mesh, P(DATA_AXIS))
+    world = jax.make_array_from_process_local_data(
+        sharding, flat[None, :])
+
+    @jax.jit
+    def _sum(x):
+        return jnp.sum(x, axis=0)
+
+    total = np.asarray(_sum(world))
+    out: Dict[str, np.ndarray] = {}
+    off = 0
+    for k in keys:
+        n = int(np.prod(shapes[k])) if shapes[k] else 1
+        out[k] = total[off:off + n].reshape(shapes[k])
+        off += n
+    return out

@@ -1,0 +1,309 @@
+"""Sharded streaming driver: N worker processes, one merged artifact.
+
+:func:`run_sharded` is the multi-process sibling of ``run_job`` /
+``run_shared`` / ``run_incremental``: same registered jobs, same conf
+surface, same artifact contract — byte-identical output to the solo
+runner — but the STREAMING pass runs across ``procs`` worker processes
+on this host. The machinery:
+
+1. The shard planner over-partitions every input into newline-aligned
+   byte-range blocks (``factor`` × ``procs``) and publishes the atomic
+   plan manifest.
+2. Workers (:mod:`avenir_tpu.dist.worker`) claim blocks through the
+   block ledger — home run first, then stealing the unclaimed tail —
+   fold each block through the registered ``StreamFoldOps`` sink, and
+   commit the serialized carry first-commit-wins. Stragglers' in-flight
+   blocks are redundantly re-dispatched past the telemetry-derived
+   threshold; the ledger dedups, because every fold family is
+   NON-idempotent (the merge auditor's overlap probe) and a block must
+   fold into the final state exactly once.
+3. The coordinator restores every committed block state with the
+   registered ``restore_state``, merges them IN PLAN ORDER with the
+   registered ``merge_states`` (the algebra graftlint --merge proves
+   byte-exact for merge chains every round), and finishes the fold once
+   — CPU path. The cross-process collective merge
+   (``jax.make_array_from_process_local_data`` + psum) lives behind the
+   backend gate in :mod:`avenir_tpu.dist.collective` and is exercised
+   on TPU/GPU rounds only: jaxlib's CPU backend refuses compiled
+   multiprocess computation (tests/test_multihost.py pins the
+   limitation).
+
+Every sharded JobResult carries the shard counters next to the standard
+streamed set: ``Shard:Blocks`` (plan blocks), ``Shard:StolenBlocks``
+(claims outside the claimant's home run), ``Shard:DedupBlocks``
+(rejected duplicate commits — redundancy that actually fired),
+``Shard:MergeMs`` (restore+merge wall).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import subprocess
+import sys
+import tempfile
+import time
+from typing import Callable, Dict, List, Optional, Sequence
+
+from avenir_tpu import obs as _obs
+from avenir_tpu.dist.detect import StragglerPolicy
+from avenir_tpu.dist.ledger import BlockLedger
+from avenir_tpu.dist.plan import (DEFAULT_FACTOR, ShardPlan, plan_shards,
+                                  write_plan)
+from avenir_tpu.dist.worker import RESCAN_AT_FINISH
+
+
+class ShardError(RuntimeError):
+    """A sharded run that lost workers or blocks."""
+
+
+def _pkg_parent() -> str:
+    import avenir_tpu
+
+    return os.path.dirname(os.path.dirname(os.path.abspath(
+        avenir_tpu.__file__)))
+
+
+def _worker_env() -> Dict[str, str]:
+    env = dict(os.environ)
+    env["PYTHONPATH"] = os.pathsep.join(
+        p for p in (_pkg_parent(), env.get("PYTHONPATH")) if p)
+    return env
+
+
+def _restore_inputs(canonical: str, plan: ShardPlan, block,
+                    inputs: Sequence[str], workdir: str) -> List[str]:
+    """The input list a restored block state folds/finishes against.
+    The miners' ``finish()`` re-scans its inputs per itemset length, so
+    each of their block states must see exactly ITS block's lines — a
+    byte slice of the input, legal because plan blocks are
+    newline-aligned. Every other family's finish never re-reads inputs,
+    so the real input list (better error messages, zero extra disk)
+    is kept."""
+    if canonical not in RESCAN_AT_FINISH:
+        return list(inputs)
+    src = plan.inputs[block.input]["path"]
+    slice_path = os.path.join(workdir, f"slice_b{block.id}.bin")
+    if not os.path.exists(slice_path):
+        with open(src, "rb") as fh:
+            fh.seek(block.start)
+            data = fh.read(block.end - block.start)
+        tmp = f"{slice_path}.tmp"
+        with open(tmp, "wb") as out:
+            out.write(data)
+        os.replace(tmp, slice_path)
+    return [slice_path]
+
+
+def merge_block_states(canonical: str, cfg, ops, plan: ShardPlan,
+                       states: Dict[int, bytes], inputs: Sequence[str],
+                       workdir: str, schema=None):
+    """Restore every committed block state and merge IN PLAN ORDER —
+    the coordinator's half of the dedup contract (exactly one state per
+    block id ever reaches this table) and the merge-algebra chain the
+    auditor proves byte-exact. Returns the merged fold, ready for
+    ``finish()``. Shared with the graftlint --merge sharded-steal leg."""
+    merged = None
+    for blk in plan.blocks:
+        if blk.id not in states:
+            raise ShardError(f"block {blk.id} has no committed state")
+        rins = _restore_inputs(canonical, plan, blk, inputs, workdir)
+        fold = ops.restore_state(cfg, rins, states[blk.id], schema=schema)
+        merged = fold if merged is None else ops.merge_states(merged, fold)
+    if merged is None:
+        raise ShardError("shard plan has no blocks")
+    return merged
+
+
+def run_sharded(name: str, conf, inputs: Sequence[str], output: str,
+                procs: int = 2, factor: int = DEFAULT_FACTOR,
+                shard_root: Optional[str] = None,
+                policy: Optional[StragglerPolicy] = None,
+                pin_cores: Optional[Sequence[int]] = None,
+                worker_hook: Optional[Callable] = None,
+                timeout_s: float = 7200.0) -> "JobResult":
+    """Run one registered streamed job across ``procs`` worker
+    processes — byte-identical artifact to ``run_job``, wall clock
+    scaled by the host's process parallelism.
+
+    ``worker_hook(pids, root)`` is the chaos/test tap, called once the
+    workers are spawned (before the go barrier releases them) — the
+    SIGSTOP chaos leg arms its watcher here. ``pin_cores`` pins worker
+    i to core ``pin_cores[i % len]`` (the fleet convention: one core
+    per worker makes a same-box N-vs-1 comparison measure scale-out,
+    not XLA's intra-op oversubscription)."""
+    from avenir_tpu.runner import (JobResult, _finish_fold, _job_cfg,
+                                   stream_fold_ops)
+
+    canonical, prefix, cfg = _job_cfg(name, conf)
+    ops = stream_fold_ops(canonical)
+    policy = policy or StragglerPolicy()
+    root = shard_root or tempfile.mkdtemp(prefix="avenir_shard_")
+    own_root = shard_root is None
+    procs = max(int(procs), 1)
+    try:
+        plan = plan_shards(list(inputs), procs, factor,
+                           policy=policy.to_dict())
+        plan.job = canonical
+        plan.prefix = prefix
+        plan.props = {k: str(v) for k, v in cfg.props.items()
+                      if k != "__job_name__"}
+        write_plan(plan, os.path.join(root, "plan.json"))
+        ledger = BlockLedger(root)
+        logs = os.path.join(root, "logs")
+        os.makedirs(logs, exist_ok=True)
+
+        workers = []
+        for w in range(procs):
+            preexec = None
+            if pin_cores and hasattr(os, "sched_setaffinity"):
+                core = pin_cores[w % len(pin_cores)]
+                preexec = (lambda c=core: os.sched_setaffinity(0, {c}))
+            log = open(os.path.join(logs, f"w{w}.log"), "ab")
+            workers.append((log, subprocess.Popen(
+                [sys.executable, "-m", "avenir_tpu.dist.worker",
+                 root, str(w)],
+                stdout=log, stderr=log, env=_worker_env(),
+                cwd=_pkg_parent(), preexec_fn=preexec)))
+        try:
+            if worker_hook is not None:
+                worker_hook([p.pid for _log, p in workers], root)
+            # boot barrier: the measured scan starts when every worker
+            # has finished its (concurrent) interpreter+jax boot — the
+            # solo arm's convention too (its child times run_job, not
+            # imports), so the A/B compares scans, not boots
+            deadline = time.perf_counter() + timeout_s
+            ready = os.path.join(root, "ready")
+            while True:
+                try:
+                    n_ready = len(os.listdir(ready))
+                except OSError:
+                    n_ready = 0
+                if n_ready >= procs:
+                    break
+                _reap_check(workers, ledger, plan, logs)
+                if time.perf_counter() > deadline:
+                    raise ShardError(
+                        f"{n_ready}/{procs} workers ready within "
+                        f"{timeout_s}s")
+                time.sleep(0.01)
+            t_scan = time.perf_counter()
+            with open(os.path.join(root, "go.tmp"), "w") as fh:
+                fh.write("go")
+            os.replace(os.path.join(root, "go.tmp"),
+                       os.path.join(root, "go"))
+
+            n_blocks = len(plan.blocks)
+            # once every block is committed, straggling workers get a
+            # BOUNDED grace to exit on their own — long enough for a
+            # woken straggler to finish its in-flight fold and record
+            # the rejected duplicate in the dedup counters, short
+            # enough that a permanently wedged worker (the failure
+            # mirroring exists to survive) cannot hold a finished scan
+            # hostage for the run timeout; past it the finally kills
+            # the stragglers and the merge proceeds
+            grace_until = None
+            while True:
+                alive = [p for _log, p in workers if p.poll() is None]
+                done = len(ledger.committed())
+                if done >= n_blocks:
+                    if not alive:
+                        break
+                    if grace_until is None:
+                        grace_until = time.perf_counter() \
+                            + policy.exit_grace_s
+                    elif time.perf_counter() > grace_until:
+                        break
+                elif not alive:
+                    _raise_workers_dead(workers, logs, done, n_blocks)
+                if time.perf_counter() > deadline:
+                    raise ShardError(
+                        f"sharded scan incomplete after {timeout_s}s "
+                        f"({done}/{n_blocks} blocks committed)")
+                time.sleep(0.02)
+        finally:
+            for log, proc in workers:
+                if proc.poll() is None:
+                    proc.kill()
+                    proc.wait()
+                log.close()
+
+        # ------------------------------------------------------- merge
+        t_merge = time.perf_counter()
+        states = {bid: ledger.load_state(bid)
+                  for bid in ledger.committed()}
+        schema = None
+        if ops.kind == "dataset":
+            from avenir_tpu.runner import _schema
+
+            schema = _schema(cfg)
+        merged = merge_block_states(canonical, cfg, ops, plan, states,
+                                    list(inputs), root, schema=schema)
+        merge_ms = (time.perf_counter() - t_merge) * 1e3
+        if output:
+            parent = os.path.dirname(os.path.abspath(output))
+            os.makedirs(parent, exist_ok=True)
+        t0 = _obs.now()
+        res = _finish_fold(merged, output, canonical)
+        _obs.record("job.dispatch", t0, mode="sharded", procs=procs,
+                    blocks=n_blocks, jobs=canonical)
+
+        stats = _worker_stats(root, procs)
+        claims = ledger.claims()
+        by_id = {b.id: b for b in plan.blocks}
+        stolen = sum(1 for bid, info in claims.items()
+                     if bid in by_id
+                     and by_id[bid].home != info["worker"])
+        res.counters["Shard:Blocks"] = float(n_blocks)
+        res.counters["Shard:StolenBlocks"] = float(stolen)
+        res.counters["Shard:DedupBlocks"] = float(ledger.dup_count())
+        res.counters["Shard:MergeMs"] = round(merge_ms, 3)
+        res.counters["Shard:ScanSeconds"] = round(
+            time.perf_counter() - t_scan, 4)
+        res.counters["Shard:Workers"] = float(procs)
+        if stats:
+            res.counters["Shard:MirroredBlocks"] = float(
+                sum(s.get("mirrored", 0) for s in stats))
+        return res
+    finally:
+        if own_root:
+            shutil.rmtree(root, ignore_errors=True)
+
+
+def _worker_stats(root: str, procs: int) -> List[Dict]:
+    out = []
+    for w in range(procs):
+        try:
+            with open(os.path.join(root, "stats", f"w{w}.json")) as fh:
+                out.append(json.load(fh))
+        except (OSError, ValueError):
+            pass                  # a killed worker writes no stats
+    return out
+
+
+def _reap_check(workers, ledger, plan, logs: str) -> None:
+    """Boot-phase liveness: a worker dead before the barrier is a
+    config error the caller must see immediately."""
+    if all(p.poll() is None for _log, p in workers):
+        return
+    _raise_workers_dead(workers, logs, len(ledger.committed()),
+                        len(plan.blocks))
+
+
+def _raise_workers_dead(workers, logs: str, done: int,
+                        n_blocks: int) -> None:
+    dead = [(i, p.returncode) for i, (_log, p) in enumerate(workers)
+            if p.poll() is not None and p.returncode != 0]
+    tails = []
+    for i, rc in dead[:2]:
+        try:
+            with open(os.path.join(logs, f"w{i}.log"), "rb") as fh:
+                tails.append(f"w{i} rc={rc}: "
+                             + fh.read()[-800:].decode("utf-8", "replace"))
+        except OSError:
+            tails.append(f"w{i} rc={rc}: <no log>")
+    raise ShardError(
+        f"sharded scan lost its workers with {done}/{n_blocks} blocks "
+        f"committed; dead={[(i, rc) for i, rc in dead]}\n"
+        + "\n".join(tails))

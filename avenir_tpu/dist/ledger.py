@@ -1,0 +1,215 @@
+"""Block ledger: filesystem claims and first-commit-wins block states.
+
+The sharded driver's one hard invariant comes from the merge auditor's
+overlap probe: EVERY registered fold family is NON-idempotent (re-folding
+a block changes the output), so redundant execution — the whole point of
+over-partitioning and straggler mirroring — must dedup at block
+granularity BEFORE the fold. The ledger is where that happens, with the
+same single-writer filesystem discipline as ``net/fault.py`` leases:
+
+- **Claims** (``claims/b<id>.json``): a worker claims a block by writing
+  the claim JSON to a tmp file and hard-LINKING it into place —
+  ``os.link`` fails with EEXIST when a claim already exists, so exactly
+  one of N racing workers wins, and because the tmp file is complete
+  before the link, a reader can never see a torn claim from this path.
+  A claim that IS torn (external truncation, a crashed hand-rolled
+  writer) is treated as unclaimed: the first worker to notice renames
+  it aside (atomic — exactly one renamer succeeds) and re-claims.
+- **Commits** (``states/b<id>.npz``): the serialized fold state itself
+  is the commit record, published the same tmp+link way. The FIRST
+  commit wins; a duplicate commit of the same block id — a mirrored
+  straggler block finishing twice, a SIGCONT'd worker completing work
+  someone already re-did — is REJECTED (EEXIST), counted, and recorded
+  as a ``dups/`` marker. The coordinator merges exactly one state per
+  block id, in plan order: a block folds into the final state exactly
+  once, never twice.
+
+Everything is observable from ``ls``: claims say who owes which block
+(and since when — the straggler detector's input), states say what is
+done, dups say the dedup fired. No daemon, no lock server; rename and
+link on one filesystem are the whole coordination substrate, exactly
+like the fleet's spool and lease files.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+import uuid
+from typing import Dict, List, Optional
+
+
+class BlockLedger:
+    """Claim/commit ledger for one sharded run, rooted at
+    ``<root>/ledger``. Safe for concurrent use by any number of worker
+    processes on one filesystem."""
+
+    def __init__(self, root: str):
+        self.root = os.path.join(root, "ledger")
+        self.claims_dir = os.path.join(self.root, "claims")
+        self.states_dir = os.path.join(self.root, "states")
+        self.dups_dir = os.path.join(self.root, "dups")
+        for d in (self.claims_dir, self.states_dir, self.dups_dir):
+            os.makedirs(d, exist_ok=True)
+
+    # ---------------------------------------------------------- claims
+    def claim_path(self, block_id: int) -> str:
+        return os.path.join(self.claims_dir, f"b{block_id}.json")
+
+    def claim(self, block_id: int, worker: int,
+              mirror: bool = False) -> bool:
+        """Atomically claim a block; True when THIS call won. ``mirror``
+        marks a redundant re-dispatch claim record (informational — a
+        mirror does not take the claim, it races the commit; the flag
+        only lands in the claim file when the mirrorer claims an
+        abandoned, never-claimed block)."""
+        path = self.claim_path(block_id)
+        tmp = os.path.join(self.claims_dir,
+                           f".tmp.b{block_id}.{uuid.uuid4().hex}")
+        with open(tmp, "w") as fh:
+            json.dump({"block": block_id, "worker": worker,
+                       "claimed_at": time.time(), "mirror": mirror}, fh)
+        try:
+            for _ in range(8):
+                try:
+                    os.link(tmp, path)
+                    return True
+                except FileExistsError:
+                    if self.claim_info(block_id) is not None:
+                        return False          # a well-formed claim holds
+                    # torn claim: treated as unclaimed. Exactly one
+                    # worker wins the rename-aside; the loser re-loads
+                    # and either sees the winner's fresh claim or races
+                    # the next link round.
+                    torn = f"{path}.torn.{uuid.uuid4().hex}"
+                    try:
+                        os.rename(path, torn)
+                    except OSError:
+                        pass
+            return False
+        finally:
+            try:
+                os.remove(tmp)
+            except OSError:
+                pass
+
+    def claim_info(self, block_id: int) -> Optional[Dict]:
+        """The claim record, or None when unclaimed OR torn (an
+        unparseable claim is by contract not a claim)."""
+        try:
+            with open(self.claim_path(block_id)) as fh:
+                obj = json.load(fh)
+            return {"block": int(obj["block"]),
+                    "worker": int(obj["worker"]),
+                    "claimed_at": float(obj["claimed_at"]),
+                    "mirror": bool(obj.get("mirror", False))}
+        except (OSError, ValueError, KeyError, TypeError):
+            return None
+
+    def claims(self) -> Dict[int, Dict]:
+        out: Dict[int, Dict] = {}
+        try:
+            names = os.listdir(self.claims_dir)
+        except OSError:
+            return out
+        for n in names:
+            if not n.startswith("b") or not n.endswith(".json"):
+                continue
+            try:
+                bid = int(n[1:-5])
+            except ValueError:
+                continue
+            info = self.claim_info(bid)
+            if info is not None:
+                out[bid] = info
+        return out
+
+    # --------------------------------------------------------- commits
+    def state_path(self, block_id: int) -> str:
+        return os.path.join(self.states_dir, f"b{block_id}.npz")
+
+    def commit(self, block_id: int, worker: int, blob: bytes) -> bool:
+        """Publish a block's serialized fold state, FIRST COMMIT WINS.
+        Returns True when this state is the one the coordinator will
+        merge; False when the block was already committed — the
+        duplicate is rejected (never merged: the fold families are
+        non-idempotent) and recorded under ``dups/``."""
+        path = self.state_path(block_id)
+        tmp = os.path.join(self.states_dir,
+                           f".tmp.b{block_id}.{uuid.uuid4().hex}")
+        with open(tmp, "wb") as fh:
+            fh.write(blob)
+        try:
+            os.link(tmp, path)
+            return True
+        except FileExistsError:
+            self._mark_dup(block_id, worker)
+            return False
+        finally:
+            try:
+                os.remove(tmp)
+            except OSError:
+                pass
+
+    def _mark_dup(self, block_id: int, worker: int) -> None:
+        """Record one rejected duplicate commit — worker-namespaced so
+        concurrent losers never race one file, atomic so the
+        coordinator's count never reads a torn marker."""
+        path = os.path.join(self.dups_dir, f"b{block_id}.w{worker}.json")
+        tmp = f"{path}.tmp.{uuid.uuid4().hex}"
+        with open(tmp, "w") as fh:
+            json.dump({"block": block_id, "worker": worker,
+                       "rejected_at": time.time()}, fh)
+        os.replace(tmp, path)
+
+    def load_state(self, block_id: int) -> bytes:
+        with open(self.state_path(block_id), "rb") as fh:
+            return fh.read()
+
+    def committed(self) -> List[int]:
+        try:
+            names = os.listdir(self.states_dir)
+        except OSError:
+            return []
+        out = []
+        for n in names:
+            if n.startswith("b") and n.endswith(".npz"):
+                try:
+                    out.append(int(n[1:-4]))
+                except ValueError:
+                    pass
+        return sorted(out)
+
+    def dup_count(self) -> int:
+        try:
+            return sum(1 for n in os.listdir(self.dups_dir)
+                       if n.endswith(".json"))
+        except OSError:
+            return 0
+
+    # ------------------------------------------------------- summaries
+    def pending(self, n_blocks: int) -> List[int]:
+        """Block ids not yet committed."""
+        done = set(self.committed())
+        return [b for b in range(n_blocks) if b not in done]
+
+    def unclaimed(self, n_blocks: int) -> List[int]:
+        """Block ids with neither a (well-formed) claim nor a commit."""
+        done = set(self.committed())
+        claimed = set(self.claims())
+        return [b for b in range(n_blocks)
+                if b not in done and b not in claimed]
+
+    def stale_claims(self, n_blocks: int, older_than_s: float,
+                     now: Optional[float] = None) -> List[int]:
+        """Claimed-but-uncommitted block ids whose claim is older than
+        ``older_than_s`` — the straggler detector's candidates for
+        redundant re-dispatch, oldest first."""
+        now = time.time() if now is None else now
+        done = set(self.committed())
+        rows = [(info["claimed_at"], bid)
+                for bid, info in self.claims().items()
+                if bid not in done
+                and now - info["claimed_at"] > older_than_s]
+        return [bid for _t, bid in sorted(rows)]

@@ -1,0 +1,206 @@
+"""Shard planner: over-partition inputs into newline-aligned blocks.
+
+The multi-process streaming driver (:mod:`avenir_tpu.dist.driver`) does
+not hand each worker one fixed split — that is exactly the layout a
+single slow worker turns into a tail. Instead every input is cut into
+``factor`` × ``procs`` blocks (the over-partitioning "Leveraging Coding
+Techniques for Speeding up Distributed Computing", arXiv:1802.03049,
+grounds: a finer work unit is what makes redundant tail execution cheap)
+and workers CLAIM blocks through the block ledger — home blocks first,
+then the unclaimed tail of slower workers.
+
+Blocks are **newline-aligned**: each boundary is advanced to just past
+the next ``\\n`` at or after its nominal ceil-division position
+(``core.stream.split_byte_ranges``), so a block's byte range contains
+exactly whole lines, the ranges tile ``[0, size)`` gap-free, and a
+block's bytes can be sliced verbatim out of the input (the driver
+materializes such slices for the miners' per-k re-parse). A corpus
+whose last line has no trailing newline, a corpus smaller than the
+block count (trailing empty blocks), and a single-line corpus are all
+legal plans — the same edge set the split arithmetic is
+regression-tested on.
+
+The plan is written as ONE atomic JSON manifest (tmp+rename, the spool
+discipline) that workers — separate processes with no other channel —
+load to learn the job, its config, the block table and the straggler
+policy. The manifest is the unit of auditability: ``plan.json`` under
+the shard root says exactly which byte range every block id means, and
+the ledger next to it says who folded it.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from avenir_tpu.core.stream import split_byte_ranges
+
+#: default over-partitioning: blocks per worker. 4x keeps the steal/
+#: mirror unit at ~25% of a worker's share — fine enough that a dead
+#: worker strands little, coarse enough that per-block fold + serialize
+#: overhead stays amortized.
+DEFAULT_FACTOR = 4
+
+#: how far past a nominal boundary the aligner will scan for a newline
+#: before giving up and taking EOF — a single line longer than this is
+#: pathological for a line-oriented corpus (64MB, one default block)
+_ALIGN_SCAN_BYTES = 64 << 20
+
+
+class PlanError(ValueError):
+    """A shard plan that cannot be built or loaded."""
+
+
+@dataclass(frozen=True)
+class ShardBlock:
+    """One claimable unit of work: a newline-aligned byte range of one
+    input file, with a deterministic ``home`` worker (the worker that
+    folds it when nobody is slow; any worker may steal it from the
+    unclaimed tail)."""
+
+    id: int
+    input: int          # index into ShardPlan.inputs
+    start: int
+    end: int
+    home: int
+
+    def to_dict(self) -> Dict:
+        return {"id": self.id, "input": self.input, "start": self.start,
+                "end": self.end, "home": self.home}
+
+    @classmethod
+    def from_dict(cls, obj: Dict) -> "ShardBlock":
+        return cls(id=int(obj["id"]), input=int(obj["input"]),
+                   start=int(obj["start"]), end=int(obj["end"]),
+                   home=int(obj["home"]))
+
+
+@dataclass
+class ShardPlan:
+    """The atomic plan manifest: inputs (path + size, so a worker can
+    detect a corpus that changed under the plan), the job and its
+    prefixed properties, the block table, and the straggler policy
+    knobs. ``blocks`` is in PLAN ORDER — the order the coordinator
+    merges committed block states in, which is what makes the sharded
+    artifact byte-identical to the solo scan under the proven merge
+    algebra."""
+
+    procs: int
+    factor: int
+    job: str = ""
+    prefix: str = ""
+    props: Dict[str, str] = field(default_factory=dict)
+    inputs: List[Dict] = field(default_factory=list)
+    blocks: List[ShardBlock] = field(default_factory=list)
+    policy: Dict[str, float] = field(default_factory=dict)
+
+    def input_paths(self) -> List[str]:
+        return [str(i["path"]) for i in self.inputs]
+
+    def blocks_for(self, worker: int) -> List[ShardBlock]:
+        return [b for b in self.blocks if b.home == worker]
+
+    def to_dict(self) -> Dict:
+        return {"procs": self.procs, "factor": self.factor,
+                "job": self.job, "prefix": self.prefix,
+                "props": dict(self.props),
+                "inputs": [dict(i) for i in self.inputs],
+                "blocks": [b.to_dict() for b in self.blocks],
+                "policy": dict(self.policy)}
+
+    @classmethod
+    def from_dict(cls, obj: Dict) -> "ShardPlan":
+        return cls(procs=int(obj["procs"]), factor=int(obj["factor"]),
+                   job=str(obj.get("job", "")),
+                   prefix=str(obj.get("prefix", "")),
+                   props=dict(obj.get("props", {})),
+                   inputs=[dict(i) for i in obj.get("inputs", [])],
+                   blocks=[ShardBlock.from_dict(b)
+                           for b in obj.get("blocks", [])],
+                   policy=dict(obj.get("policy", {})))
+
+
+def _align_boundaries(path: str, size: int, n: int) -> List[Tuple[int, int]]:
+    """Newline-aligned [lo, hi) ranges tiling ``[0, size)``: nominal
+    ceil-division bounds, each interior boundary advanced to one past
+    the next ``\\n`` at or after it. Boundaries that run out of
+    newlines collapse onto ``size`` — trailing empty ranges tile
+    gap-free, exactly like ``split_byte_ranges`` on a corpus smaller
+    than the split count."""
+    nominal = split_byte_ranges(size, n)
+    cuts = [0]
+    with open(path, "rb") as fh:
+        for _lo, hi in nominal[:-1]:
+            b = max(hi, cuts[-1])
+            if b >= size:
+                cuts.append(size)
+                continue
+            fh.seek(b)
+            scanned = 0
+            nl = -1
+            while scanned < _ALIGN_SCAN_BYTES:
+                buf = fh.read(min(1 << 16, _ALIGN_SCAN_BYTES - scanned))
+                if not buf:
+                    break
+                nl = buf.find(b"\n")
+                if nl >= 0:
+                    nl = b + scanned + nl
+                    break
+                scanned += len(buf)
+                nl = -1
+            cuts.append(size if nl < 0 else min(nl + 1, size))
+    cuts.append(size)
+    return list(zip(cuts[:-1], cuts[1:]))
+
+
+def plan_shards(inputs: Sequence[str], procs: int,
+                factor: int = DEFAULT_FACTOR,
+                policy: Optional[Dict[str, float]] = None) -> ShardPlan:
+    """Build the over-partitioned plan: every input cut into
+    ``procs * factor`` newline-aligned blocks, block ids global in
+    (input, offset) order, homes assigned as CONTIGUOUS runs per input
+    (worker w's home blocks are one disk-sequential stretch; the steal
+    path is what breaks contiguity, and only when someone is slow)."""
+    if procs < 1:
+        raise PlanError(f"procs must be positive, got {procs}")
+    if factor < 1:
+        raise PlanError(f"factor must be positive, got {factor}")
+    if not inputs:
+        raise PlanError("shard plan needs at least one input")
+    plan = ShardPlan(procs=procs, factor=factor,
+                     policy=dict(policy or {}))
+    bid = 0
+    for ii, path in enumerate(inputs):
+        if not os.path.exists(path):
+            raise PlanError(f"no such input file: {path!r}")
+        size = os.path.getsize(path)
+        plan.inputs.append({"path": os.path.abspath(path), "size": size})
+        n = procs * factor
+        ranges = _align_boundaries(path, size, n)
+        for j, (lo, hi) in enumerate(ranges):
+            # contiguous home runs: blocks [w*factor, (w+1)*factor) of
+            # this input belong to worker w
+            plan.blocks.append(ShardBlock(
+                id=bid, input=ii, start=lo, end=hi, home=j // factor))
+            bid += 1
+    return plan
+
+
+def write_plan(plan: ShardPlan, path: str) -> str:
+    """Atomically publish the plan manifest (tmp+rename): a reader
+    either sees no plan or a complete one, never a torn table."""
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w") as fh:
+        json.dump(plan.to_dict(), fh, indent=1)
+    os.replace(tmp, path)
+    return path
+
+
+def load_plan(path: str) -> ShardPlan:
+    try:
+        with open(path) as fh:
+            return ShardPlan.from_dict(json.load(fh))
+    except (OSError, ValueError, KeyError) as e:
+        raise PlanError(f"cannot load shard plan {path!r}: {e}") from e

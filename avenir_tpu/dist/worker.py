@@ -1,0 +1,221 @@
+"""Sharded-scan worker process: claim blocks, fold, commit states.
+
+``python -m avenir_tpu.dist.worker <shard-root> <worker-id>`` — spawned
+by :func:`avenir_tpu.dist.driver.run_sharded`, one process per worker.
+The loop:
+
+1. **Boot barrier** — write ``ready/w<i>`` once imports and the plan
+   load are done, then wait for the coordinator's ``go`` file. The
+   measured sharded wall starts at ``go``, so interpreter/jax boot
+   (paid once per worker, concurrently) never skews the scan A/B — the
+   same protocol the fleet tripwire uses with its warmup requests.
+2. **Home blocks** — claim and fold this worker's contiguous home run
+   first (disk-sequential reads).
+3. **Steal the tail** — when the home run is done, claim from the
+   global unclaimed tail: a fast worker absorbs a slow one's
+   never-started blocks with zero redundancy.
+4. **Mirror stragglers** — when nothing is unclaimed but blocks remain
+   uncommitted, consult the straggler detector: this worker's own
+   per-block telemetry (``stream.read/parse/fold`` spans →
+   :func:`avenir_tpu.tune.signals.extract_signals`) prices a block, and
+   a peer's claim older than the policy multiple is folded REDUNDANTLY.
+   The block ledger's first-commit-wins keeps the fold-exactly-once
+   invariant; the rejected duplicate lands in ``Shard:DedupBlocks``.
+
+Every block folds through the REAL streamed machinery: the registered
+``StreamFoldOps`` factory builds the sink, ``SharedScan`` drives it (one
+instrumentation point with the solo/fused/incremental paths), and the
+carry crosses processes via the registered ``serialize_state`` — the
+same ops the graftlint --merge auditor proves byte-exact every round.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+from typing import List, Optional
+
+from avenir_tpu import obs as _obs
+from avenir_tpu.dist.detect import StragglerPolicy, mirror_after_s
+from avenir_tpu.dist.ledger import BlockLedger
+from avenir_tpu.dist.plan import ShardBlock, ShardPlan, load_plan
+
+#: test-only chaos hook (cross-process, so an env var): "worker:block:secs"
+#: makes that worker sleep that long after CLAIMING the block and before
+#: folding it — a deterministic straggler for the dedup tests; the
+#: SIGSTOP chaos leg in bench_scaling.shard_tripwire stays signal-driven
+_HOLD_ENV = "AVENIR_SHARD_TEST_HOLD"
+
+#: the fold families whose finish() re-scans their inputs (the miners'
+#: per-k passes): their per-block states must be restored against a
+#: per-block SLICE of the corpus, not the whole file — see
+#: driver._restore_inputs
+RESCAN_AT_FINISH = ("frequentItemsApriori", "candidateGenerationWithSelfJoin")
+
+
+def fold_block(canonical: str, cfg, ops, schema, inputs: List[str],
+               path: str, start: int, end: int):
+    """Fold ONE plan block — the byte range ``[start, end)`` of
+    ``path`` — through the registered fold sink, and return the fed
+    fold. Newline-aligned plan blocks make the range self-contained:
+    the LineRecordReader contract in the readers degrades to a plain
+    slice read. Shared by the worker loop and the graftlint --merge
+    sharded-steal leg, so the audited fold path IS the production
+    one."""
+    from avenir_tpu.core.stream import CsvBlockReader, iter_byte_blocks
+    from avenir_tpu.runner import _drive_fold
+
+    fold = ops.factory(cfg, list(inputs), schema)
+    block_bytes = int(cfg.get_float("stream.block.size.mb", 64.0)
+                      * (1 << 20))
+    if ops.kind == "dataset":
+        chunks = iter(CsvBlockReader(path, schema, cfg.field_delim_regex,
+                                     block_bytes, byte_range=(start, end)))
+    else:
+        chunks = iter_byte_blocks(path, block_bytes,
+                                  byte_range=(start, end))
+    _drive_fold(fold, chunks, canonical)
+    return fold
+
+
+def _hold(worker: int, block_id: int) -> None:
+    spec = os.environ.get(_HOLD_ENV, "")
+    try:
+        w, b, secs = spec.split(":")
+        if int(w) == worker and int(b) == block_id:
+            time.sleep(float(secs))
+    except ValueError:
+        pass
+
+
+class _Worker:
+    def __init__(self, root: str, worker: int):
+        self.root = root
+        self.worker = worker
+        self.plan: ShardPlan = load_plan(os.path.join(root, "plan.json"))
+        self.policy = StragglerPolicy.from_dict(self.plan.policy)
+        self.ledger = BlockLedger(root)
+        self.stats = {"worker": worker, "claimed": 0, "stolen": 0,
+                      "mirrored": 0, "dedup_rejected": 0, "folded": 0,
+                      "scan_s": 0.0}
+        from avenir_tpu.runner import _job_cfg, stream_fold_ops
+
+        self.canonical, self.prefix, cfg = _job_cfg(self.plan.job,
+                                                    dict(self.plan.props))
+        self.ops = stream_fold_ops(self.canonical)
+        if self.canonical in RESCAN_AT_FINISH:
+            # per-block folds never run per-k passes here (the
+            # coordinator does, over restored states) — spilling an
+            # encoded-block cache per block would be pure waste
+            cfg.props[f"{self.prefix}.stream.encoded.cache"] = "false"
+        self.cfg = cfg
+        self.schema = None
+        if self.ops.kind == "dataset":
+            from avenir_tpu.runner import _schema
+
+            self.schema = _schema(cfg)
+        self.inputs = self.plan.input_paths()
+
+    # ------------------------------------------------------- lifecycle
+    def barrier(self, timeout_s: float = 300.0) -> None:
+        ready = os.path.join(self.root, "ready")
+        os.makedirs(ready, exist_ok=True)
+        marker = os.path.join(ready, f"w{self.worker}")
+        with open(marker + ".tmp", "w") as fh:
+            fh.write(str(os.getpid()))
+        os.replace(marker + ".tmp", marker)
+        deadline = time.perf_counter() + timeout_s
+        go = os.path.join(self.root, "go")
+        while not os.path.exists(go):
+            if time.perf_counter() > deadline:
+                raise RuntimeError(
+                    f"worker {self.worker}: no go signal in {timeout_s}s")
+            time.sleep(0.01)
+
+    def write_stats(self, signals) -> None:
+        self.stats["signals"] = signals.to_json()
+        path = os.path.join(self.root, "stats", f"w{self.worker}.json")
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        tmp = f"{path}.tmp"
+        with open(tmp, "w") as fh:
+            json.dump(self.stats, fh)
+        os.replace(tmp, path)
+
+    # ------------------------------------------------------- fold path
+    def _fold_and_commit(self, blk: ShardBlock) -> None:
+        src = self.plan.inputs[blk.input]["path"]
+        fold = fold_block(self.canonical, self.cfg, self.ops, self.schema,
+                          self.inputs, src, blk.start, blk.end)
+        blob = self.ops.serialize_state(fold)
+        if self.ledger.commit(blk.id, self.worker, blob):
+            self.stats["folded"] += 1
+        else:
+            self.stats["dedup_rejected"] += 1
+
+    def _next_unclaimed(self) -> Optional[ShardBlock]:
+        """Home blocks first, then the global unclaimed tail (a
+        steal)."""
+        by_id = {b.id: b for b in self.plan.blocks}
+        done = set(self.ledger.committed())
+        claims = self.ledger.claims()
+        home = [b.id for b in self.plan.blocks if b.home == self.worker]
+        tail = [b.id for b in self.plan.blocks if b.home != self.worker]
+        for bid in home + tail:
+            if bid in done or bid in claims:
+                continue
+            if self.ledger.claim(bid, self.worker):
+                blk = by_id[bid]
+                self.stats["claimed"] += 1
+                if blk.home != self.worker:
+                    self.stats["stolen"] += 1
+                return blk
+        return None
+
+    def run(self) -> None:
+        self.barrier()
+        n_blocks = len(self.plan.blocks)
+        by_id = {b.id: b for b in self.plan.blocks}
+        t_run = time.perf_counter()
+        with _obs.capture() as rec:
+            from avenir_tpu.tune.signals import extract_signals
+
+            while True:
+                blk = self._next_unclaimed()
+                if blk is not None:
+                    _hold(self.worker, blk.id)
+                    self._fold_and_commit(blk)
+                    continue
+                pending = self.ledger.pending(n_blocks)
+                if not pending:
+                    break
+                # nothing unclaimed, blocks outstanding: the straggler
+                # detector prices a block from THIS worker's telemetry
+                # and mirrors any claim older than the policy multiple
+                signals = extract_signals(rec.spans())
+                if self.policy.mirror:
+                    threshold = mirror_after_s(self.policy, signals,
+                                               self.stats["folded"])
+                    stale = self.ledger.stale_claims(n_blocks, threshold)
+                    claims = self.ledger.claims()   # ONE snapshot
+                    stale = [b for b in stale
+                             if (claims.get(b) or {})
+                             .get("worker") != self.worker]
+                    if stale:
+                        self.stats["mirrored"] += 1
+                        self._fold_and_commit(by_id[stale[0]])
+                        continue
+                time.sleep(self.policy.poll_s)
+            self.stats["scan_s"] = round(time.perf_counter() - t_run, 4)
+            self.write_stats(extract_signals(rec.spans()))
+
+
+def worker_main(argv) -> int:
+    root, worker = argv[0], int(argv[1])
+    _Worker(root, worker).run()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(worker_main(sys.argv[1:]))

@@ -50,11 +50,16 @@ def global_mesh(model_parallel: int = 1) -> Mesh:
 
 def host_shard_bounds(n_rows_global: int) -> tuple:
     """[lo, hi) row range this host should ingest — the input-split
-    assignment, contiguous per process."""
+    assignment, contiguous per process. Delegates to the ONE copy of
+    the split arithmetic (core.stream.split_byte_ranges), so the
+    boundary edges the shard planner and this path share — corpus
+    smaller than the process count (trailing empty shards tile
+    gap-free), single-line corpus, no trailing newline — are fixed and
+    regression-tested in one place."""
+    from avenir_tpu.core.stream import split_byte_ranges
+
     p, i = jax.process_count(), jax.process_index()
-    per = (n_rows_global + p - 1) // p
-    lo = min(i * per, n_rows_global)
-    return lo, min(lo + per, n_rows_global)
+    return split_byte_ranges(n_rows_global, p)[i]
 
 
 def host_csv_byte_range(path: str) -> tuple:

@@ -3281,6 +3281,13 @@ def run_from_cli(argv: Sequence[str]) -> JobResult:
                     help="delta-scan a streamed job: restore the last "
                          "fold-state checkpoint and fold only appended "
                          "blocks (run_incremental)")
+    ap.add_argument("--shard", type=int, default=0, metavar="N",
+                    help="run a streamed job's scan across N worker "
+                         "processes: over-partitioned byte-range blocks "
+                         "claimed through the first-commit-wins block "
+                         "ledger, merged via the registered fold-state "
+                         "algebra (avenir_tpu.dist.run_sharded); "
+                         "byte-identical to the solo scan")
     ap.add_argument("--autotune", action="store_true",
                     help="close the telemetry loop: apply the profile "
                          "store's tuned knobs to this run and record its "
@@ -3316,8 +3323,23 @@ def run_from_cli(argv: Sequence[str]) -> JobResult:
     short = args.jobname.rsplit(".", 1)[-1]
     name = args.jobname if args.jobname in _REGISTRY else short[0].lower() + short[1:]
     inputs, output = args.paths[:-1], args.paths[-1]
-    runner = run_incremental if args.incremental else run_job
-    res = runner(name, props, inputs, output)
+    if args.shard and args.incremental:
+        ap.error("--shard and --incremental are different drivers; "
+                 "pick one (a sharded refresh is a ROADMAP item)")
+    if args.shard and args.autotune:
+        # the sharded driver does not consult the profile store yet;
+        # accepting the flag would silently tune nothing — the same
+        # loud-over-silent contract the knob guard holds everywhere
+        ap.error("--shard does not support --autotune yet; the sharded "
+                 "driver applies no tuned knobs")
+    if args.shard:
+        from avenir_tpu.dist import run_sharded
+
+        res = run_sharded(name, props, inputs, output,
+                          procs=args.shard)
+    else:
+        runner = run_incremental if args.incremental else run_job
+        res = runner(name, props, inputs, output)
     print(json.dumps({"job": res.name, "counters": res.counters,
                       "outputs": res.outputs}))
     return res

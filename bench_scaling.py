@@ -93,6 +93,16 @@ def graftlint_tripwire() -> dict:
     merge_rep = run(["--merge"], "--merge")
     ma = merge_rep["merge_audit"]
     unmerged = [r["kernel"] for r in ma if not r["merge_validated"]]
+    # the sharded-steal leg of the same audit: a boundary block folded
+    # through two workers' ledgers must commit exactly once (duplicate
+    # rejected first-commit-wins) and merge to the cold bytes — the
+    # avenir-shard dedup contract, 8/8 every round
+    undeduped = [r["kernel"] for r in ma
+                 if not r.get("shard_dedup_validated")]
+    if undeduped:
+        raise RuntimeError(
+            f"sharded-steal dedup audit regression: a redundantly "
+            f"folded block double-committed or drifted for {undeduped}")
     # same >= 8 floor: every streamed fold kernel (solo + fused) must
     # re-prove its shard-merge + checkpoint-resume byte-identity per
     # round — the standing gate the resumable-scan and multi-host
@@ -147,6 +157,7 @@ def graftlint_tripwire() -> dict:
             "merge_allowlisted": merge_rep["suppressed"],
             "merge_kernels_validated": len(ma),
             "incremental_kernels_validated": len(ma) - len(unincr),
+            "shard_dedup_validated": len(ma) - len(undeduped),
             "span_coverage_validated": len(cov),
             "memory_manifest": "MEMORY_MANIFEST.json"}
 
@@ -1299,6 +1310,267 @@ def fleet_fault_tripwire(rows: int = 10_000_000,
         shutil.rmtree(d, ignore_errors=True)
 
 
+def shard_tripwire(rows: int = 10_000_000, floor: float = 1.5,
+                   parallel_efficiency_floor: float = 0.75) -> dict:
+    """avenir-shard tripwire: the multi-process sharded streaming
+    driver must reproduce the solo runner byte-for-byte AND scale with
+    the box. Three legs:
+
+    **Byte-identity + speedup** — for TWO fold families (one
+    Dataset-chunk: mutualInformation over the churn corpus; one
+    raw-byte-block: markovStateTransitionModel over the sequence
+    corpus), the solo runner executes in a pinned one-core child (its
+    recorded seconds exclude interpreter/jax boot — the
+    stream_scale_check child convention) and ``run_sharded(procs=2)``
+    runs with each worker pinned to its own core, its scan clock
+    starting at the workers' go barrier (boot paid concurrently, off
+    the clock — the fleet warmup convention). Artifacts must be
+    byte-identical per family; the GEOMEAN speedup is held to
+    ``min(floor, capacity * parallel_efficiency_floor)`` with the box's
+    2-process capacity probed on both sides and the min taken, and the
+    throughput gate arms only where capacity >= 1.7 — the PR-12
+    convention: no software runs two workers 1.5x faster than one on
+    ~1.3 steal-throttled cores, so there the numbers bank as evidence.
+
+    **SIGSTOP chaos** — one worker is stopped the moment it holds an
+    uncommitted claim: the survivor steals the unclaimed tail, the
+    straggler detector prices the stalled claim off the survivor's own
+    span telemetry and redundantly re-dispatches it, and after SIGCONT
+    the woken worker's late commit is REJECTED first-commit-wins.
+    Asserted: every block committed (zero lost), ``Shard:DedupBlocks
+    >= 1`` (the dedup actually fired), bytes identical to solo.
+    """
+    import os
+    import shutil
+    import signal
+    import threading
+    import time
+
+    from avenir_tpu.data import churn_schema, generate_churn
+    from avenir_tpu.dist import StragglerPolicy, run_sharded
+
+    d = tempfile.mkdtemp(prefix="avenir_shard_tripwire_")
+    try:
+        churn = os.path.join(d, "churn.csv")
+        blob = generate_churn(100_000, seed=51, as_csv=True)
+        with open(churn, "w") as fh:
+            for _ in range(max(rows // 100_000, 1)):
+                fh.write(blob)
+        schema = os.path.join(d, "churn.json")
+        churn_schema().save(schema)
+        seq = os.path.join(d, "seq.csv")
+        seq_blob = "".join(
+            f"c{i},{'T' if i % 2 else 'F'},L,M,H,M,L\n"
+            for i in range(100_000))
+        with open(seq, "w") as fh:
+            for _ in range(max(rows // 100_000, 1)):
+                fh.write(seq_blob)
+
+        families = [
+            ("mutualInformation",
+             {"mut.feature.schema.file.path": schema,
+              "mut.mutual.info.score.algorithms":
+                  "mutual.info.maximization"}, churn),
+            ("markovStateTransitionModel",
+             {"mst.model.states": "L,M,H",
+              "mst.class.label.field.ord": "1",
+              "mst.skip.field.count": "2", "mst.class.labels": "T,F"},
+             seq),
+        ]
+        n_cores = os.cpu_count() or 2
+        pin = [i % n_cores for i in range(2)]
+
+        def solo_child(job, conf, inp, out) -> float:
+            """Solo arm in a fresh child pinned to ONE core: prints the
+            run_job seconds (imports excluded — the established child
+            protocol), so both arms compare scans, not boots."""
+            import subprocess
+            import sys as _sys
+
+            code = (
+                "import json, sys, time\n"
+                "sys.path.insert(0, '.')\n"
+                "import jax\n"
+                "jax.config.update('jax_platforms', 'cpu')\n"
+                "from avenir_tpu.runner import run_job\n"
+                "job, conf, inp, out = (sys.argv[1], json.loads(sys.argv[2]),"
+                " sys.argv[3], sys.argv[4])\n"
+                "t0 = time.perf_counter()\n"
+                "run_job(job, conf, [inp], out)\n"
+                "print(json.dumps({'seconds': time.perf_counter() - t0}))\n")
+            preexec = None
+            if hasattr(os, "sched_setaffinity"):
+                preexec = lambda: os.sched_setaffinity(0, {pin[0]})  # noqa: E731
+            proc = subprocess.run(
+                [_sys.executable, "-c", code, job, json.dumps(conf),
+                 inp, out],
+                capture_output=True, text=True, timeout=7200,
+                env=dict(os.environ, AVENIR_SKIP_DEVICE_PROBE="1"),
+                preexec_fn=preexec)
+            if proc.returncode != 0:
+                raise RuntimeError(
+                    f"solo {job} failed: {proc.stderr[-500:]}")
+            return float(json.loads(
+                proc.stdout.strip().splitlines()[-1])["seconds"])
+
+        import contextlib
+
+        try:
+            from bench import _host_core_lock
+        except ImportError:
+            _host_core_lock = contextlib.nullcontext
+
+        speedups, rows_out = [], {}
+        with _host_core_lock():
+            cap_before = host_parallel_capacity(2)
+            for job, conf, inp in families:
+                solo_out = os.path.join(d, f"solo_{job}")
+                solo_s = solo_child(job, conf, inp, solo_out)
+                res = run_sharded(job, conf, [inp],
+                                  os.path.join(d, f"shard_{job}"),
+                                  procs=2, pin_cores=pin)
+                shard_s = float(res.counters["Shard:ScanSeconds"])
+                # byte-identity per family (miner-style multi-file
+                # outputs compare sorted, like every other tripwire)
+                solo_files = ([solo_out] if os.path.isfile(solo_out)
+                              else sorted(
+                                  os.path.join(solo_out, f)
+                                  for f in os.listdir(solo_out)))
+                if len(solo_files) != len(res.outputs):
+                    raise RuntimeError(
+                        f"sharded {job} wrote {len(res.outputs)} "
+                        f"outputs, solo wrote {len(solo_files)} — the "
+                        f"zip below would silently skip the difference")
+                for pa, pb in zip(solo_files, sorted(res.outputs)):
+                    with open(pa, "rb") as fa, open(pb, "rb") as fb:
+                        if fa.read() != fb.read():
+                            raise RuntimeError(
+                                f"sharded {job} artifact differs from "
+                                f"its solo twin ({pb} vs {pa})")
+                speedups.append(solo_s / max(shard_s, 1e-9))
+                rows_out[job] = {
+                    "solo_seconds": round(solo_s, 2),
+                    "sharded_seconds": round(shard_s, 2),
+                    "speedup": round(solo_s / max(shard_s, 1e-9), 2),
+                    "counters": {k: v for k, v in res.counters.items()
+                                 if k.startswith("Shard:")}}
+            capacity = min(cap_before, host_parallel_capacity(2))
+
+        speedup = float((speedups[0] * speedups[1]) ** 0.5)
+        effective_floor = min(floor, capacity * parallel_efficiency_floor)
+        throughput_gated = capacity >= 1.7
+        if throughput_gated and speedup < effective_floor:
+            raise RuntimeError(
+                f"2-process sharded scan only {speedup:.2f}x solo "
+                f"(floor {effective_floor:.2f}x = min({floor}, "
+                f"{capacity:.2f} capacity * {parallel_efficiency_floor}); "
+                f"per-family {[round(s, 2) for s in speedups]}) — "
+                f"shard scale-out regressed")
+
+        # ---------------------------------------------- SIGSTOP chaos
+        job, conf, inp = families[0]
+        stopped: dict = {}
+        watch_stop = threading.Event()
+
+        def chaos_hook(pids, root):
+            # the driver's test tap only HANDS the watcher its targets;
+            # the thread itself is owned (started, joined bounded) by
+            # the tripwire body below
+            stopped["pids"] = pids
+            stopped["root"] = root
+
+        def watch():
+            from avenir_tpu.dist import BlockLedger, load_plan
+
+            while "root" not in stopped:
+                if watch_stop.wait(0.002):
+                    return
+            pids, root = stopped["pids"], stopped["root"]
+            ledger = BlockLedger(root)
+            plan = None
+            victim = None
+            while not watch_stop.is_set():
+                if plan is None:
+                    try:
+                        plan = load_plan(os.path.join(root, "plan.json"))
+                    except Exception:
+                        time.sleep(0.005)
+                        continue
+                if victim is None:
+                    done = set(ledger.committed())
+                    for bid, info in ledger.claims().items():
+                        if bid not in done:
+                            victim = info["worker"]
+                            os.kill(pids[victim], signal.SIGSTOP)
+                            # verify the claim is STILL uncommitted
+                            # (the fold might have raced the stop)
+                            if bid in set(ledger.committed()):
+                                os.kill(pids[victim], signal.SIGCONT)
+                                victim = None
+                            break
+                    time.sleep(0.002)
+                    continue
+                stopped["victim"] = victim
+                if len(ledger.committed()) >= len(plan.blocks):
+                    os.kill(pids[victim], signal.SIGCONT)
+                    stopped["resumed"] = True
+                    return
+                time.sleep(0.01)
+
+        watcher = threading.Thread(target=watch, daemon=True)
+        watcher.start()
+        chaos_policy = StragglerPolicy(mirror_floor_s=0.5,
+                                       mirror_multiple=2.0, poll_s=0.02)
+        try:
+            res = run_sharded(job, conf, [inp],
+                              os.path.join(d, "chaos_out"), procs=2,
+                              pin_cores=pin, policy=chaos_policy,
+                              worker_hook=chaos_hook)
+        finally:
+            # the watcher normally exits at SIGCONT; stop+join it
+            # BOUNDED either way so a missed catch cannot leak the
+            # thread past the tripwire
+            watch_stop.set()
+            watcher.join(30)
+            if watcher.is_alive():
+                raise RuntimeError("chaos watcher failed to stop")
+        if "victim" not in stopped:
+            raise RuntimeError(
+                "chaos leg: the watcher never caught a worker holding "
+                "an uncommitted claim — nothing was actually stalled")
+        if res.counters["Shard:DedupBlocks"] < 1:
+            raise RuntimeError(
+                f"chaos leg: the stalled worker's block was never "
+                f"redundantly re-dispatched and deduped "
+                f"(counters {res.counters})")
+        # zero lost blocks: run_sharded's merge REFUSES to run with any
+        # block state missing (ShardError), so reaching a result at all
+        # proves every plan block committed; make the claim explicit
+        if not res.outputs or res.counters["Shard:Blocks"] < 1:
+            raise RuntimeError("chaos leg lost its outputs")
+        solo_out = os.path.join(d, f"solo_{job}")
+        with open(solo_out, "rb") as fa, open(res.outputs[0], "rb") as fb:
+            if fa.read() != fb.read():
+                raise RuntimeError(
+                    "chaos leg artifact differs from the solo twin — a "
+                    "redundantly folded block leaked into the merge")
+        return {"rows": rows, "floor": floor,
+                "effective_floor": round(effective_floor, 2),
+                "host_parallel_capacity": round(capacity, 2),
+                "throughput_gated": throughput_gated,
+                "speedup": round(speedup, 2),
+                "families": rows_out,
+                "chaos_dedup_blocks": int(
+                    res.counters["Shard:DedupBlocks"]),
+                "chaos_stolen_blocks": int(
+                    res.counters["Shard:StolenBlocks"]),
+                "chaos_victim_worker": int(stopped["victim"]),
+                "zero_lost_blocks": True,
+                "outputs_byte_identical": True}
+    finally:
+        shutil.rmtree(d, ignore_errors=True)
+
+
 def main(n_devices: int = 8, quick: bool = False):
     from __graft_entry__ import _bootstrap_devices
 
@@ -1359,6 +1631,13 @@ def main(n_devices: int = 8, quick: bool = False):
     line["fleet_fault_tripwire"] = (
         fleet_fault_tripwire(1_000_000) if quick
         else fleet_fault_tripwire())
+    # the sharded-scan gate follows the fleet convention: quick runs
+    # the 1M proxy (smaller drowns the parallel win in fixed per-block
+    # costs) with the efficiency term relaxed for the residual fixed
+    # share; byte-identity and the SIGSTOP dedup leg assert everywhere
+    line["shard_tripwire"] = (
+        shard_tripwire(1_000_000, parallel_efficiency_floor=0.7)
+        if quick else shard_tripwire())
     # quick mode's runs are short enough that scheduler jitter swamps
     # the 3% overhead bound; the real <=1.03x gate runs at the 10M-row
     # proxy every full round

@@ -77,6 +77,15 @@ def test_merge_gate_clean_and_all_stream_kernels_validated():
         assert fused["byte_identical"] and fused["resume_interrupted"], row
         assert fused["skipped_bytes"] > 0, row
         assert fused["jobs"] == len(row["jobs"]), row
+        # the sharded-steal leg ran through the REAL block ledger
+        # (avenir_tpu.dist): a boundary block folded by two workers
+        # committed exactly once — the duplicate was rejected
+        # first-commit-wins — and the plan-ordered merge reproduced
+        # the cold scan's bytes
+        assert row["shard_dedup_validated"], row
+        sh = row["sharded"]
+        assert sh["dup_rejected"] and sh["committed_once"], row
+        assert sh["byte_identical"] and sh["blocks"] >= 4, row
 
 
 def test_every_stream_entry_carries_fold_specs():
@@ -316,8 +325,9 @@ def test_auditor_flags_a_corpus_too_small_to_shard(tmp_path):
     row, finding = audit_merge(tiny)
     assert row["merge_validated"] is False
     assert row["incremental_validated"] is False
+    assert row["shard_dedup_validated"] is False
     assert row["shards"] == [] and row["checkpoint"] is None
-    assert row["incremental"] is None
+    assert row["incremental"] is None and row["sharded"] is None
     assert finding is not None and finding.rule == MERGE_AUDIT_RULE
     assert "too small" in finding.message
 

@@ -1,31 +1,31 @@
 """Multi-host ingest, actually multi-process: 2 CPU processes behind a
-localhost jax.distributed coordinator, each ingesting ITS OWN
-`host_csv_byte_range` input split of one shared CSV.
+localhost jax.distributed coordinator, each folding ITS OWN home blocks
+of a SHARD PLAN over one shared CSV.
 
-This is the SURVEY §2.12 input-split story run for real —
-`parallel/multihost.py` stops being dead code: `initialize()` brings up
-the coordination service, `host_csv_byte_range` hands each process a
-disjoint byte range under the LineRecordReader boundary contract,
-`CsvBlockReader(byte_range=...)` streams it, and `global_rows` assembles
-a globally row-sharded array whose shards live on different processes.
-
-The cross-process count merge goes through the REGISTERED fold-state
-algebra (runner.stream_fold_ops("bayesianDistr")): each worker folds its
-split through the registry's fold sink, serializes the carry with the
-registered ``serialize_state`` op, and the parent restores both carries
-and merges them with ``merge_states`` — the SAME ops the graftlint
---merge auditor validates every round, so the multi-host path and the
-audited path can never drift apart. The merged model equals the
-single-process whole-file fit EXACTLY, and the merged fold's finished
-model file is byte-identical to the single-process runner job's.
+This is the SURVEY §2.12 input-split story run for real, now through
+the avenir-shard substrate instead of hand-rolled splits:
+`parallel/multihost.initialize()` brings up the coordination service,
+the shard planner (`avenir_tpu.dist.plan_shards`) over-partitions the
+corpus into newline-aligned byte-range blocks, each process CLAIMS its
+home blocks through the block ledger (`avenir_tpu.dist.BlockLedger` —
+the same first-commit-wins claim files the sharded driver uses), folds
+each through the registry's fold sink, and commits the serialized
+carry. The parent restores every committed block state and merges IN
+PLAN ORDER via the registered ``merge_states`` — the SAME ops the
+graftlint --merge auditor validates every round, so the multi-host
+path and the audited path can never drift apart. The merged model
+equals the single-process whole-file fit EXACTLY, and the merged
+fold's finished model file is byte-identical to the single-process
+runner job's.
 
 Honest limitation, pinned here so nobody re-discovers it: jaxlib's CPU
 backend refuses *compiled multiprocess computations* ("Multiprocess
 computations aren't implemented on the CPU backend"), so the cross-host
-collective itself needs real TPU/GPU transport. Everything up to it —
-distributed init, per-host splits, global array assembly, shard
-placement — is asserted multi-process below; the count merge crosses
-processes through the serialized fold states instead.
+collective itself needs real TPU/GPU transport
+(avenir_tpu.dist.collective gates on exactly this). Everything up to it
+— distributed init, planner blocks, ledger claims, global array
+assembly, shard placement — is asserted multi-process below; the count
+merge crosses processes through the serialized fold states instead.
 """
 
 import os
@@ -45,8 +45,8 @@ os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
 import numpy as np
 import jax
 
-proc_id, coord, csv, schema_path, out = (
-    int(sys.argv[1]), sys.argv[2], sys.argv[3], sys.argv[4], sys.argv[5])
+proc_id, coord, root, out = (
+    int(sys.argv[1]), sys.argv[2], sys.argv[3], sys.argv[4])
 
 from avenir_tpu.parallel import multihost
 
@@ -57,42 +57,59 @@ assert jax.process_index() == proc_id
 assert len(jax.devices()) == 2 and len(jax.local_devices()) == 1
 
 from avenir_tpu.core.schema import FeatureSchema
-from avenir_tpu.core.stream import CsvBlockReader
+from avenir_tpu.dist import BlockLedger, load_plan
+from avenir_tpu.dist.worker import fold_block
 from avenir_tpu.runner import _job_cfg, stream_fold_ops
 
-schema = FeatureSchema.from_file(schema_path)
-lo, hi = multihost.host_csv_byte_range(csv)
+plan = load_plan(os.path.join(root, "plan.json"))
+ledger = BlockLedger(root)
+csv = plan.inputs[0]["path"]
 size = os.path.getsize(csv)
-assert 0 <= lo <= hi <= size
-# the two splits tile the file exactly (contiguous per process)
-assert (lo == 0) == (proc_id == 0) and (hi == size) == (proc_id == 1)
 
-# fold THIS host's split through the REGISTERED fold sink — the same
-# factory/serialize ops the graftlint --merge auditor proves each round
-ops = stream_fold_ops("bayesianDistr")
-_name, _prefix, cfg = _job_cfg(
-    "bayesianDistr", {"bad.feature.schema.file.path": schema_path})
-fold = ops.factory(cfg, [csv], schema)
-for chunk in CsvBlockReader(csv, schema, block_bytes=4096,
-                            byte_range=(lo, hi)):
-    fold.consume(chunk)
-state = ops.serialize_state(fold)
-with open(out + ".state", "wb") as fh:
-    fh.write(state)
+# the planner's blocks tile the file gap-free, newline-aligned
+pos = 0
+for blk in plan.blocks:
+    assert blk.start == pos, (blk, pos)
+    pos = blk.end
+assert pos == size
+
+# this host's HOME run is contiguous and non-trivial
+home = plan.blocks_for(proc_id)
+assert home and all(b.home == proc_id for b in home)
+
+ops = stream_fold_ops(plan.job)
+_name, _prefix, cfg = _job_cfg(plan.job, dict(plan.props))
+schema = FeatureSchema.from_file(
+    cfg.assert_get("feature.schema.file.path"))
+
+# claim each home block through the ledger (exactly-one-winner claim
+# files), fold it through the REGISTERED sink, commit the serialized
+# carry first-commit-wins — the sharded driver's worker loop, driven
+# from a jax.distributed process
+rows = 0
+local = None
+for blk in home:
+    assert ledger.claim(blk.id, proc_id), blk
+    fold = fold_block(plan.job, cfg, ops, schema, [csv], csv,
+                      blk.start, blk.end)
+    rows += fold.rows
+    assert ledger.commit(blk.id, proc_id, ops.serialize_state(fold))
+    local = fold if local is None else ops.merge_states(local, fold)
 
 # assemble a genuinely multi-process global array: one row per host
 # (equal shards), sharded across the two processes' devices
-fold.model.flush()
+local.model.flush()
 mesh = multihost.global_mesh()
-local = np.concatenate([fold.model.post_counts.ravel(),
-                        fold.model.class_counts.ravel()]).astype(np.float32)
-arr = multihost.global_rows(mesh, local[None, :])
-assert arr.shape == (2, local.shape[0])
+vec = np.concatenate([local.model.post_counts.ravel(),
+                      local.model.class_counts.ravel()]).astype(np.float32)
+arr = multihost.global_rows(mesh, vec[None, :])
+assert arr.shape == (2, vec.shape[0])
 assert len(arr.addressable_shards) == 1              # only OUR row is local
 assert {d.process_index for d in arr.sharding.device_set} == {0, 1}
 
-np.savez(out, rows=fold.rows, split=np.array([lo, hi]))
-print("OK", proc_id, fold.rows, flush=True)
+np.savez(out, rows=rows,
+         span=np.array([home[0].start, home[-1].end]))
+print("OK", proc_id, rows, flush=True)
 """
 
 
@@ -120,7 +137,18 @@ def _free_port() -> int:
     return port
 
 
-def test_two_process_split_ingest_merges_via_registered_ops(corpus):
+def test_two_process_planned_ingest_merges_via_registered_ops(corpus):
+    from avenir_tpu.dist import BlockLedger, plan_shards, write_plan
+
+    root = os.path.join(corpus["dir"], "shard_root")
+    os.makedirs(root, exist_ok=True)
+    plan = plan_shards([corpus["csv"]], procs=2, factor=2)
+    plan.job = "bayesianDistr"
+    plan.prefix = "bad"
+    plan.props = {"bad.feature.schema.file.path": corpus["schema"]}
+    write_plan(plan, os.path.join(root, "plan.json"))
+    assert len(plan.blocks) == 4
+
     coord = f"127.0.0.1:{_free_port()}"
     env = dict(os.environ)
     env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
@@ -132,7 +160,7 @@ def test_two_process_split_ingest_merges_via_registered_ops(corpus):
         out = os.path.join(corpus["dir"], f"proc{pid}.npz")
         procs.append((out, subprocess.Popen(
             [sys.executable, corpus["worker"], str(pid), coord,
-             corpus["csv"], corpus["schema"], out],
+             root, out],
             stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
             cwd=REPO, env=env)))
     results = []
@@ -140,34 +168,45 @@ def test_two_process_split_ingest_merges_via_registered_ops(corpus):
         stdout, _ = proc.communicate(timeout=180)
         assert proc.returncode == 0, stdout[-2000:]
         assert "OK" in stdout, stdout[-2000:]
-        results.append((np.load(out), open(out + ".state", "rb").read()))
+        results.append(np.load(out))
 
-    # splits are disjoint, contiguous, and tile the file
-    (lo0, hi0), (lo1, hi1) = results[0][0]["split"], results[1][0]["split"]
+    # home spans are disjoint, contiguous, and tile the file
+    (lo0, hi0), (lo1, hi1) = results[0]["span"], results[1]["span"]
     assert lo0 == 0 and hi0 == lo1 and hi1 == os.path.getsize(corpus["csv"])
 
-    # per-split row counts partition the corpus, both splits non-trivial
-    rows = [int(r["rows"]) for r, _s in results]
+    # per-host row counts partition the corpus, both hosts non-trivial
+    rows = [int(r["rows"]) for r in results]
     assert sum(rows) == 1200 and min(rows) > 0
 
-    # the registered merge algebra crosses the process boundary: restore
-    # both workers' serialized fold states and merge them through the
-    # SAME merge_states op the graftlint --merge auditor validates
+    # the ledger recorded the whole run: every block claimed by its
+    # HOME worker, every block committed exactly once, zero dedups
+    # (nobody stalled)
+    ledger = BlockLedger(root)
+    claims = ledger.claims()
+    assert sorted(claims) == [b.id for b in plan.blocks]
+    for blk in plan.blocks:
+        assert claims[blk.id]["worker"] == blk.home
+    assert ledger.committed() == [b.id for b in plan.blocks]
+    assert ledger.dup_count() == 0
+
+    # the registered merge algebra crosses the process boundary: the
+    # coordinator-side merge (merge_block_states — the sharded
+    # driver's own merge) restores every committed block state and
+    # chains merge_states IN PLAN ORDER
     from avenir_tpu.core.dataset import Dataset
     from avenir_tpu.core.schema import FeatureSchema
     from avenir_tpu.data import churn_schema
+    from avenir_tpu.dist import merge_block_states
     from avenir_tpu.models.naive_bayes import NaiveBayesModel
     from avenir_tpu.runner import _job_cfg, run_job, stream_fold_ops
 
     ops = stream_fold_ops("bayesianDistr")
     conf = {"bad.feature.schema.file.path": corpus["schema"]}
-    folds = []
-    for _r, state in results:
-        _name, _prefix, cfg = _job_cfg("bayesianDistr", dict(conf))
-        folds.append(ops.restore_state(
-            cfg, [corpus["csv"]], state,
-            schema=FeatureSchema.from_file(corpus["schema"])))
-    merged = ops.merge_states(folds[0], folds[1])
+    _name, _prefix, cfg = _job_cfg("bayesianDistr", dict(conf))
+    states = {bid: ledger.load_state(bid) for bid in ledger.committed()}
+    merged = merge_block_states(
+        "bayesianDistr", cfg, ops, plan, states, [corpus["csv"]], root,
+        schema=FeatureSchema.from_file(corpus["schema"]))
     assert merged.rows == 1200
 
     # merged sufficient statistics == the single-process whole-file fit
@@ -188,3 +227,52 @@ def test_two_process_split_ingest_merges_via_registered_ops(corpus):
     merged.finish(merged_out)
     with open(single_out, "rb") as fa, open(merged_out, "rb") as fb:
         assert fa.read() == fb.read()
+
+
+def test_host_shard_bounds_edges_delegate_to_split_ranges():
+    """The satellite regression set for the split arithmetic the
+    multi-host byte ranges and the shard planner now share
+    (core.stream.split_byte_ranges): corpus smaller than the split
+    count must yield trailing EMPTY shards that still tile gap-free,
+    and single-line / no-trailing-newline corpora must partition their
+    lines exactly through the LineRecordReader contract."""
+    from avenir_tpu.core.stream import iter_byte_blocks, split_byte_ranges
+
+    # smaller than the split count: empty shards tile gap-free
+    assert split_byte_ranges(3, 8) == [
+        (0, 1), (1, 2), (2, 3), (3, 3), (3, 3), (3, 3), (3, 3), (3, 3)]
+    # exact division, ragged division, zero total
+    assert split_byte_ranges(12, 2) == [(0, 6), (6, 12)]
+    assert split_byte_ranges(5, 4) == [(0, 2), (2, 4), (4, 5), (5, 5)]
+    assert split_byte_ranges(0, 3) == [(0, 0), (0, 0), (0, 0)]
+    with pytest.raises(ValueError):
+        split_byte_ranges(10, 0)
+    with pytest.raises(ValueError):
+        split_byte_ranges(-1, 2)
+
+    import tempfile
+
+    def lines_across_splits(content: bytes, n: int) -> list:
+        with tempfile.NamedTemporaryFile(delete=False) as fh:
+            fh.write(content)
+            path = fh.name
+        try:
+            return [line
+                    for rng in split_byte_ranges(len(content), n)
+                    for blk in iter_byte_blocks(path, 7, byte_range=rng)
+                    for line in blk.split(b"\n") if line.strip()]
+        finally:
+            os.remove(path)
+
+    want = [b"a,1", b"b,2", b"c,3"]
+    # no trailing newline
+    assert lines_across_splits(b"a,1\nb,2\nc,3", 2) == want
+    assert lines_across_splits(b"a,1\nb,2\nc,3", 8) == want
+    # trailing newline, more splits than lines
+    assert lines_across_splits(b"a,1\nb,2\nc,3\n", 5) == want
+    # single-line corpus, with and without the newline: exactly one
+    # split owns the line, every other yields nothing
+    assert lines_across_splits(b"onlyline,42", 4) == [b"onlyline,42"]
+    assert lines_across_splits(b"onlyline,42\n", 4) == [b"onlyline,42"]
+    # empty corpus
+    assert lines_across_splits(b"", 3) == []

@@ -55,9 +55,20 @@ from the streaming accumulators), and a metrics.json snapshot written
 next to the served artifacts — the same file a resident server
 refreshes live for `python -m avenir_tpu stats`.
 
+With --shard, additionally measures the multi-process sharded driver
+(avenir_tpu.dist.run_sharded): mutualInformation (Dataset-chunk family)
+and markovStateTransitionModel (raw-byte-block family) re-run with the
+scan split across 2 worker processes through the block ledger, in a
+fresh child — byte-identity vs the solo anchors asserted, the
+Shard:Blocks/StolenBlocks/DedupBlocks/MergeMs counters recorded as
+columns, and the summary gains `shard_speedup` (solo anchor seconds /
+sharded scan seconds per job; the scan clock starts at the workers' go
+barrier, matching the solo children's boot-excluded convention).
+
 Usage: python tools/stream_scale_check.py [--rows N_MILLION] [--extra]
                                           [--fused] [--incremental]
-                                          [--server] [--no-audits]
+                                          [--server] [--shard]
+                                          [--no-audits]
 """
 
 import json
@@ -223,6 +234,26 @@ print(json.dumps({
     "hists": hists,
     "stats": {k: v for k, v in stats.items() if v},
 }))
+'''
+
+
+_CHILD_SHARDED = r'''
+import json, os, resource, sys, time
+sys.path.insert(0, ".")
+import jax
+jax.config.update("jax_platforms", "cpu")
+from avenir_tpu.dist import run_sharded
+
+job, conf_json, inp, out, procs = sys.argv[1:6]
+t0 = time.perf_counter()
+res = run_sharded(job, json.loads(conf_json), [inp], out,
+                  procs=int(procs))
+dt = time.perf_counter() - t0
+rss = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / 1024.0
+print(json.dumps({"job": job, "seconds": round(dt, 1),
+                  "scan_seconds": res.counters["Shard:ScanSeconds"],
+                  "peak_rss_mb": round(rss, 1),
+                  "counters": res.counters, "outputs": res.outputs}))
 '''
 
 
@@ -456,6 +487,47 @@ def main():
             "outputs_byte_identical": True,
         }
         os.remove(base)
+    if "--shard" in sys.argv:
+        # sharded-scan A/B: the two anchor families re-run with the
+        # scan split across 2 worker processes (block ledger, plan-
+        # ordered merge), in a fresh child; byte-identity asserted
+        # against the solo anchors above, shard counters recorded
+        env = dict(os.environ, AVENIR_SKIP_DEVICE_PROBE="1")
+        shard_jobs = [
+            ("mutualInformation",
+             {"mut.feature.schema.file.path": schema_path,
+              "mut.mutual.info.score.algorithms":
+                  "mutual.info.maximization"},
+             CHURN_CSV, "/tmp/avenir_scale_mi_sharded.txt",
+             "/tmp/avenir_scale_mi.txt"),
+            ("markovStateTransitionModel",
+             {"mst.model.states": "L,M,H",
+              "mst.class.label.field.ord": "1",
+              "mst.skip.field.count": "2", "mst.class.labels": "T,F"},
+             SEQ_CSV, "/tmp/avenir_scale_mst_sharded.txt",
+             "/tmp/avenir_scale_mst.txt"),
+        ]
+        for job, conf, inp, out, solo_out in shard_jobs:
+            proc = subprocess.run(
+                [sys.executable, "-c", _CHILD_SHARDED, job,
+                 json.dumps(conf), inp, out, "2"],
+                capture_output=True, text=True, timeout=7200, env=env)
+            if proc.returncode != 0:
+                raise RuntimeError(
+                    f"sharded {job} failed: {proc.stderr[-500:]}")
+            line = json.loads(proc.stdout.strip().splitlines()[-1])
+            print(json.dumps(line), flush=True)
+            assert line["peak_rss_mb"] < RSS_LIMIT_MB, \
+                f"sharded {job} RSS {line['peak_rss_mb']}MB not O(block)"
+            with open(solo_out, "rb") as fa, open(out, "rb") as fb:
+                assert fa.read() == fb.read(), \
+                    f"sharded {job} output != solo anchor {solo_out}"
+            line["outputs_byte_identical"] = True
+            line["solo_seconds"] = results[job]["seconds"]
+            line["shard_speedup"] = round(
+                results[job]["seconds"]
+                / max(line["scan_seconds"], 1e-9), 2)
+            results[f"sharded_{job}"] = line
     if "--server" in sys.argv:
         # resident-server anchor: the 3-tenant mixed-kind open-loop
         # load served by an in-process JobServer vs one-job-at-a-time,
@@ -523,6 +595,22 @@ def main():
     # re-scan after a ~1% append, byte-identity already asserted above
     if "incremental" in results:
         summary["incremental_speedup"] = results["incremental"]["speedup"]
+    # the sharded-scan columns: solo anchor vs 2-process sharded scan
+    # per family, plus the Shard:* ledger counters the sharded
+    # JobResults carry (blocks / stolen / dedup / merge ms)
+    shard_cols = {job: line for job, line in results.items()
+                  if job.startswith("sharded_")}
+    if shard_cols:
+        summary["shard_speedup"] = {
+            job[len("sharded_"):]: line["shard_speedup"]
+            for job, line in shard_cols.items()}
+        summary["shard_counters"] = {
+            job[len("sharded_"):]: {
+                k: line["counters"][k] for k in
+                ("Shard:Blocks", "Shard:StolenBlocks",
+                 "Shard:DedupBlocks", "Shard:MergeMs")
+                if k in line.get("counters", {})}
+            for job, line in shard_cols.items()}
     # the served-jobs/min column: batched multi-tenant serving vs
     # one-job-at-a-time, plus the served requests' Server:* counters
     if "jobServer" in results:
