@@ -99,3 +99,16 @@ def mirror_after_s(policy: StragglerPolicy, sig: RunSignals,
     to call anyone else slow."""
     est = policy.mirror_multiple * per_block_seconds(sig, blocks_done)
     return min(max(est, policy.mirror_floor_s), policy.mirror_cap_s)
+
+
+def mirror_after_wall_s(policy: StragglerPolicy, wall_s: float,
+                        blocks_done: int) -> float:
+    """The per-k variant of :func:`mirror_after_s`: the miners' per-k
+    count folds replay the encoded-block cache (no ``stream.read`` /
+    ``stream.parse`` spans fire), so the worker prices a per-k block
+    from its DIRECTLY measured count wall — total seconds over per-k
+    blocks it has finished — instead of the span extractor. Same
+    multiple, same floor/cap clamp, same no-evidence rule."""
+    est = (policy.mirror_multiple * wall_s / blocks_done
+           if blocks_done > 0 else 0.0)
+    return min(max(est, policy.mirror_floor_s), policy.mirror_cap_s)

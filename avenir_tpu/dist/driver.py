@@ -28,15 +28,36 @@ on this host. The machinery:
    multiprocess computation (tests/test_multihost.py pins the
    limitation).
 
+**Miner jobs run their per-k candidate rounds distributed too**
+(``plan.per_k``): after the pass-1 merge the coordinator does ZERO
+candidate counting itself. It thresholds the merged k=1 supports,
+publishes each level's candidates as an atomic token-space manifest
+(``<root>/candidates/k<k>.json`` — candidates translate per block via
+``token_code``), and the resident workers re-enter the claim/steal/
+mirror loop against the level-namespaced ledger (``k<k>/b<id>``),
+counting each claimed block's candidate supports by replaying their
+own committed encoded-block cache segments (no CSV re-parse on the
+happy path). The coordinator merges each level's per-block count
+vectors through ``merge_support_counts`` — the same reducer algebra
+``mine_stream_merged`` uses, driven through the miner's OWN
+``_merged_rounds`` control loop, so the kept sets and counts are
+identical to the in-process sharded miner by construction — prunes,
+publishes k+1, and releases the workers with ``final.json`` when the
+frontier empties.
+
 Every sharded JobResult carries the shard counters next to the standard
 streamed set: ``Shard:Blocks`` (plan blocks), ``Shard:StolenBlocks``
-(claims outside the claimant's home run), ``Shard:DedupBlocks``
-(rejected duplicate commits — redundancy that actually fired),
-``Shard:MergeMs`` (restore+merge wall).
+(claims outside the claimant's home run, across every ledger
+namespace), ``Shard:DedupBlocks`` (rejected duplicate commits across
+every namespace — redundancy that actually fired), ``Shard:MergeMs``
+(restore+merge wall), and — miner jobs — ``Shard:PerKRounds`` (the
+distributed candidate-counting levels) and ``Shard:PerKBlocks`` (the
+per-level block commits merged).
 """
 
 from __future__ import annotations
 
+import io
 import json
 import os
 import shutil
@@ -46,11 +67,13 @@ import tempfile
 import time
 from typing import Callable, Dict, List, Optional, Sequence
 
+import numpy as np
+
 from avenir_tpu import obs as _obs
 from avenir_tpu.dist.detect import StragglerPolicy
 from avenir_tpu.dist.ledger import BlockLedger
 from avenir_tpu.dist.plan import (DEFAULT_FACTOR, ShardPlan, plan_shards,
-                                  write_plan)
+                                  write_json_atomic, write_plan)
 from avenir_tpu.dist.worker import RESCAN_AT_FINISH
 
 
@@ -80,7 +103,9 @@ def _restore_inputs(canonical: str, plan: ShardPlan, block,
     byte slice of the input, legal because plan blocks are
     newline-aligned. Every other family's finish never re-reads inputs,
     so the real input list (better error messages, zero extra disk)
-    is kept."""
+    is kept. (run_sharded's own miner path distributes the per-k
+    rounds instead and never takes this slice; the graftlint --merge
+    sharded-steal leg's in-process merge still does.)"""
     if canonical not in RESCAN_AT_FINISH:
         return list(inputs)
     src = plan.inputs[block.input]["path"]
@@ -116,6 +141,138 @@ def merge_block_states(canonical: str, cfg, ops, plan: ShardPlan,
     return merged
 
 
+# ----------------------------------------------------------- per-k rounds
+def _miner_scan_state(blob: bytes):
+    """(vocab, k=1 counts, row count) out of one committed pass-1 miner
+    block state — the npz ``serialize_state`` wrote; the per-k merge
+    needs only the discovery triple, never a rebuilt fold."""
+    with np.load(io.BytesIO(blob), allow_pickle=False) as z:
+        meta = json.loads(str(z["meta"]))
+        counts = np.asarray(z["counts"], np.int64)
+    return list(meta["vocab"]), counts, int(meta["n"])
+
+
+def _level_counts(blob: bytes) -> np.ndarray:
+    with np.load(io.BytesIO(blob), allow_pickle=False) as z:
+        return np.asarray(z["counts"], np.int64)
+
+
+def _level_tids(blob: bytes) -> List[List[str]]:
+    return json.loads(blob.decode("utf-8"))["tids"]
+
+
+def _wait_commits(ledger: BlockLedger, n_blocks: int, workers, logs: str,
+                  deadline: float, poll_s: float) -> None:
+    """Wait until every block id is committed in ``ledger``'s
+    namespace; raise when every worker died or the deadline passed."""
+    while True:
+        done = len(ledger.committed())
+        if done >= n_blocks:
+            return
+        if not any(p.poll() is None for _log, p in workers):
+            _raise_workers_dead(workers, logs, done, n_blocks)
+        if time.perf_counter() > deadline:
+            raise ShardError(
+                f"sharded scan incomplete at run deadline "
+                f"({done}/{n_blocks} blocks committed in namespace "
+                f"{ledger.ns or 'pass-1'})")
+        time.sleep(poll_s)
+
+
+def _coordinate_per_k(canonical: str, cfg, plan: ShardPlan,
+                      ledger: BlockLedger, root: str, workers,
+                      logs: str, deadline: float,
+                      policy: StragglerPolicy) -> Dict:
+    """The miners' distributed per-k rounds, coordinator half: merge
+    the committed pass-1 block states into the global k=1 supports,
+    then drive the miner's OWN ``_merged_rounds`` control loop with a
+    count function that publishes each level's candidate manifest,
+    waits for every block's first-committed count vector in the
+    level-namespaced ledger, and merges them via
+    ``merge_support_counts``. Zero coordinator-side candidate
+    counting; the counts — and therefore the kept sets — are the
+    in-process ``mine_stream_merged``'s by construction."""
+    from avenir_tpu.models.association import (frequent_tokens,
+                                               merge_support_counts)
+    from avenir_tpu.runner import _build_miner
+
+    t_perk = t0 = time.perf_counter()
+    blocks_meta = []
+    committed = set(ledger.committed())
+    for blk in plan.blocks:
+        if blk.id not in committed:
+            raise ShardError(
+                f"block {blk.id} has no committed pass-1 state")
+        blocks_meta.append(_miner_scan_state(ledger.load_state(blk.id)))
+    n = sum(nb for _v, _c, nb in blocks_meta)
+    support1 = merge_support_counts(
+        *[{vocab[i]: int(counts[i]) for i in range(len(vocab))}
+          for vocab, counts, _nb in blocks_meta])
+    miner = _build_miner(canonical, cfg)
+    # the mask every per-block source installs before counting — the
+    # global frequent-token frontier, same rule mine_stream_merged
+    # masks its shard sources with
+    mask = frequent_tokens(support1, miner.support_threshold * n)
+    stats = {"rounds": 0, "blocks": 0, "tags": [],
+             "merge_s": time.perf_counter() - t0}
+
+    cand_dir = os.path.join(root, "candidates")
+    os.makedirs(cand_dir, exist_ok=True)
+    n_blocks = len(plan.blocks)
+
+    def run_level(tag: str, cands, c_pad: int, parse_state):
+        lk = ledger.level(tag)
+        write_json_atomic(
+            {"tag": tag, "job": canonical, "mask": mask,
+             "cands": [list(cd) for cd in cands], "c_pad": int(c_pad)},
+            os.path.join(cand_dir, f"{tag}.json"))
+        _wait_commits(lk, n_blocks, workers, logs, deadline,
+                      policy.poll_s)
+        t1 = time.perf_counter()
+        payloads = [parse_state(lk.load_state(bid))
+                    for bid in range(n_blocks)]
+        stats["merge_s"] += time.perf_counter() - t1
+        stats["blocks"] += n_blocks
+        stats["tags"].append(tag)
+        return payloads
+
+    def count_level(k: int, cands, c_pad: int) -> np.ndarray:
+        payloads = run_level(f"k{k}", cands, c_pad, _level_counts)
+        t1 = time.perf_counter()
+        merged = merge_support_counts(
+            *[dict(zip(cands, p)) for p in payloads])
+        out = np.array([int(merged.get(cd, 0)) for cd in cands],
+                       np.int64)
+        stats["merge_s"] += time.perf_counter() - t1
+        stats["rounds"] += 1
+        return out
+
+    if canonical == "frequentItemsApriori":
+        rounds = miner._merged_rounds(support1, n, count_level)
+        tids = None
+        if miner.emit_trans_id:
+            all_sets = [cd for _k, sets_k, _c in rounds
+                        for cd in sets_k]
+            tids = [[] for _ in all_sets]
+            if all_sets:
+                c_pad = max(64, 1 << (len(all_sets) - 1).bit_length())
+                payloads = run_level("tids", all_sets, c_pad,
+                                     _level_tids)
+                for p in payloads:    # plan order == corpus order
+                    for ci in range(len(all_sets)):
+                        tids[ci].extend(p[ci])
+        levels = miner._pack_merged_rounds(rounds, n, tids)
+    else:
+        levels = miner._merged_rounds(support1, n, count_level)
+    # release the workers: no further manifests are coming
+    write_json_atomic({"done": True, "rounds": stats["rounds"]},
+                      os.path.join(cand_dir, "final.json"))
+    return {"levels": levels, "n": n, "rounds": stats["rounds"],
+            "blocks": stats["blocks"], "tags": stats["tags"],
+            "merge_s": stats["merge_s"],
+            "perk_s": time.perf_counter() - t_perk}
+
+
 def run_sharded(name: str, conf, inputs: Sequence[str], output: str,
                 procs: int = 2, factor: int = DEFAULT_FACTOR,
                 shard_root: Optional[str] = None,
@@ -125,7 +282,8 @@ def run_sharded(name: str, conf, inputs: Sequence[str], output: str,
                 timeout_s: float = 7200.0) -> "JobResult":
     """Run one registered streamed job across ``procs`` worker
     processes — byte-identical artifact to ``run_job``, wall clock
-    scaled by the host's process parallelism.
+    scaled by the host's process parallelism (miner jobs: BOTH the
+    pass-1 scan and every per-k candidate round run distributed).
 
     ``worker_hook(pids, root)`` is the chaos/test tap, called once the
     workers are spawned (before the go barrier releases them) — the
@@ -134,7 +292,7 @@ def run_sharded(name: str, conf, inputs: Sequence[str], output: str,
     per worker makes a same-box N-vs-1 comparison measure scale-out,
     not XLA's intra-op oversubscription)."""
     from avenir_tpu.runner import (JobResult, _finish_fold, _job_cfg,
-                                   stream_fold_ops)
+                                   finish_miner_levels, stream_fold_ops)
 
     canonical, prefix, cfg = _job_cfg(name, conf)
     ops = stream_fold_ops(canonical)
@@ -142,6 +300,7 @@ def run_sharded(name: str, conf, inputs: Sequence[str], output: str,
     root = shard_root or tempfile.mkdtemp(prefix="avenir_shard_")
     own_root = shard_root is None
     procs = max(int(procs), 1)
+    per_k = canonical in RESCAN_AT_FINISH
     try:
         plan = plan_shards(list(inputs), procs, factor,
                            policy=policy.to_dict())
@@ -149,6 +308,7 @@ def run_sharded(name: str, conf, inputs: Sequence[str], output: str,
         plan.prefix = prefix
         plan.props = {k: str(v) for k, v in cfg.props.items()
                       if k != "__job_name__"}
+        plan.per_k = per_k
         write_plan(plan, os.path.join(root, "plan.json"))
         ledger = BlockLedger(root)
         logs = os.path.join(root, "logs")
@@ -166,6 +326,7 @@ def run_sharded(name: str, conf, inputs: Sequence[str], output: str,
                  root, str(w)],
                 stdout=log, stderr=log, env=_worker_env(),
                 cwd=_pkg_parent(), preexec_fn=preexec)))
+        mined = None
         try:
             if worker_hook is not None:
                 worker_hook([p.pid for _log, p in workers], root)
@@ -195,11 +356,20 @@ def run_sharded(name: str, conf, inputs: Sequence[str], output: str,
                        os.path.join(root, "go"))
 
             n_blocks = len(plan.blocks)
-            # once every block is committed, straggling workers get a
-            # BOUNDED grace to exit on their own — long enough for a
-            # woken straggler to finish its in-flight fold and record
-            # the rejected duplicate in the dedup counters, short
-            # enough that a permanently wedged worker (the failure
+            if per_k:
+                # pass 1: wait for every block's committed state — the
+                # workers stay resident for the per-k rounds
+                _wait_commits(ledger, n_blocks, workers, logs,
+                              deadline, policy.poll_s)
+                mined = _coordinate_per_k(canonical, cfg, plan, ledger,
+                                          root, workers, logs, deadline,
+                                          policy)
+            # once the scan is complete (pass 1 for single-pass
+            # families; final.json published for miners), straggling
+            # workers get a BOUNDED grace to exit on their own — long
+            # enough for a woken straggler to finish its in-flight fold
+            # and record the rejected duplicate in the dedup counters,
+            # short enough that a permanently wedged worker (the
             # mirroring exists to survive) cannot hold a finished scan
             # hostage for the run timeout; past it the finally kills
             # the stragglers and the merge proceeds
@@ -207,7 +377,7 @@ def run_sharded(name: str, conf, inputs: Sequence[str], output: str,
             while True:
                 alive = [p for _log, p in workers if p.poll() is None]
                 done = len(ledger.committed())
-                if done >= n_blocks:
+                if per_k or done >= n_blocks:
                     if not alive:
                         break
                     if grace_until is None:
@@ -230,41 +400,72 @@ def run_sharded(name: str, conf, inputs: Sequence[str], output: str,
                 log.close()
 
         # ------------------------------------------------------- merge
-        t_merge = time.perf_counter()
-        states = {bid: ledger.load_state(bid)
-                  for bid in ledger.committed()}
-        schema = None
-        if ops.kind == "dataset":
-            from avenir_tpu.runner import _schema
-
-            schema = _schema(cfg)
-        merged = merge_block_states(canonical, cfg, ops, plan, states,
-                                    list(inputs), root, schema=schema)
-        merge_ms = (time.perf_counter() - t_merge) * 1e3
-        if output:
-            parent = os.path.dirname(os.path.abspath(output))
-            os.makedirs(parent, exist_ok=True)
-        t0 = _obs.now()
-        res = _finish_fold(merged, output, canonical)
-        _obs.record("job.dispatch", t0, mode="sharded", procs=procs,
-                    blocks=n_blocks, jobs=canonical)
-
         stats = _worker_stats(root, procs)
-        claims = ledger.claims()
+        if per_k:
+            # the levels are already merged (per-k rounds); only the
+            # artifact write remains — zero coordinator-side counting
+            merge_ms = mined["merge_s"] * 1e3
+            t0 = _obs.now()
+            res = finish_miner_levels(
+                canonical, cfg, mined["levels"], mined["n"],
+                time.perf_counter() - t_scan, output,
+                extra_counters={
+                    "Cache:SpillBytes": float(sum(
+                        s.get("cache_bytes", 0) for s in stats)),
+                    "Cache:EvictedBytes": float(sum(
+                        s.get("cache_evicted", 0) for s in stats))})
+            _obs.record("job.dispatch", t0, mode="sharded",
+                        procs=procs, blocks=n_blocks,
+                        perk_rounds=mined["rounds"], jobs=canonical)
+        else:
+            t_merge = time.perf_counter()
+            states = {bid: ledger.load_state(bid)
+                      for bid in ledger.committed()}
+            schema = None
+            if ops.kind == "dataset":
+                from avenir_tpu.runner import _schema
+
+                schema = _schema(cfg)
+            merged = merge_block_states(canonical, cfg, ops, plan,
+                                        states, list(inputs), root,
+                                        schema=schema)
+            merge_ms = (time.perf_counter() - t_merge) * 1e3
+            if output:
+                parent = os.path.dirname(os.path.abspath(output))
+                os.makedirs(parent, exist_ok=True)
+            t0 = _obs.now()
+            res = _finish_fold(merged, output, canonical)
+            _obs.record("job.dispatch", t0, mode="sharded", procs=procs,
+                        blocks=n_blocks, jobs=canonical)
+
         by_id = {b.id: b for b in plan.blocks}
-        stolen = sum(1 for bid, info in claims.items()
-                     if bid in by_id
-                     and by_id[bid].home != info["worker"])
+        ledgers = [ledger] + [ledger.level(tag)
+                              for tag in (mined["tags"] if mined else ())]
+        stolen = dups = 0
+        for led in ledgers:
+            dups += led.dup_count()
+            stolen += sum(1 for bid, info in led.claims().items()
+                          if bid in by_id
+                          and by_id[bid].home != info["worker"])
         res.counters["Shard:Blocks"] = float(n_blocks)
         res.counters["Shard:StolenBlocks"] = float(stolen)
-        res.counters["Shard:DedupBlocks"] = float(ledger.dup_count())
+        res.counters["Shard:DedupBlocks"] = float(dups)
         res.counters["Shard:MergeMs"] = round(merge_ms, 3)
         res.counters["Shard:ScanSeconds"] = round(
             time.perf_counter() - t_scan, 4)
         res.counters["Shard:Workers"] = float(procs)
         if stats:
             res.counters["Shard:MirroredBlocks"] = float(
-                sum(s.get("mirrored", 0) for s in stats))
+                sum(s.get("mirrored", 0) + s.get("perk_mirrored", 0)
+                    for s in stats))
+        if per_k:
+            res.counters["Shard:PerKRounds"] = float(mined["rounds"])
+            res.counters["Shard:PerKBlocks"] = float(mined["blocks"])
+            # the distributed per-k phase's wall (pass-1 merge through
+            # final.json) — the denominator of the per-k speedup the
+            # shard_tripwire miner leg and stream_scale_check record
+            res.counters["Shard:PerKSeconds"] = round(
+                mined["perk_s"], 4)
         return res
     finally:
         if own_root:

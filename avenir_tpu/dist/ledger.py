@@ -29,6 +29,14 @@ Everything is observable from ``ls``: claims say who owes which block
 done, dups say the dedup fired. No daemon, no lock server; rename and
 link on one filesystem are the whole coordination substrate, exactly
 like the fleet's spool and lease files.
+
+**Namespaces** (:meth:`BlockLedger.level`): the miners' distributed
+per-k rounds reuse the same claim/commit discipline once per candidate
+length — level ``k`` counts block ``b`` under ``ledger/k<k>/b<b>``, so
+one block id claims, commits and dedups independently PER LEVEL and a
+block's candidate counts fold into a level's merged support exactly
+once. The default (pass-1) namespace is the bare ``ledger/`` root, so
+every pre-existing caller is the empty-namespace case.
 """
 
 from __future__ import annotations
@@ -45,13 +53,25 @@ class BlockLedger:
     ``<root>/ledger``. Safe for concurrent use by any number of worker
     processes on one filesystem."""
 
-    def __init__(self, root: str):
-        self.root = os.path.join(root, "ledger")
+    def __init__(self, root: str, ns: str = ""):
+        self._base = root
+        self.ns = ns
+        self.root = (os.path.join(root, "ledger", ns) if ns
+                     else os.path.join(root, "ledger"))
         self.claims_dir = os.path.join(self.root, "claims")
         self.states_dir = os.path.join(self.root, "states")
         self.dups_dir = os.path.join(self.root, "dups")
         for d in (self.claims_dir, self.states_dir, self.dups_dir):
             os.makedirs(d, exist_ok=True)
+
+    def level(self, ns: str) -> "BlockLedger":
+        """A NAMESPACED sub-ledger under ``ledger/<ns>/`` — the per-k
+        rounds' handle (``level("k2")`` scopes block ``b`` at
+        ``k2/b<b>``): same first-commit-wins discipline, independent
+        claim/commit/dup state per level."""
+        if not ns or os.sep in ns or ns != os.path.basename(ns):
+            raise ValueError(f"bad ledger namespace {ns!r}")
+        return BlockLedger(self._base, ns=ns)
 
     # ---------------------------------------------------------- claims
     def claim_path(self, block_id: int) -> str:

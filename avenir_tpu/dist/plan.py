@@ -95,6 +95,10 @@ class ShardPlan:
     inputs: List[Dict] = field(default_factory=list)
     blocks: List[ShardBlock] = field(default_factory=list)
     policy: Dict[str, float] = field(default_factory=dict)
+    #: miner jobs: workers stay resident after pass 1 and re-enter the
+    #: per-k candidate-counting loop against the level-namespaced
+    #: ledger (driver publishes candidate manifests, workers count)
+    per_k: bool = False
 
     def input_paths(self) -> List[str]:
         return [str(i["path"]) for i in self.inputs]
@@ -108,7 +112,8 @@ class ShardPlan:
                 "props": dict(self.props),
                 "inputs": [dict(i) for i in self.inputs],
                 "blocks": [b.to_dict() for b in self.blocks],
-                "policy": dict(self.policy)}
+                "policy": dict(self.policy),
+                "per_k": bool(self.per_k)}
 
     @classmethod
     def from_dict(cls, obj: Dict) -> "ShardPlan":
@@ -119,7 +124,8 @@ class ShardPlan:
                    inputs=[dict(i) for i in obj.get("inputs", [])],
                    blocks=[ShardBlock.from_dict(b)
                            for b in obj.get("blocks", [])],
-                   policy=dict(obj.get("policy", {})))
+                   policy=dict(obj.get("policy", {})),
+                   per_k=bool(obj.get("per_k", False)))
 
 
 def _align_boundaries(path: str, size: int, n: int) -> List[Tuple[int, int]]:
@@ -188,14 +194,22 @@ def plan_shards(inputs: Sequence[str], procs: int,
     return plan
 
 
-def write_plan(plan: ShardPlan, path: str) -> str:
-    """Atomically publish the plan manifest (tmp+rename): a reader
-    either sees no plan or a complete one, never a torn table."""
+def write_json_atomic(obj: Dict, path: str) -> str:
+    """Atomically publish one JSON manifest (tmp+rename, the spool
+    discipline): a reader either sees no manifest or a complete one,
+    never a torn table. Shared by the plan manifest and the per-k
+    candidate manifests the sharded mining driver publishes under
+    ``<root>/candidates/``."""
     tmp = f"{path}.tmp.{os.getpid()}"
     with open(tmp, "w") as fh:
-        json.dump(plan.to_dict(), fh, indent=1)
+        json.dump(obj, fh, indent=1)
     os.replace(tmp, path)
     return path
+
+
+def write_plan(plan: ShardPlan, path: str) -> str:
+    """Atomically publish the plan manifest — see write_json_atomic."""
+    return write_json_atomic(plan.to_dict(), path)
 
 
 def load_plan(path: str) -> ShardPlan:

@@ -68,6 +68,93 @@ def merge_support_counts(*states: "Dict") -> "Dict":
     return out
 
 
+def frequent_tokens(support1: Dict, min_count: float) -> List[str]:
+    """The canonical frequent-token frontier after the merged k=1
+    round: tokens whose merged support beats the threshold, SORTED —
+    the one ordering every merged/sharded driver derives candidates
+    (and the per-shard masks) from."""
+    return sorted(t for t, cnt in support1.items() if cnt > min_count)
+
+
+def stream_candidate_support(src: "StreamingTransactionSource",
+                             cand_ids: List[Tuple[int, ...]],
+                             c_pad: int, block: int = 8192) -> np.ndarray:
+    """One streamed support pass over ONE source: candidates (masked
+    item-id tuples in `src`'s id space) packed into a [c_pad, words]
+    bitset matrix, blocks double-buffered against the donated int32
+    device fold. The SINGLE implementation of the N-proportional
+    counting — mine_stream, the sharded mine_stream_merged driver and
+    the distributed per-k block workers all fold through it, which is
+    what makes their counts (and therefore their outputs) identical by
+    construction."""
+    from avenir_tpu.core.stream import double_buffered
+    from avenir_tpu.ops.bitset import (bitset_fold_counts,
+                                       pack_index_rows_u32)
+
+    cand_d = jnp.asarray(pack_index_rows_u32(
+        cand_ids, src.masked_width, c_pad))
+    counts_d = jnp.zeros(c_pad, jnp.int32)
+    for packed in double_buffered(src.packed_chunks(block)):
+        # host-side span: the donated fold dispatches async, so the
+        # duration is dispatch+transfer time, not device occupancy
+        t0 = _obs.now()
+        counts_d = bitset_fold_counts(
+            counts_d, jnp.asarray(packed), cand_d)
+        _obs.record("stream.fold", t0, sink="apriori_support")
+    return np.asarray(counts_d, np.int64)
+
+
+def count_token_supports(src: "StreamingTransactionSource",
+                         cands: List[Tuple[str, ...]], c_pad: int,
+                         block: int = 8192) -> np.ndarray:
+    """Support counts of canonical TOKEN-space candidates over ONE
+    source, aligned to ``cands``: translate per source via token_code
+    (a candidate holding a token this source never saw — or masked out
+    — counts 0 without a scan), count the present ones through the one
+    :func:`stream_candidate_support` fold. The per-shard body of
+    mine_stream_merged AND the sharded per-k worker's block fold."""
+    ids = [tuple(src.token_code(t) for t in cd) for cd in cands]
+    present = [ci for ci, m in enumerate(ids)
+               if all(i >= 0 for i in m)]
+    counts = np.zeros(len(cands), np.int64)
+    if present:
+        shard = stream_candidate_support(
+            src, [ids[ci] for ci in present], c_pad, block)
+        counts[present] = shard[:len(present)]
+    return counts
+
+
+def collect_token_trans_ids(src: "StreamingTransactionSource",
+                            all_sets: List[Tuple[str, ...]], c_pad: int,
+                            block: int = 8192) -> List[List[str]]:
+    """Per-set exact transaction-id lists over ONE source for the fused
+    all-lengths id pass (fia.emit.trans.id): token-space sets translate
+    via token_code, row ids come back in THIS source's row order — the
+    per-shard body of _collect_trans_ids_merged and the sharded tids
+    level's block fold. NOTE: rows come from ``src.chunks`` (the
+    id-bearing python feed), so a per-block caller must hand a source
+    whose paths ARE its block (a byte slice) — the cache stores no
+    ids."""
+    from avenir_tpu.ops.bitset import (bitset_contain_mask,
+                                       pack_index_rows_u32, pack_rows_u32)
+
+    tids: List[List[str]] = [[] for _ in all_sets]
+    ids = [tuple(src.token_code(t) for t in cd) for cd in all_sets]
+    present = [ci for ci, m in enumerate(ids)
+               if all(i >= 0 for i in m)]
+    if not present:
+        return tids
+    cand_d = jnp.asarray(pack_index_rows_u32(
+        [ids[ci] for ci in present], src.masked_width, c_pad))
+    for mh, row_ids in src.chunks(block, with_ids=True):
+        m = np.asarray(bitset_contain_mask(
+            jnp.asarray(pack_rows_u32(mh)), cand_d))
+        for pi, ci in enumerate(present):
+            for r in np.flatnonzero(m[:len(row_ids), pi]):
+                tids[ci].append(str(row_ids[r]))
+    return tids
+
+
 class TransactionSet:
     """Dictionary-encoded transactions: multi-hot uint8 [N, V] + id column.
 
@@ -688,28 +775,58 @@ class FrequentItemsApriori:
     def _stream_support(self, src: StreamingTransactionSource,
                         cand_ids: List[Tuple[int, ...]], c_pad: int
                         ) -> np.ndarray:
-        """One streamed support pass over ONE source: candidates (masked
-        item-id tuples in `src`'s id space) packed into a [c_pad, words]
-        bitset matrix, blocks double-buffered against the donated int32
-        device fold. The SINGLE implementation of the N-proportional
-        counting, shared by mine_stream and the sharded
-        mine_stream_merged driver — which is what makes their counts
-        (and therefore their outputs) identical by construction."""
-        from avenir_tpu.core.stream import double_buffered
-        from avenir_tpu.ops.bitset import (bitset_fold_counts,
-                                           pack_index_rows_u32)
+        """One streamed support pass over ONE source — the module-level
+        :func:`stream_candidate_support` at this miner's block size."""
+        return stream_candidate_support(src, cand_ids, c_pad, self.block)
 
-        cand_d = jnp.asarray(pack_index_rows_u32(
-            cand_ids, src.masked_width, c_pad))
-        counts_d = jnp.zeros(c_pad, jnp.int32)
-        for packed in double_buffered(src.packed_chunks(self.block)):
-            # host-side span: the donated fold dispatches async, so the
-            # duration is dispatch+transfer time, not device occupancy
-            t0 = _obs.now()
-            counts_d = bitset_fold_counts(
-                counts_d, jnp.asarray(packed), cand_d)
-            _obs.record("stream.fold", t0, sink="apriori_support")
-        return np.asarray(counts_d, np.int64)
+    def _merged_rounds(self, support1: Dict, n: int, count_fn):
+        """The per-k control loop of the MERGED mining drivers over
+        canonical token-space candidates: threshold the merged k=1
+        supports, generate each level's candidates, count them through
+        ``count_fn(k, cands, c_pad) -> int64 [len(cands)]``, prune, and
+        stop on an empty frontier. Shared by mine_stream_merged (counts
+        per shard source in-process) and the sharded per-k driver
+        (counts per ledger block across worker processes) — ONE loop,
+        so their kept sets and counts agree by construction."""
+        min_count = self.support_threshold * n
+        freq_toks = frequent_tokens(support1, min_count)
+        rounds: List[Tuple[int, List[Tuple[str, ...]], List[int]]] = [
+            (1, [(t,) for t in freq_toks],
+             [int(support1[t]) for t in freq_toks])]
+
+        freq_sets: List[Tuple[str, ...]] = rounds[0][1]
+        for k in range(2, self.max_length + 1):
+            cands = _generate_candidates(freq_sets, k)
+            if not cands:
+                break
+            c_pad = max(64, 1 << (len(cands) - 1).bit_length())
+            counts = count_fn(k, cands, c_pad)
+            kept = [(cd, int(cnt)) for cd, cnt in zip(cands, counts)
+                    if cnt > min_count]
+            if not kept:
+                break
+            freq_sets = [cd for cd, _ in kept]
+            rounds.append((k, freq_sets, [cnt for _, cnt in kept]))
+        return rounds
+
+    def _pack_merged_rounds(self, rounds, n: int,
+                            tids: Optional[List[List[str]]] = None
+                            ) -> List[ItemSetList]:
+        """Merged rounds -> per-length ItemSetLists (sorted sets, global
+        support fractions) — the artifact-shaping tail shared by
+        mine_stream_merged and the sharded per-k driver."""
+        out: List[ItemSetList] = []
+        at = 0
+        for k, sets_k, counts_k in rounds:
+            sets = []
+            for ci, cd in enumerate(sets_k):
+                sets.append(ItemSet(
+                    tuple(sorted(cd)), counts_k[ci] / n, int(counts_k[ci]),
+                    tids[at + ci] if tids is not None else None))
+            sets.sort(key=lambda s: s.items)
+            out.append(ItemSetList(k, sets))
+            at += len(sets_k)
+        return out
 
     def mine_stream_merged(self, sources: Sequence[StreamingTransactionSource]
                            ) -> List[ItemSetList]:
@@ -737,80 +854,38 @@ class FrequentItemsApriori:
         support1 = merge_support_counts(
             *[{vocab[i]: int(counts[i]) for i in range(len(vocab))}
               for vocab, counts, _n in scans])
-        freq_toks = sorted(t for t, cnt in support1.items()
-                           if cnt > min_count)
+        freq_toks = frequent_tokens(support1, min_count)
         for src in srcs:
             src.mask_items([src.index[t] for t in freq_toks
                             if t in src.index])
-        rounds: List[Tuple[int, List[Tuple[str, ...]], List[int]]] = [
-            (1, [(t,) for t in freq_toks],
-             [int(support1[t]) for t in freq_toks])]
 
-        freq_sets: List[Tuple[str, ...]] = rounds[0][1]
-        for k in range(2, self.max_length + 1):
-            cands = _generate_candidates(freq_sets, k)
-            if not cands:
-                break
-            c_pad = max(64, 1 << (len(cands) - 1).bit_length())
+        def count_level(k, cands, c_pad):
             counts = np.zeros(len(cands), np.int64)
             for src in srcs:
-                ids = [tuple(src.token_code(t) for t in cd) for cd in cands]
-                present = [ci for ci, m in enumerate(ids)
-                           if all(i >= 0 for i in m)]
-                if not present:
-                    continue
-                shard = self._stream_support(
-                    src, [ids[ci] for ci in present], c_pad)
-                counts[present] += shard[:len(present)]
-            kept = [(cd, int(cnt)) for cd, cnt in zip(cands, counts)
-                    if cnt > min_count]
-            if not kept:
-                break
-            freq_sets = [cd for cd, _ in kept]
-            rounds.append((k, freq_sets, [cnt for _, cnt in kept]))
+                counts += count_token_supports(src, cands, c_pad,
+                                               self.block)
+            return counts
 
+        rounds = self._merged_rounds(support1, n, count_level)
         tids = self._collect_trans_ids_merged(srcs, rounds) \
             if self.emit_trans_id else None
-        out: List[ItemSetList] = []
-        at = 0
-        for k, sets_k, counts_k in rounds:
-            n_k = len(sets_k)
-            sets = []
-            for ci, cd in enumerate(sets_k):
-                sets.append(ItemSet(
-                    tuple(sorted(cd)), counts_k[ci] / n, int(counts_k[ci]),
-                    tids[at + ci] if tids is not None else None))
-            sets.sort(key=lambda s: s.items)
-            out.append(ItemSetList(k, sets))
-            at += n_k
-        return out
+        return self._pack_merged_rounds(rounds, n, tids)
 
     def _collect_trans_ids_merged(self, srcs, rounds) -> List[List[str]]:
         """The exact-trans-id pass of the sharded driver: one fused
-        all-lengths scan PER SHARD, per-candidate id lists concatenated
-        in shard order (= corpus order for byte-range shards)."""
-        from avenir_tpu.ops.bitset import (bitset_contain_mask,
-                                           pack_index_rows_u32, pack_rows_u32)
-
+        all-lengths scan PER SHARD (collect_token_trans_ids),
+        per-candidate id lists concatenated in shard order (= corpus
+        order for byte-range shards)."""
         all_sets = [cd for _k, sets_k, _c in rounds for cd in sets_k]
         tids: List[List[str]] = [[] for _ in all_sets]
         if not all_sets:
             return tids
         c_pad = max(64, 1 << (len(all_sets) - 1).bit_length())
         for src in srcs:
-            ids = [tuple(src.token_code(t) for t in cd) for cd in all_sets]
-            present = [ci for ci, m in enumerate(ids)
-                       if all(i >= 0 for i in m)]
-            if not present:
-                continue
-            cand_d = jnp.asarray(pack_index_rows_u32(
-                [ids[ci] for ci in present], src.masked_width, c_pad))
-            for mh, row_ids in src.chunks(self.block, with_ids=True):
-                m = np.asarray(bitset_contain_mask(
-                    jnp.asarray(pack_rows_u32(mh)), cand_d))
-                for pi, ci in enumerate(present):
-                    for r in np.flatnonzero(m[:len(row_ids), pi]):
-                        tids[ci].append(str(row_ids[r]))
+            shard = collect_token_trans_ids(src, all_sets, c_pad,
+                                            self.block)
+            for ci in range(len(all_sets)):
+                tids[ci].extend(shard[ci])
         return tids
 
     def _collect_trans_ids(self, src: StreamingTransactionSource,

@@ -122,6 +122,43 @@ def _subseq_fold_kernel(acc: jnp.ndarray, rows: jnp.ndarray,
     return acc + _subseq_support_kernel(rows, cands, k_vec)
 
 
+def stream_candidate_support(src: "StreamingSequenceSource",
+                             cands: List[Tuple[str, ...]], c_pad: int,
+                             block: int = 65536) -> np.ndarray:
+    """One streamed support pass over ONE source: token-space
+    candidates encoded via src.token_code (-2 for tokens this source
+    never saw, which match nothing), blocks double-buffered against
+    the donated int32 device fold. The SINGLE implementation of the
+    N-proportional counting — mine_stream, the sharded
+    mine_stream_merged driver and the distributed per-k block workers
+    all fold through it, which is what makes their counts (and
+    therefore their outputs) identical by construction."""
+    from avenir_tpu.core.stream import double_buffered
+
+    cand_d, kv = GSPMiner._cand_arrays(cands, src.token_code, c_pad)
+    counts_d = jnp.zeros(c_pad, jnp.int32)
+    for blk in double_buffered(src.chunks(block)):
+        # host-side span: the donated fold dispatches async, so the
+        # duration is dispatch+transfer time, not device occupancy
+        t0 = _obs.now()
+        counts_d = _subseq_fold_kernel(
+            counts_d, jnp.asarray(blk), cand_d, kv)
+        _obs.record("stream.fold", t0, sink="gsp_support")
+    return np.asarray(counts_d, np.int64)
+
+
+def count_token_supports(src: "StreamingSequenceSource",
+                         cands: List[Tuple[str, ...]], c_pad: int,
+                         block: int = 65536) -> np.ndarray:
+    """Support counts of token-space GSP candidates over ONE source,
+    aligned to ``cands`` — the per-shard body of mine_stream_merged
+    AND the sharded per-k worker's block fold. GSP candidates are
+    already canonical token tuples, so token_code's -2 never-matches
+    sentinel handles absent tokens without present-filtering."""
+    return stream_candidate_support(src, cands, c_pad,
+                                    block)[:len(cands)]
+
+
 @dataclass
 class SequenceSet:
     """Dictionary-encoded, padded sequences (pad token -1)."""
@@ -551,25 +588,38 @@ class GSPMiner:
     def _stream_support(self, src: StreamingSequenceSource,
                         cands: List[Tuple[str, ...]], c_pad: int
                         ) -> np.ndarray:
-        """One streamed support pass over ONE source: token-space
-        candidates encoded via src.token_code (-2 for tokens this source
-        never saw, which match nothing), blocks double-buffered against
-        the donated int32 device fold. The SINGLE implementation of the
-        N-proportional counting, shared by mine_stream and the sharded
-        mine_stream_merged driver — which is what makes their counts
-        (and therefore their outputs) identical by construction."""
-        from avenir_tpu.core.stream import double_buffered
+        """One streamed support pass over ONE source — the module-level
+        :func:`stream_candidate_support` at this miner's block size."""
+        return stream_candidate_support(src, cands, c_pad, self.block)
 
-        cand_d, kv = self._cand_arrays(cands, src.token_code, c_pad)
-        counts_d = jnp.zeros(c_pad, jnp.int32)
-        for blk in double_buffered(src.chunks(self.block)):
-            # host-side span: the donated fold dispatches async, so the
-            # duration is dispatch+transfer time, not device occupancy
-            t0 = _obs.now()
-            counts_d = _subseq_fold_kernel(
-                counts_d, jnp.asarray(blk), cand_d, kv)
-            _obs.record("stream.fold", t0, sink="gsp_support")
-        return np.asarray(counts_d, np.int64)
+    def _merged_rounds(self, support1: Dict, n: int, count_fn
+                       ) -> Dict[int, Dict[Tuple[str, ...], float]]:
+        """The per-k control loop of the MERGED GSP drivers: threshold
+        the merged k=1 supports, generate each level's candidates,
+        count them through ``count_fn(k, cands, c_pad) -> int64
+        [len(cands)]``, prune, stop on an empty frontier. Shared by
+        mine_stream_merged (counts per shard source in-process) and
+        the sharded per-k driver (counts per ledger block across
+        worker processes) — ONE loop, so their kept sets and supports
+        agree by construction."""
+        min_count = self.support_threshold * n
+        out: Dict[int, Dict[Tuple[str, ...], float]] = {}
+        freq = {(tok,): cnt / n for tok, cnt in sorted(support1.items())
+                if cnt > min_count}
+        out[1] = freq
+
+        for k in range(2, self.max_length + 1):
+            cands = generate_sequence_candidates(list(freq))
+            if not cands:
+                break
+            c_pad = max(16, 1 << (len(cands) - 1).bit_length())
+            counts = count_fn(k, cands, c_pad)
+            freq = {c: cnt / n for c, cnt in zip(cands, counts)
+                    if cnt > min_count}
+            if not freq:
+                break
+            out[k] = freq
+        return out
 
     def mine_stream_merged(self, sources: Sequence[StreamingSequenceSource]
                            ) -> Dict[int, Dict[Tuple[str, ...], float]]:
@@ -594,28 +644,20 @@ class GSPMiner:
         support1 = merge_support_counts(
             *[{vocab[i]: int(counts[i]) for i in range(len(vocab))}
               for vocab, counts, _n in scans])
-        out: Dict[int, Dict[Tuple[str, ...], float]] = {}
-        freq = {(tok,): cnt / n for tok, cnt in sorted(support1.items())
-                if cnt > min_count}
-        out[1] = freq
+        freq_toks = [tok for tok, cnt in sorted(support1.items())
+                     if cnt > min_count]
         for src in srcs:
-            src.mask_tokens([src.index[tok] for (tok,) in freq
+            src.mask_tokens([src.index[tok] for tok in freq_toks
                              if tok in src.index])
 
-        for k in range(2, self.max_length + 1):
-            cands = generate_sequence_candidates(list(freq))
-            if not cands:
-                break
-            c_pad = max(16, 1 << (len(cands) - 1).bit_length())
+        def count_level(k, cands, c_pad):
             counts = np.zeros(len(cands), np.int64)
             for src in srcs:
-                counts += self._stream_support(src, cands, c_pad)[:len(cands)]
-            freq = {c: cnt / n for c, cnt in zip(cands, counts)
-                    if cnt > min_count}
-            if not freq:
-                break
-            out[k] = freq
-        return out
+                counts += count_token_supports(src, cands, c_pad,
+                                               self.block)
+            return counts
+
+        return self._merged_rounds(support1, n, count_level)
 
 
 # ---------------------------------------------------------------------------

@@ -87,6 +87,13 @@ class FaultPolicy:
     #: attempts before a request is failed in-band instead of requeued
     #: (a poison request must not crash-loop the whole fleet)
     max_requeues: int = 2
+    #: how long a STRANDED request (attempt trail covers every host,
+    #: none healthy) may wait for a restarting/stalled host to recover
+    #: before it is abandoned in-band — the bound that keeps "never
+    #: hang to the collect() timeout" true even when the only hosts
+    #: left are permanently wedged (STALLED never restarts: only an
+    #: exit code triggers respawn)
+    stranded_patience_s: float = 60.0
     #: hedge when a host's queue-wait p99 (or oldest pending age) runs
     #: past this multiple of the fleet median
     hedge_multiple: float = 4.0

@@ -115,7 +115,8 @@ class _Outstanding:
     them — re-execution is safe by the idempotency contract, so a late
     duplicate is an identical write, never a conflict."""
 
-    __slots__ = ("copies", "obj", "submitted_at", "lease", "mirrored")
+    __slots__ = ("copies", "obj", "submitted_at", "lease", "mirrored",
+                 "stranded_at")
 
     def __init__(self, copy: _Copy, obj: Dict, submitted_at: float,
                  lease: Lease):
@@ -124,6 +125,10 @@ class _Outstanding:
         self.submitted_at = submitted_at
         self.lease = lease
         self.mirrored = False
+        #: when the request first became STRANDED (trail covers every
+        #: host, none healthy) — the patience clock _rescue_stranded
+        #: abandons on; None while the request has a way forward
+        self.stranded_at: Optional[float] = None
 
 
 class Fleet:
@@ -142,14 +147,24 @@ class Fleet:
                  profile_dir: Optional[str] = None,
                  env: Optional[Dict[str, str]] = None,
                  pin_cores: Optional[Sequence[int]] = None,
-                 fault_policy: Optional[FaultPolicy] = None):
+                 fault_policy: Optional[FaultPolicy] = None,
+                 listen_addresses: Optional[Dict[int, str]] = None):
         """``pin_cores``: pin host i to CPU ``pin_cores[i % len]``
         (Linux ``sched_setaffinity``; ignored where unsupported). On a
         shared box an UNPINNED single process borrows every core
         through XLA's intra-op threads, so a same-box fleet-vs-one
         comparison measures nothing — pinning one core per host is
         what makes a single machine a faithful proxy for N hosts
-        (``bench_scaling.fleet_tripwire`` relies on it)."""
+        (``bench_scaling.fleet_tripwire`` relies on it).
+
+        ``listen_addresses``: base URL per host index (e.g.
+        ``{0: "http://127.0.0.1:8191"}``) for hosts that run a
+        ``--listen`` edge — the supervisor then heartbeats those hosts
+        through ``fault.probe_healthz`` (/healthz) instead of the
+        metrics.json mtime: a listener answering "serving"/"draining"
+        is live; a refused probe or a quarantined/restarting overlay
+        marks the host stalled and out of placement. The exit-code
+        check stays authoritative for death either way."""
         if hosts < 1:
             raise ValueError("fleet needs at least one host")
         self.root = os.path.abspath(root)
@@ -165,6 +180,16 @@ class Fleet:
         self._env = env
         self.pin_cores = list(pin_cores) if pin_cores else None
         self.fault = fault_policy or FaultPolicy()
+        self.listen_addresses = dict(listen_addresses or {})
+        #: per-host (stamped_at, hb_live) memo of the last /healthz
+        #: probe: the probe is a blocking HTTP round trip (a WEDGED
+        #: listener holds the connection to the timeout — the exact
+        #: state it exists to detect), so it must not run every tick or
+        #: stalled hosts would stall the whole supervisor loop past the
+        #: lease-renewal window; probing at half the heartbeat budget
+        #: keeps detection latency inside the same bound the mtime
+        #: heartbeat has
+        self._probe_memo: Dict[int, Tuple[float, bool]] = {}
         self._procs: List[Optional[subprocess.Popen]] = [None] * hosts
         self._logs: List[str] = [
             os.path.join(d, "server.log") for d in self.host_dirs]
@@ -585,20 +610,48 @@ class Fleet:
                                                   "in")):
                         self._set_host_state(i, fault.SERVING)
                 continue
-            # alive host: the spool heartbeat (metrics.json mtime) is
-            # the liveness signal — a live process that stopped
-            # refreshing it is wedged or stopped (SIGSTOP, hard IO
-            # stall) and must not take new placements
+            # alive host: a listener-fronted host heartbeats through
+            # /healthz (fault.probe_healthz — "serving"/"draining"
+            # answers are live, a refused probe or a quarantined/
+            # restarting overlay is not); spool-only hosts heartbeat
+            # through the metrics.json mtime. Either way a live
+            # process that stopped answering is wedged or stopped
+            # (SIGSTOP, hard IO stall) and must not take new
+            # placements
+            booting = now - spawned_at <= self._hb_timeout
+            addr = self.listen_addresses.get(i)
+            if addr is not None:
+                hb_live = self._probe_host(i, addr, now)
+                if state == fault.SERVING and not hb_live \
+                        and not booting:
+                    self._set_host_state(i, fault.STALLED)
+                elif state == fault.STALLED and hb_live:
+                    self._set_host_state(i, fault.SERVING)
+                continue
             age = fault.heartbeat_age_s(
                 os.path.join(self.host_dirs[i], "metrics.json"), now)
             if age is None:
                 age = now - spawned_at
-            booting = now - spawned_at <= self._hb_timeout
             if state == fault.SERVING and age > self._hb_timeout \
                     and not booting:
                 self._set_host_state(i, fault.STALLED)
             elif state == fault.STALLED and age <= self._hb_timeout:
                 self._set_host_state(i, fault.SERVING)
+
+    def _probe_host(self, i: int, addr: str, now: float) -> bool:
+        """Memoized /healthz liveness of a listener-fronted host:
+        re-probes at most every hb_timeout/2 with a timeout bounded
+        well under the heartbeat budget, so N wedged listeners can
+        never stall the supervisor tick past the lease-renewal
+        window."""
+        hit = self._probe_memo.get(i)
+        if hit is not None and now - hit[0] < self._hb_timeout / 2.0:
+            return hit[1]
+        timeout = min(2.0, max(self._hb_timeout / 4.0, 0.25))
+        status = fault.probe_healthz(addr, timeout=timeout)
+        hb_live = status in ("serving", "draining")
+        self._probe_memo[i] = (now, hb_live)
+        return hb_live
 
     @staticmethod
     def _copy_on(entry: _Outstanding, host: int) -> _Copy:
@@ -646,7 +699,12 @@ class Fleet:
                 continue
             if dead or state in (fault.RESTARTING, fault.QUARANTINED) \
                     or lease.expired(now):
-                self._requeue(name, entry, now)
+                if not self._requeue(name, entry, now):
+                    # the requeue found no excluded-compliant host: a
+                    # STRANDED request (trail covers every host) must
+                    # respool or abandon in-band, never hang until the
+                    # caller's collect() timeout
+                    self._rescue_stranded(name, entry, now)
 
     def _requeue(self, name: str, entry: _Outstanding,
                  now: float) -> bool:
@@ -659,20 +717,11 @@ class Fleet:
         headroom this tick."""
         lease = entry.lease
         if lease.attempts > self.fault.max_requeues:
-            row = {"ok": False, "error":
-                   f"request abandoned after {lease.attempts} attempts "
-                   f"across hosts {lease.hosts} (max_requeues="
-                   f"{self.fault.max_requeues})"}
-            if lease.nonce:
-                row["nonce"] = lease.nonce
-            with self._lock:
-                if self._outstanding.pop(name, None) is None:
-                    return True
-                self._collected[name] = row
-                self._fault_stats["abandoned"] += 1
-                copies = list(entry.copies)
-            _release_placements(self.router, copies)
-            self._leases.remove(name)
+            self._abandon(
+                name, entry,
+                f"request abandoned after {lease.attempts} attempts "
+                f"across hosts {lease.hosts} (max_requeues="
+                f"{self.fault.max_requeues})")
             return True
         req, priced, cost = self.price(entry.obj)
         placement = self.router.place(affinity_key(req), priced, cost,
@@ -719,19 +768,89 @@ class Fleet:
         self._leases.write(lease)
         return True
 
-    def _respool(self, name: str, entry: _Outstanding,
-                 now: float) -> None:
-        """Re-spool a stranded request into its (restarted) lease
-        host's OWN in/ — the fallback when the requeue exclusion
-        leaves no other host: the new incarnation never saw the claim
-        the old one died holding, and re-execution is safe, so handing
-        it the request again beats never serving it. The copy rides
-        the ORIGINAL placement's budget charge (same host, same
-        request — not new load)."""
+    def _abandon(self, name: str, entry: _Outstanding,
+                 error: str) -> None:
+        """Resolve one outstanding request as an in-band failure row:
+        the terminal move for a poison request past the requeue cap
+        and for a stranded request no host can ever take again. The
+        row honors the nonce namespace, every copy's placement is
+        released, the lease removed — the caller's collect() returns
+        a failure instead of timing out."""
+        lease = entry.lease
+        row = {"ok": False, "error": error}
+        if lease.nonce:
+            row["nonce"] = lease.nonce
+        with self._lock:
+            if self._outstanding.pop(name, None) is None:
+                return             # raced a sweep: the result landed
+            self._collected[name] = row
+            self._fault_stats["abandoned"] += 1
+            copies = list(entry.copies)
+        _release_placements(self.router, copies)
+        self._leases.remove(name)
+
+    def _rescue_stranded(self, name: str, entry: _Outstanding,
+                         now: float) -> None:
+        """A request the requeue could not move this tick. Distinguish
+        'no headroom yet' (an untried SERVING host may still take it —
+        wait, capacity frees when results land) from STRANDED: the
+        attempt trail covers every host, so no requeue can ever land.
+        A stranded request resolves in-band — respooled to a healthy
+        trail host (re-execution is safe by the idempotency contract,
+        and the respool's attempt bump walks it into the max_requeues
+        cap if the failures keep coming) or abandoned with a failure
+        row: immediately when every host is quarantined/stopped, and
+        after ``stranded_patience_s`` when the only hosts left are
+        restarting/stalled (a brief stall recovers; a permanently
+        wedged host must not hold the request to the collect()
+        timeout — STALLED never respawns, only an exit code does).
+        ``attempts`` only grows on moves, so the cap alone can never
+        fire for a request nobody can move."""
+        lease = entry.lease
+        with self._lock:
+            states = list(self._host_state)
+            procs = list(self._procs)
+        trail = set(lease.hosts)
+        if any(h not in trail and s == fault.SERVING
+               for h, s in enumerate(states)):
+            entry.stranded_at = None
+            return                 # headroom wait: capacity frees
+        healthy_trail = [h for h in sorted(trail)
+                         if h < len(states)
+                         and states[h] == fault.SERVING
+                         and procs[h] is not None]
+        if healthy_trail:
+            entry.stranded_at = None
+            self._respool(name, entry, now, host=healthy_trail[0])
+            return
+        if any(s in (fault.RESTARTING, fault.STALLED) for s in states):
+            # a host may yet recover: wait, but only within patience
+            if entry.stranded_at is None:
+                entry.stranded_at = now
+            if now - entry.stranded_at \
+                    <= self.fault.stranded_patience_s:
+                return
+        self._abandon(
+            name, entry,
+            f"request stranded: attempt trail {sorted(trail)} covers "
+            f"every host and none is healthy (states {states})")
+
+    def _respool(self, name: str, entry: _Outstanding, now: float,
+                 host: Optional[int] = None) -> None:
+        """Re-spool a stranded request into a trail host's OWN in/ —
+        the fallback when the requeue exclusion leaves no other host.
+        Default target: the lease host (the restarted-incarnation
+        case: the new process never saw the claim the old one died
+        holding); a stranded request whose lease host stays dead
+        respools to any healthy trail host instead. Re-execution is
+        safe, so handing the request back beats never serving it. The
+        copy rides that host's EXISTING placement charge (same host,
+        same request — not new load)."""
         lease = entry.lease
         if lease.attempts > self.fault.max_requeues:
             return                 # the requeue cap will abandon it
-        prior = self._copy_on(entry, lease.host)
+        host = lease.host if host is None else host
+        prior = self._copy_on(entry, host)
         new_name = self._next_name()
         copy = self._write_copy(prior.placement, new_name, entry.obj)
         with self._lock:
@@ -742,10 +861,11 @@ class Fleet:
         if landed:                 # raced a sweep: just unspool it
             try:
                 os.remove(os.path.join(
-                    self.host_dirs[lease.host], "in", new_name))
+                    self.host_dirs[host], "in", new_name))
             except OSError:
                 pass
             return
+        lease.host = host
         lease.claimed_at = now
         lease.attempts += 1
         entry.submitted_at = now

@@ -742,6 +742,72 @@ def _write_gsp_outputs(cfg: JobConfig, output: str, levels) -> List[str]:
     return outs
 
 
+def finish_miner_levels(canonical: str, cfg: JobConfig, levels,
+                        n_rows: int, wall_s: float, output: str,
+                        extra_counters: Optional[Dict[str, float]] = None
+                        ) -> "JobResult":
+    """Artifact write + counter assembly for a miner whose per-k levels
+    were computed OUTSIDE a fold sink — the sharded per-k driver's
+    finish: same writers and counter names as ``_MinerScanFold.finish``
+    (and the warm-serve path), so a sharded miner's artifacts and
+    result row are indistinguishable from the solo runner's."""
+    if canonical == "frequentItemsApriori":
+        counters = {"Apriori:MaxLength": len(levels),
+                    **throughput_counters(n_rows, wall_s)}
+        outs = _write_apriori_outputs(cfg, output, levels)
+    else:
+        counters = {"GSP:MaxLength": max(levels) if levels else 0,
+                    **throughput_counters(n_rows, wall_s)}
+        outs = _write_gsp_outputs(cfg, output, levels)
+    counters.update(extra_counters or {})
+    return JobResult(canonical, counters, outs, levels)
+
+
+def _build_miner(canonical: str, cfg: JobConfig):
+    """The miner object one prefixed conf describes — ONE constructor
+    shared by the miner fold sink, the warm-serve path and the sharded
+    per-k driver/worker, so a new mining knob cannot land in one of
+    them and silently miss the others."""
+    if canonical == "frequentItemsApriori":
+        from avenir_tpu.models.association import FrequentItemsApriori
+
+        return FrequentItemsApriori(
+            support_threshold=cfg.assert_float("support.threshold"),
+            max_length=cfg.get_int("item.set.length", 3),
+            emit_trans_id=cfg.get_bool("emit.trans.id", False))
+    if canonical == "candidateGenerationWithSelfJoin":
+        from avenir_tpu.models.sequence import GSPMiner
+
+        return GSPMiner(
+            support_threshold=cfg.assert_float("support.threshold"),
+            max_length=cfg.get_int("item.set.length", 3))
+    raise ValueError(f"job {canonical!r} is not a multi-pass miner")
+
+
+def _build_miner_source(canonical: str, cfg: JobConfig,
+                        inputs: Sequence[str], spill: bool):
+    """The streaming source a miner conf describes (the companion of
+    :func:`_build_miner`): the association transaction reader or the
+    GSP sequence reader, with the shared block/cache knobs applied."""
+    block = int(cfg.get_float("stream.block.size.mb", 64.0) * (1 << 20))
+    skip = cfg.get_int("skip.field.count", 1)
+    if canonical == "frequentItemsApriori":
+        from avenir_tpu.models.association import StreamingTransactionSource
+
+        return StreamingTransactionSource(
+            list(inputs), delim=cfg.field_delim_regex,
+            trans_id_ord=cfg.get_int("tans.id.ord", 0),
+            skip_field_count=skip, marker=cfg.get("infreq.item.marker"),
+            block_bytes=block, spill_cache=spill,
+            cache_budget_bytes=_cache_budget(cfg))
+    from avenir_tpu.models.sequence import StreamingSequenceSource
+
+    return StreamingSequenceSource(
+        list(inputs), delim=cfg.field_delim_regex,
+        skip_field_count=skip, block_bytes=block, spill_cache=spill,
+        cache_budget_bytes=_cache_budget(cfg))
+
+
 class _MinerScanFold:
     """A multi-pass miner's DISCOVERY pass as a shared-scan sink over raw
     byte blocks: pass 1 (vocabulary + k=1 supports) folds from the shared
@@ -754,35 +820,9 @@ class _MinerScanFold:
         self.cfg = cfg
         self.job = job
         self.t0 = time.perf_counter()
-        block = int(cfg.get_float("stream.block.size.mb", 64.0) * (1 << 20))
-        spill = cfg.get_bool("stream.encoded.cache", True)
-        skip = cfg.get_int("skip.field.count", 1)
-        if job == "frequentItemsApriori":
-            from avenir_tpu.models.association import (
-                FrequentItemsApriori, StreamingTransactionSource)
-
-            self.miner = FrequentItemsApriori(
-                support_threshold=cfg.assert_float("support.threshold"),
-                max_length=cfg.get_int("item.set.length", 3),
-                emit_trans_id=cfg.get_bool("emit.trans.id", False))
-            self.src = StreamingTransactionSource(
-                list(inputs), delim=cfg.field_delim_regex,
-                trans_id_ord=cfg.get_int("tans.id.ord", 0),
-                skip_field_count=skip, marker=cfg.get("infreq.item.marker"),
-                block_bytes=block, spill_cache=spill,
-                cache_budget_bytes=_cache_budget(cfg))
-        else:
-            from avenir_tpu.models.sequence import (GSPMiner,
-                                                    StreamingSequenceSource)
-
-            self.miner = GSPMiner(
-                support_threshold=cfg.assert_float("support.threshold"),
-                max_length=cfg.get_int("item.set.length", 3))
-            self.src = StreamingSequenceSource(
-                list(inputs), delim=cfg.field_delim_regex,
-                skip_field_count=skip, block_bytes=block,
-                spill_cache=spill,
-                cache_budget_bytes=_cache_budget(cfg))
+        self.miner = _build_miner(job, cfg)
+        self.src = _build_miner_source(
+            job, cfg, inputs, cfg.get_bool("stream.encoded.cache", True))
         self._sink = self.src.scan_consumer()
         self._sealed = False
         self._shards: List["_MinerScanFold"] = []
@@ -1085,37 +1125,19 @@ def run_warm_miner(name: str, conf, inputs: Sequence[str], output: str,
     state the source already memoizes); throughput counters price the
     mining wall time alone, which is the point."""
     canonical, _prefix, cfg = _job_cfg(name, conf)
-    t0 = time.perf_counter()
-    if canonical == "frequentItemsApriori":
-        from avenir_tpu.models.association import FrequentItemsApriori
-
-        miner = FrequentItemsApriori(
-            support_threshold=cfg.assert_float("support.threshold"),
-            max_length=cfg.get_int("item.set.length", 3),
-            emit_trans_id=cfg.get_bool("emit.trans.id", False))
-        levels = miner.mine_stream(src)
-        counters = {"Apriori:MaxLength": len(levels),
-                    **throughput_counters(src.n_trans,
-                                          time.perf_counter() - t0),
-                    **_cache_counters(src)}
-        outs = _write_apriori_outputs(cfg, output, levels)
-    elif canonical == "candidateGenerationWithSelfJoin":
-        from avenir_tpu.models.sequence import GSPMiner
-
-        miner = GSPMiner(
-            support_threshold=cfg.assert_float("support.threshold"),
-            max_length=cfg.get_int("item.set.length", 3))
-        levels = miner.mine_stream(src)
-        counters = {"GSP:MaxLength": max(levels) if levels else 0,
-                    **throughput_counters(src.n_rows,
-                                          time.perf_counter() - t0),
-                    **_cache_counters(src)}
-        outs = _write_gsp_outputs(cfg, output, levels)
-    else:
+    if canonical not in ("frequentItemsApriori",
+                         "candidateGenerationWithSelfJoin"):
         raise ValueError(
             f"job {name!r} has no warm-source path; warm-servable jobs: "
             f"frequentItemsApriori, candidateGenerationWithSelfJoin")
-    res = JobResult(canonical, counters, outs, levels)
+    t0 = time.perf_counter()
+    miner = _build_miner(canonical, cfg)
+    levels = miner.mine_stream(src)
+    n_rows = (src.n_trans if canonical == "frequentItemsApriori"
+              else src.n_rows)
+    res = finish_miner_levels(canonical, cfg, levels, n_rows,
+                              time.perf_counter() - t0, output,
+                              extra_counters=_cache_counters(src))
     _add_mem_counters(canonical, cfg, inputs, res)
     return res
 

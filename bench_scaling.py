@@ -1181,14 +1181,32 @@ def fleet_fault_tripwire(rows: int = 10_000_000,
                 if time.perf_counter() > deadline:
                     raise RuntimeError("no fleet result within 3600s")
                 time.sleep(0.05)
-            held: dict = {}
-            for lease_name in fleet._leases.names():
-                lease = fleet._leases.load(lease_name)
-                if lease is not None:
-                    held[lease.host] = held.get(lease.host, 0) + 1
-            victim = max(held, key=held.get)
-            victim_pid = fleet.host_pid(victim)
-            os.kill(victim_pid, signal.SIGKILL)
+            # victim selection: snapshot the lease table ONCE per try —
+            # the sweep races this loop (rows land, leases drop), so an
+            # empty snapshot or an already-gone pid retries, and if the
+            # whole batch drains before any lease is caught the kill is
+            # skipped CLEANLY (nothing left to strand) instead of
+            # crashing the harness on max() of an empty dict /
+            # os.kill(None)
+            victim = victim_pid = None
+            kill_deadline = time.perf_counter() + 60
+            while victim_pid is None \
+                    and time.perf_counter() < kill_deadline:
+                held: dict = {}
+                for lease_name in fleet._leases.names():
+                    lease = fleet._leases.load(lease_name)
+                    if lease is not None:
+                        held[lease.host] = held.get(lease.host, 0) + 1
+                if not held:
+                    if not fleet._outstanding:
+                        break          # batch drained: nothing to kill
+                    time.sleep(0.02)
+                    continue
+                victim = max(held, key=held.get)
+                victim_pid = fleet.host_pid(victim)
+            killed = victim_pid is not None
+            if killed:
+                os.kill(victim_pid, signal.SIGKILL)
             name_rows = fleet.collect(list(names.values()),
                                       timeout=7200)
             rows_by_tag = {tag: name_rows[n] for tag, n in names.items()}
@@ -1198,7 +1216,7 @@ def fleet_fault_tripwire(rows: int = 10_000_000,
                     f"chaos leg lost/failed requests {bad}: "
                     f"{rows_by_tag[bad[0]].get('error')}")
             chaos_snap = fleet.fault_snapshot()
-            if chaos_snap["stats"]["requeues"] < 1:
+            if killed and chaos_snap["stats"]["requeues"] < 1:
                 raise RuntimeError(
                     "chaos leg: SIGKILL stranded no lease — the "
                     "requeue path never exercised")
@@ -1207,7 +1225,7 @@ def fleet_fault_tripwire(rows: int = 10_000_000,
                     f"chaos leg leaked "
                     f"{chaos_snap['leases_outstanding']} lease(s)")
             t0 = time.perf_counter()
-            while True:
+            while killed:
                 snap = fleet.fault_snapshot()
                 ok_restart = (snap["stats"]["restarts"] >= 1
                               and snap["hosts"][victim]["state"]
@@ -1302,7 +1320,8 @@ def fleet_fault_tripwire(rows: int = 10_000_000,
         return {"rows": rows, "requests": len(load),
                 "chaos_requeues": int(chaos_snap["stats"]["requeues"]),
                 "chaos_restarts": int(chaos_snap["stats"]["restarts"]),
-                "victim_host": int(victim),
+                "victim_host": int(victim) if killed else None,
+                "chaos_kill_skipped": not killed,
                 "hedges": int(hedges),
                 "zero_lost": True, "zero_conflicting": True,
                 "outputs_byte_identical": True}
@@ -1331,6 +1350,17 @@ def shard_tripwire(rows: int = 10_000_000, floor: float = 1.5,
     throughput gate arms only where capacity >= 1.7 — the PR-12
     convention: no software runs two workers 1.5x faster than one on
     ~1.3 steal-throttled cores, so there the numbers bank as evidence.
+
+    **Miner per-k leg** — frequentItemsApriori over the sequence
+    corpus: the per-k candidate rounds (the dominant share of a mining
+    job's wall) run DISTRIBUTED through the level-namespaced ledger,
+    workers replaying their own encoded-block caches. Byte-identity vs
+    the solo miner asserts UNCONDITIONALLY, the per-k counters must
+    show the rounds actually ran distributed (``Shard:PerKBlocks`` >=
+    plan blocks, ``Shard:PerKRounds`` >= 1), and the 2-process speedup
+    is held to the same capacity-gated floor as the families above
+    (banked as evidence on sub-1.7x boxes — the hardware-rounds
+    convention).
 
     **SIGSTOP chaos** — one worker is stopped the moment it holds an
     uncommitted claim: the survivor steals the unclaimed tail, the
@@ -1467,6 +1497,59 @@ def shard_tripwire(rows: int = 10_000_000, floor: float = 1.5,
                 f"per-family {[round(s, 2) for s in speedups]}) — "
                 f"shard scale-out regressed")
 
+        # -------------------------------------------- miner per-k leg
+        fia_conf = {"fia.support.threshold": "0.3",
+                    "fia.item.set.length": "3",
+                    "fia.skip.field.count": "2"}
+        with _host_core_lock():
+            cap_m0 = host_parallel_capacity(2)
+            solo_miner_out = os.path.join(d, "solo_fia")
+            solo_miner_s = solo_child("frequentItemsApriori", fia_conf,
+                                      seq, solo_miner_out)
+            mres = run_sharded("frequentItemsApriori", fia_conf, [seq],
+                               os.path.join(d, "shard_fia"), procs=2,
+                               pin_cores=pin)
+            cap_miner = min(cap_m0, host_parallel_capacity(2))
+        miner_shard_s = float(mres.counters["Shard:ScanSeconds"])
+        solo_files = sorted(os.path.join(solo_miner_out, f)
+                            for f in os.listdir(solo_miner_out))
+        if len(solo_files) != len(mres.outputs):
+            raise RuntimeError(
+                f"sharded miner wrote {len(mres.outputs)} outputs, "
+                f"solo wrote {len(solo_files)}")
+        for pa, pb in zip(solo_files, sorted(mres.outputs)):
+            with open(pa, "rb") as fa, open(pb, "rb") as fb:
+                if fa.read() != fb.read():
+                    raise RuntimeError(
+                        f"sharded miner artifact differs from its solo "
+                        f"twin ({pb} vs {pa})")
+        if mres.counters["Shard:PerKRounds"] < 1 \
+                or mres.counters["Shard:PerKBlocks"] \
+                < mres.counters["Shard:Blocks"]:
+            raise RuntimeError(
+                f"miner per-k rounds never ran distributed "
+                f"(counters {mres.counters}) — the coordinator counted "
+                f"candidates itself")
+        miner_speedup = solo_miner_s / max(miner_shard_s, 1e-9)
+        miner_floor = min(floor, cap_miner * parallel_efficiency_floor)
+        miner_gated = cap_miner >= 1.7
+        if miner_gated and miner_speedup < miner_floor:
+            raise RuntimeError(
+                f"2-process sharded MINER only {miner_speedup:.2f}x "
+                f"solo (floor {miner_floor:.2f}x at capacity "
+                f"{cap_miner:.2f}) — the distributed per-k rounds "
+                f"regressed")
+        miner_row = {
+            "solo_seconds": round(solo_miner_s, 2),
+            "sharded_seconds": round(miner_shard_s, 2),
+            "perk_seconds": float(
+                mres.counters.get("Shard:PerKSeconds", 0.0)),
+            "speedup": round(miner_speedup, 2),
+            "host_parallel_capacity": round(cap_miner, 2),
+            "throughput_gated": miner_gated,
+            "counters": {k: v for k, v in mres.counters.items()
+                         if k.startswith("Shard:")}}
+
         # ---------------------------------------------- SIGSTOP chaos
         job, conf, inp = families[0]
         stopped: dict = {}
@@ -1560,6 +1643,7 @@ def shard_tripwire(rows: int = 10_000_000, floor: float = 1.5,
                 "throughput_gated": throughput_gated,
                 "speedup": round(speedup, 2),
                 "families": rows_out,
+                "miner": miner_row,
                 "chaos_dedup_blocks": int(
                     res.counters["Shard:DedupBlocks"]),
                 "chaos_stolen_blocks": int(

@@ -186,6 +186,47 @@ class TestLedger:
         assert ledger.load_state(0) == f"state-{winner}".encode()
         assert ledger.dup_count() == 5
 
+    def test_level_namespaces_are_independent(self, tmp_path):
+        # per-k rounds ride the same ledger under ledger/k<k>/: one
+        # block id claims/commits independently per level, and a
+        # level's dedup never bleeds into pass-1 counters
+        ledger = BlockLedger(str(tmp_path))
+        k2 = ledger.level("k2")
+        assert ledger.commit(0, worker=0, blob=b"pass1-state")
+        assert k2.commit(0, worker=1, blob=b"k2-counts")
+        assert ledger.load_state(0) == b"pass1-state"
+        assert k2.load_state(0) == b"k2-counts"
+        assert not k2.commit(0, worker=0, blob=b"late-dup")
+        assert k2.dup_count() == 1
+        assert ledger.dup_count() == 0
+        assert ledger.level("k2").committed() == [0]
+        with pytest.raises(ValueError):
+            ledger.level("k2/../escape")
+
+    def test_perk_racing_commits_one_winner_plus_dup_marker(
+            self, tmp_path):
+        # two workers racing one k-block commit: exactly one count
+        # vector wins, the loser lands as a dup marker — the fold-
+        # exactly-once-per-level contract the merged supports rest on
+        ledger = BlockLedger(str(tmp_path)).level("k3")
+        outcomes = {}
+        barrier = threading.Barrier(2)
+
+        def committer(w):
+            barrier.wait()
+            outcomes[w] = ledger.commit(5, w, f"counts-{w}".encode())
+
+        threads = [threading.Thread(target=committer, args=(w,))
+                   for w in range(2)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert sum(outcomes.values()) == 1
+        winner = next(w for w, won in outcomes.items() if won)
+        assert ledger.load_state(5) == f"counts-{winner}".encode()
+        assert ledger.dup_count() == 1
+
     def test_torn_claim_treated_as_unclaimed(self, tmp_path):
         ledger = BlockLedger(str(tmp_path))
         with open(ledger.claim_path(5), "w") as fh:
@@ -278,9 +319,11 @@ class TestRunSharded:
         assert res.counters["Shard:Blocks"] == 4.0
 
     def test_miner_family_byte_identical(self, corpus, tmp_path):
-        # the miners' finish() re-scans inputs per-k: their per-block
-        # states restore against newline-aligned byte SLICES, and the
-        # plan-ordered merged mine must still equal the solo artifacts
+        # the miners' per-k candidate rounds run DISTRIBUTED: workers
+        # stay resident after pass 1, count each level's candidates
+        # per block through the k-namespaced ledger (replaying their
+        # own encoded-block caches), and the coordinator only merges —
+        # the artifacts must still equal the solo miner's byte for byte
         from avenir_tpu.runner import run_job
 
         conf = {"fia.support.threshold": "0.3",
@@ -290,6 +333,87 @@ class TestRunSharded:
                        str(tmp_path / "fia_solo"))
         res = run_sharded("frequentItemsApriori", conf, [corpus["seq"]],
                           str(tmp_path / "fia_sharded"), procs=2,
+                          factor=2)
+        assert len(solo.outputs) == len(res.outputs) >= 1
+        for pa, pb in zip(sorted(solo.outputs), sorted(res.outputs)):
+            assert open(pa, "rb").read() == open(pb, "rb").read(), \
+                (pa, pb)
+        # the per-k phase really ran distributed: one k=2 round over
+        # every plan block, zero coordinator-side candidate counting
+        assert res.counters["Shard:PerKRounds"] >= 1.0
+        assert res.counters["Shard:PerKBlocks"] >= \
+            res.counters["Shard:Blocks"]
+
+    def test_gsp_miner_byte_identical(self, corpus, tmp_path):
+        # the second miner family through the same distributed per-k
+        # path: GSP candidates are token tuples counted by the subseq
+        # scan kernel — sharded output must equal solo byte for byte
+        from avenir_tpu.runner import run_job
+
+        conf = {"cgs.support.threshold": "0.3",
+                "cgs.item.set.length": "3", "cgs.skip.field.count": "2",
+                "cgs.stream.block.size.mb": "0.005"}
+        solo = run_job("candidateGenerationWithSelfJoin", conf,
+                       [corpus["seq"]], str(tmp_path / "cgs_solo"))
+        res = run_sharded("candidateGenerationWithSelfJoin", conf,
+                          [corpus["seq"]],
+                          str(tmp_path / "cgs_sharded"), procs=2,
+                          factor=2)
+        assert len(solo.outputs) == len(res.outputs) >= 1
+        for pa, pb in zip(sorted(solo.outputs), sorted(res.outputs)):
+            assert open(pa, "rb").read() == open(pb, "rb").read(), \
+                (pa, pb)
+        assert res.counters["Shard:PerKRounds"] >= 1.0
+        assert res.counters["Shard:PerKBlocks"] >= \
+            res.counters["Shard:Blocks"]
+
+    def test_perk_straggler_is_mirrored_and_deduped(self, corpus,
+                                                    tmp_path):
+        # a straggler INSIDE the per-k loop: worker 0 claims a k=2
+        # count block and stalls on it (deterministic hold); worker 1
+        # finishes the level's tail, prices the stale claim off its own
+        # measured per-k wall, and mirrors it — the level completes,
+        # worker 0's late commit is REJECTED first-commit-wins
+        # (Shard:DedupBlocks fires), and the bytes still match solo
+        from avenir_tpu.runner import run_job
+
+        conf = {"fia.support.threshold": "0.3",
+                "fia.item.set.length": "2", "fia.skip.field.count": "2",
+                "fia.stream.block.size.mb": "0.005"}
+        solo = run_job("frequentItemsApriori", conf, [corpus["seq"]],
+                       str(tmp_path / "pk_solo"))
+        os.environ["AVENIR_SHARD_TEST_HOLD"] = "0:k2:0:8"
+        try:
+            res = run_sharded(
+                "frequentItemsApriori", conf, [corpus["seq"]],
+                str(tmp_path / "pk_sharded"), procs=2, factor=2,
+                policy=StragglerPolicy(mirror_floor_s=0.3,
+                                       mirror_multiple=2.0,
+                                       poll_s=0.02))
+        finally:
+            del os.environ["AVENIR_SHARD_TEST_HOLD"]
+        assert res.counters["Shard:DedupBlocks"] >= 1.0
+        assert res.counters["Shard:MirroredBlocks"] >= 1.0
+        assert res.counters["Shard:PerKRounds"] >= 1.0
+        assert len(solo.outputs) == len(res.outputs) >= 1
+        for pa, pb in zip(sorted(solo.outputs), sorted(res.outputs)):
+            assert open(pa, "rb").read() == open(pb, "rb").read(), \
+                (pa, pb)
+
+    def test_miner_trans_ids_byte_identical(self, corpus, tmp_path):
+        # fia.emit.trans.id distributes as one more ledger level
+        # ("tids"): per-block id lists concatenate in plan order ==
+        # corpus order, so the exact-id artifacts match solo too
+        from avenir_tpu.runner import run_job
+
+        conf = {"fia.support.threshold": "0.3",
+                "fia.item.set.length": "2", "fia.skip.field.count": "2",
+                "fia.emit.trans.id": "true",
+                "fia.stream.block.size.mb": "0.005"}
+        solo = run_job("frequentItemsApriori", conf, [corpus["seq"]],
+                       str(tmp_path / "tid_solo"))
+        res = run_sharded("frequentItemsApriori", conf, [corpus["seq"]],
+                          str(tmp_path / "tid_sharded"), procs=2,
                           factor=2)
         assert len(solo.outputs) == len(res.outputs) >= 1
         for pa, pb in zip(sorted(solo.outputs), sorted(res.outputs)):

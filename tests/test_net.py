@@ -1097,6 +1097,234 @@ def test_stranded_lease_after_restart_respools(tmp_path):
     assert fleet.fault_snapshot()["leases_outstanding"] == 0
 
 
+def _stranded_two_host_fleet(tmp_path, alive):
+    """A 2-host fleet with stand-in processes and ONE outstanding
+    request whose attempt trail already covers both hosts, lease held
+    by host 1. ``alive`` flags which hosts have a live process."""
+
+    class FakeProc:
+        pid = 4242
+
+        def poll(self):
+            return None
+
+    csv = _seq(tmp_path, rows=50)
+    fleet = Fleet(str(tmp_path / "fleet"), hosts=2,
+                  fault_policy=FaultPolicy(hedge=False))
+    for h in range(2):
+        for sub in ("in", "out", "work"):
+            os.makedirs(os.path.join(fleet.host_dirs[h], sub),
+                        exist_ok=True)
+    with fleet._lock:
+        fleet._procs = [FakeProc() if alive[h] else None
+                        for h in range(2)]
+        fleet._spawned_at = [time.time() - 1.0] * 2
+    obj = _req_obj(csv, str(tmp_path / "stranded.txt"))
+    req, priced, cost = fleet.price(obj)
+    name = fleet._spool_to(
+        fleet.router.assign_to(0, affinity_key(req), priced, cost), obj)
+    entry = fleet._outstanding[name]
+    # simulate the earlier requeue that put host 1 on the trail: a
+    # second copy spooled at host 1, lease moved there
+    copy = fleet._write_copy(
+        fleet.router.assign_to(1, affinity_key(req), priced, cost),
+        fleet._next_name(), obj)
+    entry.copies.append(copy)
+    entry.lease.host = 1
+    entry.lease.hosts = [0, 1]
+    fleet._leases.write(entry.lease)
+    return fleet, name, entry
+
+
+def test_stranded_request_respools_to_healthy_trail_host(tmp_path):
+    """The stranded-request hang: a request whose attempt trail covers
+    EVERY host can neither requeue (all hosts excluded) nor pass the
+    max_requeues cap (attempts only grows on successful moves) when
+    its lease host is dead — it used to sit until the collect()
+    timeout. The sweep must respool it to a healthy trail host
+    in-band: re-execution is safe by the idempotency contract."""
+    from avenir_tpu.net import fault
+
+    fleet, name, entry = _stranded_two_host_fleet(
+        tmp_path, alive=[True, False])
+    with fleet._lock:
+        fleet._host_state[1] = fault.RESTARTING   # host 1 died
+    fleet._sweep_leases(time.time())
+    snap = fleet.fault_snapshot()
+    assert snap["stats"]["respools"] == 1
+    assert snap["stats"]["requeues"] == 0
+    assert snap["stats"]["abandoned"] == 0
+    assert entry.lease.host == 0        # moved to the healthy trail host
+    new_copy = entry.copies[-1]
+    assert new_copy.placement.host == 0
+    assert os.path.exists(os.path.join(fleet.host_dirs[0], "in",
+                                       new_copy.name))
+    # a row on the respooled copy completes the request; the shared
+    # budget charges release exactly once each
+    with open(new_copy.out_path + ".tmp", "w") as fh:
+        json.dump({"ok": True}, fh)
+    os.replace(new_copy.out_path + ".tmp", new_copy.out_path)
+    rows = fleet.collect([name], timeout=30)
+    assert rows[name]["ok"]
+    for h in range(2):
+        host = fleet.router.snapshot()["hosts"][h]
+        assert host["assigned_bytes"] == 0
+    assert fleet.fault_snapshot()["leases_outstanding"] == 0
+
+
+def test_stranded_request_abandons_in_band_when_no_host_left(tmp_path):
+    """Same trail-exhausted shape, but NO healthy host remains (lease
+    host dead, the other quarantined): the request must resolve as an
+    in-band failure row — collect() returns it instead of hanging to
+    its timeout."""
+    from avenir_tpu.net import fault
+
+    fleet, name, entry = _stranded_two_host_fleet(
+        tmp_path, alive=[False, False])
+    with fleet._lock:
+        fleet._host_state = [fault.QUARANTINED, fault.QUARANTINED]
+    fleet._sweep_leases(time.time())
+    snap = fleet.fault_snapshot()
+    assert snap["stats"]["abandoned"] == 1
+    assert snap["stats"]["respools"] == 0
+    assert snap["leases_outstanding"] == 0
+    rows = {name: fleet._collected[name]}
+    assert rows[name]["ok"] is False
+    assert "stranded" in rows[name]["error"]
+
+
+def test_stranded_request_waits_for_recovering_trail_host(tmp_path):
+    """Trail exhausted but a trail host is RESTARTING: neither respool
+    (nobody healthy yet) nor abandon (it may come back) — the sweep
+    waits, then respools once the host serves again."""
+    from avenir_tpu.net import fault
+
+    fleet, name, entry = _stranded_two_host_fleet(
+        tmp_path, alive=[False, False])
+    with fleet._lock:
+        fleet._host_state = [fault.RESTARTING, fault.RESTARTING]
+    fleet._sweep_leases(time.time())
+    snap = fleet.fault_snapshot()
+    assert snap["stats"]["abandoned"] == 0
+    assert snap["stats"]["respools"] == 0
+    # host 0 comes back: the next sweep respools onto it
+
+    class FakeProc:
+        pid = 4242
+
+        def poll(self):
+            return None
+
+    with fleet._lock:
+        fleet._procs[0] = FakeProc()
+        fleet._host_state[0] = fault.SERVING
+    fleet._sweep_leases(time.time())
+    assert fleet.fault_snapshot()["stats"]["respools"] == 1
+    assert entry.lease.host == 0
+
+
+def test_stranded_request_patience_bounds_the_wait(tmp_path):
+    """Permanently wedged recovery: when the only hosts left stay
+    RESTARTING/STALLED forever (a stall never respawns — only an exit
+    code does), the stranded wait is bounded by stranded_patience_s,
+    after which the request abandons in-band instead of riding the
+    collect() timeout."""
+    from avenir_tpu.net import fault
+
+    fleet, name, entry = _stranded_two_host_fleet(
+        tmp_path, alive=[False, False])
+    with fleet._lock:
+        fleet._host_state = [fault.STALLED, fault.RESTARTING]
+    t0 = time.time()
+    fleet._sweep_leases(t0)              # starts the patience clock
+    assert fleet.fault_snapshot()["stats"]["abandoned"] == 0
+    assert entry.stranded_at is not None
+    fleet._sweep_leases(
+        t0 + fleet.fault.stranded_patience_s + 1.0)
+    snap = fleet.fault_snapshot()
+    assert snap["stats"]["abandoned"] == 1
+    assert snap["leases_outstanding"] == 0
+    assert fleet._collected[name]["ok"] is False
+
+
+def test_probe_healthz_drives_listener_host_heartbeat(tmp_path):
+    """fault.probe_healthz wired into the supervisor tick: a host
+    registered with a listen address heartbeats through /healthz —
+    a "serving" answer keeps it placeable, a quarantined overlay (or
+    a dead listener) marks it stalled, recovery reinstates it. Driven
+    through the real _supervise_hosts against a fake listener."""
+    import http.server
+    import threading
+
+    from avenir_tpu.net import fault
+
+    status = {"value": "serving"}
+
+    class _Healthz(http.server.BaseHTTPRequestHandler):
+        def do_GET(self):
+            body = json.dumps({"status": status["value"]}).encode()
+            code = 200 if status["value"] == "serving" else 503
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def log_message(self, *a):
+            pass
+
+    httpd = http.server.ThreadingHTTPServer(("127.0.0.1", 0), _Healthz)
+    thread = threading.Thread(target=httpd.serve_forever, daemon=True)
+    thread.start()
+    addr = f"http://127.0.0.1:{httpd.server_address[1]}"
+
+    class FakeProc:
+        pid = 4242
+
+        def poll(self):
+            return None
+
+    try:
+        fleet = Fleet(str(tmp_path / "fleet"), hosts=1,
+                      fault_policy=FaultPolicy(hedge=False,
+                                               heartbeat_timeout_s=0.1),
+                      listen_addresses={0: addr})
+        with fleet._lock:
+            fleet._procs[0] = FakeProc()
+            # well past the boot grace: the probe is the heartbeat now
+            fleet._spawned_at[0] = time.time() - 60.0
+        # each check advances `now` past the probe memo window (the
+        # supervisor re-probes at most every hb_timeout/2, so wedged
+        # listeners cannot stall every tick)
+        now = time.time()
+        step = fleet._hb_timeout
+        fleet._supervise_hosts(now)
+        assert fleet.host_state(0) == "serving"
+        # the host's own listener reports quarantined (its overlay):
+        # the front marks it stalled — no placements land on it
+        status["value"] = "quarantined"
+        fleet._supervise_hosts(now + step)
+        assert fleet.host_state(0) == "stalled"
+        assert fleet.router.snapshot()["hosts"][0]["state"] == "stalled"
+        # recovery: a serving probe reinstates placement
+        status["value"] = "serving"
+        fleet._supervise_hosts(now + 2 * step)
+        assert fleet.host_state(0) == "serving"
+        # a dead listener (probe refused) is stalled too — the
+        # exit-code check stays the authority on actual death
+        httpd.shutdown()
+        httpd.server_close()
+        fleet._supervise_hosts(now + 3 * step)
+        assert fleet.host_state(0) == "stalled"
+    finally:
+        try:
+            httpd.shutdown()
+            httpd.server_close()
+        except Exception:
+            pass
+        thread.join(10)
+
+
 def test_requeued_refresh_cold_fallback(tmp_path):
     """Crash-resume composition: a refresh request landing on a host
     WITHOUT the corpus's checkpoint (what a lease requeue does after

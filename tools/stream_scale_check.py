@@ -63,7 +63,13 @@ fresh child — byte-identity vs the solo anchors asserted, the
 Shard:Blocks/StolenBlocks/DedupBlocks/MergeMs counters recorded as
 columns, and the summary gains `shard_speedup` (solo anchor seconds /
 sharded scan seconds per job; the scan clock starts at the workers' go
-barrier, matching the solo children's boot-excluded convention).
+barrier, matching the solo children's boot-excluded convention). A
+MINER anchor rides along: frequentItemsApriori re-runs sharded with
+its per-k candidate rounds distributed through the level-namespaced
+ledger (workers replay their own encoded-block caches), byte-identity
+per itemset file asserted, the Shard:PerKRounds/PerKBlocks/
+PerKSeconds counters recorded, and the summary gains
+`shard_miner_speedup`.
 
 Usage: python tools/stream_scale_check.py [--rows N_MILLION] [--extra]
                                           [--fused] [--incremental]
@@ -528,6 +534,47 @@ def main():
                 results[job]["seconds"]
                 / max(line["scan_seconds"], 1e-9), 2)
             results[f"sharded_{job}"] = line
+        # miner anchor: the distributed per-k rounds at anchor scale —
+        # solo fia (the --extra anchor when it already ran this
+        # invocation, a fresh child otherwise) vs run_sharded;
+        # byte-identity per itemset file, the Shard:PerK* counters and
+        # the shard_miner_speedup column recorded
+        fia_conf = {"fia.support.threshold": "0.3",
+                    "fia.item.set.length": "2",
+                    "fia.skip.field.count": "2",
+                    "fia.stream.block.size.mb": "64"}
+        solo_fia_out = "/tmp/avenir_scale_fia"
+        if "frequentItemsApriori" not in results:
+            results["frequentItemsApriori"] = run_child(
+                "frequentItemsApriori", fia_conf, SEQ_CSV, solo_fia_out)
+        out = "/tmp/avenir_scale_fia_sharded"
+        proc = subprocess.run(
+            [sys.executable, "-c", _CHILD_SHARDED,
+             "frequentItemsApriori", json.dumps(fia_conf), SEQ_CSV,
+             out, "2"],
+            capture_output=True, text=True, timeout=7200, env=env)
+        if proc.returncode != 0:
+            raise RuntimeError(
+                f"sharded miner failed: {proc.stderr[-500:]}")
+        line = json.loads(proc.stdout.strip().splitlines()[-1])
+        print(json.dumps(line), flush=True)
+        assert line["peak_rss_mb"] < RSS_LIMIT_MB, \
+            f"sharded miner RSS {line['peak_rss_mb']}MB not O(block)"
+        assert line["counters"].get("Shard:PerKRounds", 0) >= 1, \
+            "sharded miner ran zero distributed per-k rounds"
+        solo_files = sorted(os.path.join(solo_fia_out, f)
+                            for f in os.listdir(solo_fia_out))
+        assert len(solo_files) == len(line["outputs"]), \
+            (solo_files, line["outputs"])
+        for pa, pb in zip(solo_files, sorted(line["outputs"])):
+            with open(pa, "rb") as fa, open(pb, "rb") as fb:
+                assert fa.read() == fb.read(), \
+                    f"sharded miner output {pb} != solo {pa}"
+        line["outputs_byte_identical"] = True
+        line["solo_seconds"] = results["frequentItemsApriori"]["seconds"]
+        line["shard_speedup"] = round(
+            line["solo_seconds"] / max(line["scan_seconds"], 1e-9), 2)
+        results["sharded_frequentItemsApriori"] = line
     if "--server" in sys.argv:
         # resident-server anchor: the 3-tenant mixed-kind open-loop
         # load served by an in-process JobServer vs one-job-at-a-time,
@@ -608,9 +655,16 @@ def main():
             job[len("sharded_"):]: {
                 k: line["counters"][k] for k in
                 ("Shard:Blocks", "Shard:StolenBlocks",
-                 "Shard:DedupBlocks", "Shard:MergeMs")
+                 "Shard:DedupBlocks", "Shard:MergeMs",
+                 "Shard:PerKRounds", "Shard:PerKBlocks",
+                 "Shard:PerKSeconds")
                 if k in line.get("counters", {})}
             for job, line in shard_cols.items()}
+        # the miner anchor's own column: the distributed per-k phase
+        # is the throughput this PR exists for
+        miner = shard_cols.get("sharded_frequentItemsApriori")
+        if miner is not None:
+            summary["shard_miner_speedup"] = miner["shard_speedup"]
     # the served-jobs/min column: batched multi-tenant serving vs
     # one-job-at-a-time, plus the served requests' Server:* counters
     if "jobServer" in results:
