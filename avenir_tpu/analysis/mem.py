@@ -566,6 +566,11 @@ def _dataset_ingest(stats: CorpusStats, block_bytes: int, schema,
         "raw_blocks_in_flight": int(3 * eff),
         "parse_transient": int(parsed),
         "parsed_chunks_in_flight": int((depth + 2) * parsed),
+        # columnar-sidecar transient, one block either way: the cold
+        # pass serializes the parsed block before appending it to
+        # columns.bin; the warm pass materializes one block's columns
+        # from the replay read
+        "sidecar_pages": int(eff),
     }
 
 
@@ -586,6 +591,9 @@ def _bytes_ingest(stats: CorpusStats, block_bytes: int,
     terms = {
         "raw_blocks_in_flight": int((depth + 2) * eff),
         "csr_transients": int(toks * 9 + rows * 16 + eff),
+        # columnar-sidecar transient (write-side serialize / read-side
+        # materialize of ONE block's encoded columns)
+        "sidecar_pages": int(eff),
     }
     try:
         from avenir_tpu.native.ingest import native_available
@@ -689,7 +697,7 @@ _JOB_MODELS: Dict[str, Callable] = {
 #: once (max across jobs) when jobs fuse, exactly like the scan itself
 _INGEST_TERMS = {"raw_blocks_in_flight", "parse_transient",
                  "parsed_chunks_in_flight", "csr_transients",
-                 "python_tokenize"}
+                 "python_tokenize", "sidecar_pages"}
 
 
 def footprint_model(job: str, block_bytes: int, schema=None,

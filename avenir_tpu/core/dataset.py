@@ -49,6 +49,9 @@ class Dataset:
         # touch ids, and materializing millions of python strings halves
         # the native ingest rate (the 1B-row streaming path skips it)
         self._lazy = dict(lazy) if lazy else {}
+        # feature_codes memo: a shared scan hands one chunk to several
+        # consumers, each stacking the same [n, F] code matrix
+        self._codes_cache: Dict[tuple, tuple] = {}
 
     # ------------------------------------------------------------------ load
     @classmethod
@@ -250,6 +253,13 @@ class Dataset:
         """
         if fields is None:
             fields = [f for f in self.schema.feature_fields if f.num_bins() > 0]
+        # keyed on (ordinal, bins) so a vocabulary discovered AFTER a
+        # cached call (growing num_bins) misses instead of serving codes
+        # stacked against the stale bin count
+        memo_key = tuple((f.ordinal, f.num_bins()) for f in fields)
+        hit = self._codes_cache.get(memo_key)
+        if hit is not None:
+            return hit[0], list(hit[1])
         cols = []
         bins = []
         for fld in fields:
@@ -258,7 +268,9 @@ class Dataset:
                 continue
             col = self.column(fld.ordinal)
             if fld.is_categorical:
-                cols.append(col.astype(np.int32))
+                # copy=False: the stack below copies; an int32 column
+                # (the native parse and replay norm) need not copy twice
+                cols.append(col.astype(np.int32, copy=False))
             else:
                 if np.isnan(col).any():
                     raise ValueError(
@@ -269,9 +281,10 @@ class Dataset:
                 code = np.floor((col - lo) / fld.bucket_width).astype(np.int32)
                 cols.append(np.clip(code, 0, nb - 1))
             bins.append(nb)
-        if not cols:
-            return np.zeros((self.n_rows, 0), dtype=np.int32), []
-        return np.stack(cols, axis=1), bins
+        codes = (np.stack(cols, axis=1) if cols
+                 else np.zeros((self.n_rows, 0), dtype=np.int32))
+        self._codes_cache[memo_key] = (codes, tuple(bins))
+        return codes, bins
 
     def feature_matrix(
         self, fields: Optional[Sequence[FeatureField]] = None
@@ -279,7 +292,8 @@ class Dataset:
         """float32 [n, D] of numeric feature values (raw, unbinned)."""
         if fields is None:
             fields = [f for f in self.schema.feature_fields if f.is_numeric]
-        cols = [self.column(f.ordinal).astype(np.float32) for f in fields]
+        cols = [self.column(f.ordinal).astype(np.float32, copy=False)
+                for f in fields]
         if not cols:
             return np.zeros((self.n_rows, 0), dtype=np.float32)
         return np.stack(cols, axis=1)

@@ -382,18 +382,48 @@ def prefetch_depth(cfg) -> int:
                                  float(DEFAULT_PREFETCH_DEPTH))), 1)
 
 
+def _sidecar_payloads(feed) -> Iterator:
+    """Drop the (offset, length, hash) bookkeeping of a sidecar feed and
+    the blank-block placeholders — what job consumers fold."""
+    for _off, _length, _hash, payload in feed:
+        if payload is not None:
+            yield payload
+
+
 def stream_job_inputs(cfg, inputs: Iterable[str], schema: FeatureSchema,
                       keep_raw: bool = False) -> Iterator[Dataset]:
     """Per-job streaming input helper: prefetched block chunks of every
     input path, sized by the `stream.block.size.mb` config key (default
     64) and queued `stream.prefetch.depth` deep. The one way runner
-    jobs consume CSV inputs at unbounded size."""
+    jobs consume CSV inputs at unbounded size.
+
+    When the columnar sidecar can engage (native parse path, single-byte
+    delimiter, `stream.sidecar` not disabled), each path streams through
+    native.sidecar.dataset_blocks instead: a verified repeat scan
+    replays packed binary columns parse-free, a cold scan parses AND
+    packs, and any doubt — absent manifest, content drift, torn write —
+    falls back to the cold chunks below, byte-identically."""
     block = int(cfg.get_float("stream.block.size.mb", 64.0) * (1 << 20))
     depth = prefetch_depth(cfg)
+    sc = sc_opts = None
+    if not keep_raw:
+        try:
+            from avenir_tpu.native import sidecar as sc
+
+            sc_opts = sc.opts_from_cfg(cfg)
+        except Exception:
+            sc_opts = None
     for path in inputs:
-        yield from prefetched(iter_csv_chunks(
-            path, schema, cfg.field_delim_regex, block, keep_raw=keep_raw),
-            depth=depth)
+        feed = None
+        if sc_opts is not None:
+            feed = sc.dataset_blocks(sc_opts, path, schema,
+                                     cfg.field_delim_regex, block)
+        if feed is not None:
+            yield from prefetched(_sidecar_payloads(feed), depth=depth)
+        else:
+            yield from prefetched(iter_csv_chunks(
+                path, schema, cfg.field_delim_regex, block,
+                keep_raw=keep_raw), depth=depth)
 
 
 def iter_byte_blocks(path: str,
@@ -573,11 +603,36 @@ def stream_job_lines(cfg, inputs: Iterable[str]) -> Iterator[list]:
         yield from prefetched(iter_line_blocks(path, block), depth=depth)
 
 
-def stream_job_byte_blocks(cfg, inputs: Iterable[str]) -> Iterator[bytes]:
+def stream_job_byte_blocks(cfg, inputs: Iterable[str],
+                           sidecar_skip: Optional[int] = None
+                           ) -> Iterator[bytes]:
     """Prefetched raw byte blocks of every input path (the native
     seq_encode feed), sized by the same `stream.block.size.mb` key and
-    queued `stream.prefetch.depth` deep."""
+    queued `stream.prefetch.depth` deep.
+
+    `sidecar_skip` OPTS IN to the bytes-kind columnar sidecar: callers
+    whose consumers dispatch on native.sidecar.SidecarBytesBlock (the
+    CSR folds — markov fit_csr, the miner scan sinks) pass their meta-
+    column skip count, and verified repeat scans then replay packed
+    codes instead of raw text. Callers that fold raw bytes directly
+    leave it None and keep the historical feed."""
     block = int(cfg.get_float("stream.block.size.mb", 64.0) * (1 << 20))
     depth = prefetch_depth(cfg)
+    sc = sc_opts = None
+    if sidecar_skip is not None:
+        try:
+            from avenir_tpu.native import sidecar as sc
+
+            sc_opts = sc.opts_from_cfg(cfg)
+        except Exception:
+            sc_opts = None
     for path in inputs:
-        yield from prefetched(iter_byte_blocks(path, block), depth=depth)
+        feed = None
+        if sc_opts is not None:
+            feed = sc.byte_blocks(sc_opts, path, cfg.field_delim_regex,
+                                  int(sidecar_skip), block)
+        if feed is not None:
+            yield from prefetched(_sidecar_payloads(feed), depth=depth)
+        else:
+            yield from prefetched(iter_byte_blocks(path, block),
+                                  depth=depth)

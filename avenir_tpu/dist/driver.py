@@ -95,6 +95,44 @@ def _worker_env() -> Dict[str, str]:
     return env
 
 
+def _sidecar_snap(canonical: str, cfg, ops,
+                  inputs: Sequence[str], procs: int, factor: int,
+                  schema=None) -> Optional[List[Optional[List[int]]]]:
+    """Per-input sidecar block-start offsets for the shard planner to
+    snap its cuts to — only for inputs whose VERIFIED sidecar coverage
+    is at least as fine as the plan (>= procs*factor blocks; a coarser
+    sidecar would collapse plan blocks together and starve workers).
+    None when no input qualifies: the planner keeps its newline scan
+    and the workers fold cold, exactly the pre-sidecar behavior."""
+    try:
+        from avenir_tpu.native import sidecar as sc
+
+        opts = sc.opts_from_cfg(cfg)
+        if opts is None:
+            return None
+        block_bytes = int(cfg.get_float("stream.block.size.mb", 64.0)
+                          * (1 << 20))
+        delim = cfg.field_delim_regex
+        if ops.kind == "dataset" and schema is None:
+            from avenir_tpu.runner import _schema
+
+            schema = _schema(cfg)
+        snap: List[Optional[List[int]]] = []
+        for path in inputs:
+            if ops.kind == "dataset":
+                dirpath = sc.dataset_dir(opts, path, schema, delim,
+                                         block_bytes)
+            else:
+                dirpath = sc.bytes_dir(
+                    opts, path, delim,
+                    cfg.get_int("skip.field.count", 1), block_bytes)
+            offs = sc.verified_offsets(dirpath, path, block_bytes)
+            snap.append(offs if len(offs) >= procs * factor else None)
+        return snap if any(s is not None for s in snap) else None
+    except Exception:
+        return None
+
+
 def _restore_inputs(canonical: str, plan: ShardPlan, block,
                     inputs: Sequence[str], workdir: str) -> List[str]:
     """The input list a restored block state folds/finishes against.
@@ -303,7 +341,10 @@ def run_sharded(name: str, conf, inputs: Sequence[str], output: str,
     per_k = canonical in RESCAN_AT_FINISH
     try:
         plan = plan_shards(list(inputs), procs, factor,
-                           policy=policy.to_dict())
+                           policy=policy.to_dict(),
+                           snap=_sidecar_snap(canonical, cfg, ops,
+                                              list(inputs), procs,
+                                              factor))
         plan.job = canonical
         plan.prefix = prefix
         plan.props = {k: str(v) for k, v in cfg.props.items()
@@ -458,6 +499,7 @@ def run_sharded(name: str, conf, inputs: Sequence[str], output: str,
             res.counters["Shard:MirroredBlocks"] = float(
                 sum(s.get("mirrored", 0) + s.get("perk_mirrored", 0)
                     for s in stats))
+            _add_worker_sidecar_counters(res, stats)
         if per_k:
             res.counters["Shard:PerKRounds"] = float(mined["rounds"])
             res.counters["Shard:PerKBlocks"] = float(mined["blocks"])
@@ -470,6 +512,189 @@ def run_sharded(name: str, conf, inputs: Sequence[str], output: str,
     finally:
         if own_root:
             shutil.rmtree(root, ignore_errors=True)
+
+
+def run_sharded_refresh(name: str, conf, inputs: Sequence[str],
+                        output: str, procs: int = 2,
+                        factor: int = DEFAULT_FACTOR,
+                        shard_root: Optional[str] = None,
+                        policy: Optional[StragglerPolicy] = None,
+                        pin_cores: Optional[Sequence[int]] = None,
+                        worker_hook: Optional[Callable] = None,
+                        timeout_s: float = 7200.0,
+                        state_dir: Optional[str] = None) -> "JobResult":
+    """``--shard`` and ``--incremental`` composed: restore the last
+    fold-carry checkpoint exactly like :func:`runner.run_incremental`
+    (same store, same content-fingerprint gate, cold fallback on any
+    doubt), then fold ONLY the verified prefix's delta tail — sharded
+    across ``procs`` worker processes when there is one. The committed
+    per-block delta states merge IN PLAN ORDER into the restored carry
+    through the registered merge algebra, the delta blocks' content
+    fingerprints extend the checkpoint, and the artifact is
+    byte-identical to a solo incremental refresh (and therefore to a
+    cold full scan).
+
+    The miners stay a loud error: their per-k candidate rounds re-scan
+    the whole corpus per level, so a 'delta refresh' of one is not an
+    O(delta) operation and pretending otherwise would silently hide a
+    full re-mine behind an incremental flag."""
+    from avenir_tpu.core import incremental as incr
+    from avenir_tpu.runner import (_job_cfg, _note_sidecar_counters,
+                                   _plan_finish, _prepare_incremental,
+                                   _sidecar_counters, stream_fold_ops)
+
+    canonical, prefix, cfg = _job_cfg(name, conf)
+    if canonical in RESCAN_AT_FINISH:
+        raise ShardError(
+            f"{canonical} cannot refresh incrementally under --shard: "
+            f"the miners' per-k rounds re-scan the whole corpus per "
+            f"candidate length; run --shard (full re-mine) or "
+            f"--incremental alone")
+    ops = stream_fold_ops(canonical)
+    policy = policy or StragglerPolicy()
+    inputs = [str(p) for p in inputs]
+    iplan = _prepare_incremental(canonical, cfg, inputs, output,
+                                 state_dir)
+    sc0 = _sidecar_counters()
+    sizes = [os.path.getsize(p) for p in inputs]
+    if all(w >= s for w, s in zip(iplan.watermarks, sizes)):
+        # nothing appended anywhere: re-emit from the carry alone —
+        # zero worker processes, zero bytes read
+        res = _plan_finish(iplan)
+        _note_sidecar_counters(canonical, res, sc0)
+        res.counters["Shard:Blocks"] = 0.0
+        res.counters["Shard:Workers"] = 0.0
+        return res
+
+    root = shard_root or tempfile.mkdtemp(prefix="avenir_refresh_")
+    own_root = shard_root is None
+    procs = max(int(procs), 1)
+    try:
+        plan = plan_shards(inputs, procs, factor,
+                           policy=policy.to_dict(),
+                           starts=list(iplan.watermarks),
+                           snap=_sidecar_snap(canonical, cfg, ops,
+                                              inputs, procs, factor,
+                                              schema=iplan.schema))
+        plan.job = canonical
+        plan.prefix = prefix
+        plan.props = {k: str(v) for k, v in cfg.props.items()
+                      if k != "__job_name__"}
+        write_plan(plan, os.path.join(root, "plan.json"))
+        ledger = BlockLedger(root)
+        logs = os.path.join(root, "logs")
+        os.makedirs(logs, exist_ok=True)
+        workers = []
+        for w in range(procs):
+            preexec = None
+            if pin_cores and hasattr(os, "sched_setaffinity"):
+                core = pin_cores[w % len(pin_cores)]
+                preexec = (lambda c=core: os.sched_setaffinity(0, {c}))
+            log = open(os.path.join(logs, f"w{w}.log"), "ab")
+            workers.append((log, subprocess.Popen(
+                [sys.executable, "-m", "avenir_tpu.dist.worker",
+                 root, str(w)],
+                stdout=log, stderr=log, env=_worker_env(),
+                cwd=_pkg_parent(), preexec_fn=preexec)))
+        try:
+            if worker_hook is not None:
+                worker_hook([p.pid for _log, p in workers], root)
+            deadline = time.perf_counter() + timeout_s
+            ready = os.path.join(root, "ready")
+            while True:
+                try:
+                    n_ready = len(os.listdir(ready))
+                except OSError:
+                    n_ready = 0
+                if n_ready >= procs:
+                    break
+                _reap_check(workers, ledger, plan, logs)
+                if time.perf_counter() > deadline:
+                    raise ShardError(
+                        f"{n_ready}/{procs} workers ready within "
+                        f"{timeout_s}s")
+                time.sleep(0.01)
+            t_scan = time.perf_counter()
+            with open(os.path.join(root, "go.tmp"), "w") as fh:
+                fh.write("go")
+            os.replace(os.path.join(root, "go.tmp"),
+                       os.path.join(root, "go"))
+            n_blocks = len(plan.blocks)
+            _wait_commits(ledger, n_blocks, workers, logs, deadline,
+                          policy.poll_s)
+            grace_until = time.perf_counter() + policy.exit_grace_s
+            while any(p.poll() is None for _log, p in workers) \
+                    and time.perf_counter() < grace_until:
+                time.sleep(0.02)
+        finally:
+            for log, proc in workers:
+                if proc.poll() is None:
+                    proc.kill()
+                    proc.wait()
+                log.close()
+
+        # ---- merge the delta INTO the restored carry, in plan order
+        t_merge = time.perf_counter()
+        states = {bid: ledger.load_state(bid)
+                  for bid in ledger.committed()}
+        delta = merge_block_states(canonical, cfg, ops, plan, states,
+                                   inputs, root, schema=iplan.schema)
+        iplan.fold = (ops.merge_states(iplan.fold, delta)
+                      if iplan.hit_blocks > 0 else delta)
+        # the delta blocks' fingerprints extend the checkpoint: re-hash
+        # each plan block's byte range (newline-aligned, so the next
+        # solo or sharded refresh verifies the same content prefix)
+        for blk in plan.blocks:
+            if blk.start >= blk.end:
+                continue
+            path = plan.inputs[blk.input]["path"]
+            with open(path, "rb") as fh:
+                fh.seek(blk.start)
+                data = fh.read(blk.end - blk.start)
+            iplan.fps[blk.input].append(
+                incr.block_fingerprint(blk.start, data))
+            iplan.watermarks[blk.input] = blk.end
+            iplan.delta_blocks += 1
+        merge_ms = (time.perf_counter() - t_merge) * 1e3
+        t0 = _obs.now()
+        res = _plan_finish(iplan)
+        _obs.record("job.dispatch", t0, mode="sharded-refresh",
+                    procs=procs, blocks=n_blocks, jobs=canonical)
+        _note_sidecar_counters(canonical, res, sc0)
+        stats = _worker_stats(root, procs)
+        by_id = {b.id: b for b in plan.blocks}
+        res.counters["Shard:Blocks"] = float(n_blocks)
+        res.counters["Shard:StolenBlocks"] = float(
+            sum(1 for bid, info in ledger.claims().items()
+                if bid in by_id and by_id[bid].home != info["worker"]))
+        res.counters["Shard:DedupBlocks"] = float(ledger.dup_count())
+        res.counters["Shard:MergeMs"] = round(merge_ms, 3)
+        res.counters["Shard:ScanSeconds"] = round(
+            time.perf_counter() - t_scan, 4)
+        res.counters["Shard:Workers"] = float(procs)
+        if stats:
+            res.counters["Shard:MirroredBlocks"] = float(
+                sum(s.get("mirrored", 0) for s in stats))
+            _add_worker_sidecar_counters(res, stats)
+        return res
+    finally:
+        if own_root:
+            shutil.rmtree(root, ignore_errors=True)
+
+
+def _add_worker_sidecar_counters(res, stats: List[Dict]) -> None:
+    """Sum the workers' own sidecar/parse accounting into the result —
+    the cross-process half of the parse-free-replay proof: a sharded
+    run whose plan snapped to a warm sidecar reports Shard:ParseSpans
+    == 0 and Sidecar:HitBlocks == the plan's block tally."""
+    res.counters["Sidecar:HitBlocks"] = float(
+        sum(s.get("sidecar_hit_blocks", 0) for s in stats))
+    res.counters["Sidecar:DeltaBlocks"] = float(
+        sum(s.get("sidecar_delta_blocks", 0) for s in stats))
+    res.counters["Shard:ParseSpans"] = float(
+        sum(s.get("parse_spans", 0) for s in stats))
+    res.counters["Shard:ReplaySpans"] = float(
+        sum(s.get("replay_spans", 0) for s in stats))
 
 
 def _worker_stats(root: str, procs: int) -> List[Dict]:

@@ -30,6 +30,7 @@ the ledger next to it says who folded it.
 
 from __future__ import annotations
 
+import bisect
 import json
 import os
 from dataclasses import dataclass, field
@@ -128,21 +129,46 @@ class ShardPlan:
                    per_k=bool(obj.get("per_k", False)))
 
 
-def _align_boundaries(path: str, size: int, n: int) -> List[Tuple[int, int]]:
-    """Newline-aligned [lo, hi) ranges tiling ``[0, size)``: nominal
+def _snap_cut(b: int, lo: int, size: int,
+              snap: Sequence[int]) -> Optional[int]:
+    """The snap offset nearest a nominal boundary ``b`` that still cuts
+    strictly inside ``(lo, size)``, or None when the sorted snap list
+    has none. Snap offsets are sidecar block starts — themselves
+    newline-aligned — so a snapped cut needs no newline scan, and a
+    fully-snapped plan's block ranges tile the sidecar's own block
+    layout exactly (what lets a worker replay its claimed range)."""
+    i = bisect.bisect_left(snap, b)
+    best = None
+    for j in (i - 1, i):
+        if 0 <= j < len(snap) and lo < snap[j] < size:
+            if best is None or abs(snap[j] - b) < abs(best - b):
+                best = snap[j]
+    return best
+
+
+def _align_boundaries(path: str, size: int, n: int, start: int = 0,
+                      snap: Optional[Sequence[int]] = None
+                      ) -> List[Tuple[int, int]]:
+    """Newline-aligned [lo, hi) ranges tiling ``[start, size)``: nominal
     ceil-division bounds, each interior boundary advanced to one past
-    the next ``\\n`` at or after it. Boundaries that run out of
-    newlines collapse onto ``size`` — trailing empty ranges tile
-    gap-free, exactly like ``split_byte_ranges`` on a corpus smaller
-    than the split count."""
-    nominal = split_byte_ranges(size, n)
-    cuts = [0]
+    the next ``\\n`` at or after it — or, when a sorted ``snap`` offset
+    list is given (verified sidecar block starts), moved to the nearest
+    snap offset instead. Boundaries that run out of newlines collapse
+    onto ``size`` — trailing empty ranges tile gap-free, exactly like
+    ``split_byte_ranges`` on a corpus smaller than the split count."""
+    nominal = split_byte_ranges(size - start, n)
+    cuts = [start]
     with open(path, "rb") as fh:
         for _lo, hi in nominal[:-1]:
-            b = max(hi, cuts[-1])
+            b = max(start + hi, cuts[-1])
             if b >= size:
                 cuts.append(size)
                 continue
+            if snap:
+                snapped = _snap_cut(b, cuts[-1], size, snap)
+                if snapped is not None:
+                    cuts.append(snapped)
+                    continue
             fh.seek(b)
             scanned = 0
             nl = -1
@@ -163,18 +189,33 @@ def _align_boundaries(path: str, size: int, n: int) -> List[Tuple[int, int]]:
 
 def plan_shards(inputs: Sequence[str], procs: int,
                 factor: int = DEFAULT_FACTOR,
-                policy: Optional[Dict[str, float]] = None) -> ShardPlan:
+                policy: Optional[Dict[str, float]] = None,
+                starts: Optional[Sequence[int]] = None,
+                snap: Optional[Sequence[Optional[Sequence[int]]]] = None
+                ) -> ShardPlan:
     """Build the over-partitioned plan: every input cut into
     ``procs * factor`` newline-aligned blocks, block ids global in
     (input, offset) order, homes assigned as CONTIGUOUS runs per input
     (worker w's home blocks are one disk-sequential stretch; the steal
-    path is what breaks contiguity, and only when someone is slow)."""
+    path is what breaks contiguity, and only when someone is slow).
+
+    ``starts[i]`` plans input ``i`` from that byte offset instead of 0
+    (the sharded-refresh delta tail; must sit on a line boundary — the
+    incremental verified-prefix contract already guarantees it).
+    ``snap[i]`` is a sorted list of preferred cut offsets for input
+    ``i`` (verified sidecar block starts) — boundaries move to the
+    nearest snap offset so every plan block is a whole run of sidecar
+    blocks and a worker's claimed range replays parse-free."""
     if procs < 1:
         raise PlanError(f"procs must be positive, got {procs}")
     if factor < 1:
         raise PlanError(f"factor must be positive, got {factor}")
     if not inputs:
         raise PlanError("shard plan needs at least one input")
+    if starts is not None and len(starts) != len(inputs):
+        raise PlanError("starts must align with inputs")
+    if snap is not None and len(snap) != len(inputs):
+        raise PlanError("snap must align with inputs")
     plan = ShardPlan(procs=procs, factor=factor,
                      policy=dict(policy or {}))
     bid = 0
@@ -182,9 +223,16 @@ def plan_shards(inputs: Sequence[str], procs: int,
         if not os.path.exists(path):
             raise PlanError(f"no such input file: {path!r}")
         size = os.path.getsize(path)
+        start = int(starts[ii]) if starts is not None else 0
+        if not 0 <= start <= size:
+            raise PlanError(
+                f"start {start} outside [0, {size}] for {path!r}")
         plan.inputs.append({"path": os.path.abspath(path), "size": size})
         n = procs * factor
-        ranges = _align_boundaries(path, size, n)
+        ranges = _align_boundaries(
+            path, size, n, start=start,
+            snap=sorted(snap[ii]) if snap is not None and snap[ii]
+            else None)
         for j, (lo, hi) in enumerate(ranges):
             # contiguous home runs: blocks [w*factor, (w+1)*factor) of
             # this input belong to worker w

@@ -74,22 +74,55 @@ _HOLD_ENV = "AVENIR_SHARD_TEST_HOLD"
 RESCAN_AT_FINISH = ("frequentItemsApriori", "candidateGenerationWithSelfJoin")
 
 
+def _sidecar_range_feed(canonical: str, cfg, ops, schema, path: str,
+                        start: int, end: int, block_bytes: int):
+    """A write=False sidecar feed over one claimed byte range, or None.
+    The ranged contract replays ALL of [start, end) from verified
+    sidecar blocks or nothing — a worker never writes the shared
+    sidecar (N processes racing an append would tear it) and never
+    splices replay with cold parse mid-range; when the plan boundaries
+    were snapped to sidecar block starts the whole range replays."""
+    try:
+        from avenir_tpu.native import sidecar as sc
+
+        opts = sc.opts_from_cfg(cfg)
+        if ops.kind == "dataset":
+            return sc.dataset_blocks(opts, path, schema,
+                                     cfg.field_delim_regex, block_bytes,
+                                     byte_range=(start, end), write=False)
+        return sc.byte_blocks(opts, path, cfg.field_delim_regex,
+                              cfg.get_int("skip.field.count", 1),
+                              block_bytes, byte_range=(start, end),
+                              write=False)
+    except Exception:
+        return None
+
+
 def fold_block(canonical: str, cfg, ops, schema, inputs: List[str],
                path: str, start: int, end: int):
     """Fold ONE plan block — the byte range ``[start, end)`` of
     ``path`` — through the registered fold sink, and return the fed
     fold. Newline-aligned plan blocks make the range self-contained:
     the LineRecordReader contract in the readers degrades to a plain
-    slice read. Shared by the worker loop and the graftlint --merge
-    sharded-steal leg, so the audited fold path IS the production
-    one."""
+    slice read. When the whole range re-proves against the columnar
+    sidecar, the fold streams replayed payloads instead of parsing the
+    CSV (the fold sinks dispatch on payload type). Shared by the worker
+    loop and the graftlint --merge sharded-steal leg, so the audited
+    fold path IS the production one."""
     from avenir_tpu.core.stream import CsvBlockReader, iter_byte_blocks
     from avenir_tpu.runner import _drive_fold
 
     fold = ops.factory(cfg, list(inputs), schema)
     block_bytes = int(cfg.get_float("stream.block.size.mb", 64.0)
                       * (1 << 20))
-    if ops.kind == "dataset":
+    feed = None
+    if start < end:
+        feed = _sidecar_range_feed(canonical, cfg, ops, schema, path,
+                                   start, end, block_bytes)
+    if feed is not None:
+        chunks = (payload for _o, _l, _h, payload in feed
+                  if payload is not None)
+    elif ops.kind == "dataset":
         chunks = iter(CsvBlockReader(path, schema, cfg.field_delim_regex,
                                      block_bytes, byte_range=(start, end)))
     else:
@@ -258,6 +291,13 @@ class _Worker:
         self.barrier()
         by_id = {b.id: b for b in self.plan.blocks}
         t_run = time.perf_counter()
+        sc0 = None
+        try:
+            from avenir_tpu.native import sidecar as _sc
+
+            sc0 = _sc.counters_snapshot()
+        except Exception:
+            pass
         try:
             with _obs.capture() as rec:
                 from avenir_tpu.tune.signals import extract_signals
@@ -295,7 +335,26 @@ class _Worker:
                 if self.per_k:
                     self._run_per_k(by_id)
                     self.stats["perk_s"] = round(self._perk_wall, 4)
-                self.write_stats(extract_signals(rec.spans()))
+                # the parse-free-replay proof the coordinator surfaces:
+                # this worker's own span record (how many blocks hit the
+                # CSV parser vs the sidecar) plus the sidecar counter
+                # delta — cross-process, so it rides the stats file
+                spans = rec.spans()
+                self.stats["parse_spans"] = sum(
+                    1 for sp in spans if sp.name == "stream.parse")
+                self.stats["replay_spans"] = sum(
+                    1 for sp in spans
+                    if sp.name == "stream.sidecar.replay")
+                if sc0 is not None:
+                    try:
+                        now = _sc.counters_snapshot()
+                        self.stats["sidecar_hit_blocks"] = \
+                            now["hit_blocks"] - sc0["hit_blocks"]
+                        self.stats["sidecar_delta_blocks"] = \
+                            now["delta_blocks"] - sc0["delta_blocks"]
+                    except Exception:
+                        pass
+                self.write_stats(extract_signals(spans))
         finally:
             for fold in self._folds.values():
                 fold.src.close()

@@ -287,6 +287,9 @@ class StreamingTransactionSource(SpillScanMixin):
     def _scan_result(self) -> Tuple[List[str], np.ndarray, int]:
         return self.vocab, self._item_counts, self.n_trans
 
+    def _note_encoded_rows(self, per_row: np.ndarray, n: int) -> None:
+        self.n_trans += n
+
     def scan_items(self) -> Tuple[List[str], np.ndarray, int]:
         """Pass 1: (vocab, per-item transaction counts, n_trans). An item
         repeated within one transaction counts once (multi-hot algebra).

@@ -100,23 +100,27 @@ def _mi_chunk_counts_host(codes, y, bmax: int, k: int, nf: int):
     chunk-layout invariance is unaffected."""
     codes = np.ascontiguousarray(codes, np.int32)
     y = np.asarray(y, np.int32)
+    # one [n, F] class-fused key tensor: column f's key code*k + y IS
+    # the fc table key and the low digits of every pair-class key, so
+    # each pair costs one add + one bincount; the class-marginal pair
+    # table is the exact integer sum of pairc over the class axis —
+    # not a second bincount pass over n
+    cy = codes * np.int32(k) + y[:, None]                       # [n, F]
     fc = np.empty((nf, bmax, k), np.int64)
     for f in range(nf):
-        fc[f] = np.bincount(codes[:, f] * np.int32(k) + y,
+        fc[f] = np.bincount(cy[:, f],
                             minlength=bmax * k).reshape(bmax, k)
     npair = nf * (nf - 1) // 2
     pair = np.empty((npair, bmax, bmax), np.int64)
     pairc = np.empty((npair, bmax, bmax, k), np.int64)
     p = 0
     for i in range(nf):
-        ci_b = codes[:, i] * np.int32(bmax)
+        ci_bk = codes[:, i] * np.int32(bmax * k)
         for j in range(i + 1, nf):
-            key = ci_b + codes[:, j]
-            pair[p] = np.bincount(
-                key, minlength=bmax * bmax).reshape(bmax, bmax)
             pairc[p] = np.bincount(
-                key * np.int32(k) + y,
+                ci_bk + cy[:, j],
                 minlength=bmax * bmax * k).reshape(bmax, bmax, k)
+            pair[p] = pairc[p].sum(axis=2)
             p += 1
     return fc, pair, pairc
 

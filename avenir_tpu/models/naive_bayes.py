@@ -109,6 +109,23 @@ class NaiveBayesModel:
         bmax = self.post_counts.shape[2]
         n = labels.shape[0]
         int_mode = weights is None and self.cont_moments.shape[0] == 0
+        if int_mode and jax.default_backend() == "cpu":
+            # XLA:CPU pays the [n, F, bmax] one-hot einsum in memory
+            # bandwidth — ~100MB of materialized one-hots per 500k-row
+            # chunk for a table that is only F*K*bmax cells. Host
+            # bincount builds the same integer counts directly into the
+            # float64 arrays: bit-identical tables, same CPU-host
+            # contract as explore._mi_chunk_counts_host.
+            self.flush()
+            codes_h = np.ascontiguousarray(codes, np.int32)
+            y_h = np.asarray(labels, np.int32)
+            yb = y_h * np.int32(bmax)
+            for f in range(self.post_counts.shape[0]):
+                self.post_counts[f] += np.bincount(
+                    yb + codes_h[:, f],
+                    minlength=k * bmax).reshape(k, bmax)
+            self.class_counts += np.bincount(y_h, minlength=k)
+            return
         if self._pending is not None and self._pending_int != int_mode:
             self.flush()
         w = (jnp.asarray(weights) if weights is not None

@@ -269,6 +269,10 @@ class StreamingSequenceSource(SpillScanMixin):
     def _scan_result(self) -> Tuple[List[str], np.ndarray, int]:
         return self.vocab, self._item_counts, self.n_rows
 
+    def _note_encoded_rows(self, per_row: np.ndarray, n: int) -> None:
+        self.t_max = max(self.t_max, int(per_row.max(initial=0)))
+        self.n_rows += n
+
     def scan(self) -> Tuple[List[str], np.ndarray, int]:
         """Pass 1: (vocab, per-token row-presence counts, n_rows) — the
         k=1 support counts; also records t_max for fixed-shape chunks.

@@ -196,6 +196,23 @@ def _extract_column(lib, data: bytes, d: bytes, ordinal: int) -> List[str]:
     return raw.decode().split("\n")[:-1]
 
 
+def extract_column_raw(data: bytes, delim: str, ordinal: int
+                       ) -> Optional[bytes]:
+    """One column's trimmed tokens as the native parser's compact
+    newline-joined buffer (trailing newline included) — the exact bytes
+    the lazy-string thunks of parse_csv_native defer over, which is
+    also what the columnar sidecar stores for open-vocabulary columns.
+    None when the native library or a single-byte delimiter is not
+    available."""
+    lib = _get_lib()
+    if lib is None:
+        return None
+    d = delim.encode()
+    if len(d) != 1:
+        return None
+    return _extract_column_bytes(lib, data, d, ordinal)
+
+
 def seq_encode_native(data: bytes, delim: str, vocab: List[str]
                       ) -> Optional[Tuple[np.ndarray, np.ndarray]]:
     """Ragged tokenize + dictionary-encode a text block against one
@@ -483,12 +500,19 @@ class EncodedBlockCache:
         feed — simply never call this and keep the whole-file gate."""
         from avenir_tpu.core.incremental import block_hash
 
+        self.note_fingerprint(offset, len(data), block_hash(data))
+
+    def note_fingerprint(self, offset: int, length: int,
+                         hash_: str) -> None:
+        """note_block for a writer that already holds the block's content
+        hash (the sidecar-aware scan computes one fingerprint per block
+        for its own manifest) — same contract, no second hash pass."""
         if self._fingerprint is None:
             raise RuntimeError("note_block() before begin()")
         if self._committed:
             raise RuntimeError("note_block() after commit()")
         self._block_fps.setdefault(self._cur, []).append(
-            (int(offset), len(data), block_hash(data)))
+            (int(offset), int(length), hash_))
 
     def set_source(self, index: int) -> None:
         """Attribute subsequent add_block() calls to source `index` —
@@ -870,13 +894,43 @@ class SpillScanMixin:
         whole cache (the SharedScan feed below cannot attribute and
         writes one combined segment), and every block's content
         fingerprint is recorded (note_block) so an appended source later
-        replays its committed prefix and re-parses only the tail."""
+        replays its committed prefix and re-parses only the tail.
+
+        A runner that attached ``sidecar_opts`` (runner._build_miner_
+        source) routes each path through the cross-run columnar sidecar
+        first: verified blocks replay as SidecarBytesBlock (no tokenize,
+        no parse — _scan_encoded_block), cold blocks arrive raw and both
+        fold AND pack, so the NEXT run's pass 1 is parse-free too. The
+        per-k spill cache sits on top either way — replayed blocks feed
+        it their re-mapped codes, cold blocks their scanned ones."""
         from avenir_tpu.core.stream import iter_byte_blocks, prefetched
 
         self._scan_begin()
         label = type(self).__name__
+        opts = getattr(self, "sidecar_opts", None)
         for si, path in enumerate(self.paths):
-            if self._cache is not None:
+            feed = None
+            if opts is not None:
+                from avenir_tpu.native import sidecar as _sidecar
+
+                feed = _sidecar.byte_blocks(opts, path, self.delim,
+                                            self.skip, self.block_bytes)
+            if feed is not None:
+                if self._cache is not None:
+                    self._cache.set_source(si)
+                for off, length, hsh, payload in feed:
+                    if self._cache is not None:
+                        self._cache.note_fingerprint(off, length, hsh)
+                    if payload is None:
+                        continue
+                    if isinstance(payload, (bytes, bytearray)):
+                        t0 = _obs.now()
+                        self._scan_block(payload)
+                        _obs.record("stream.parse", t0, sink=label,
+                                    nbytes=length)
+                    else:
+                        self._scan_encoded_block(payload)
+            elif self._cache is not None:
                 self._cache.set_source(si)
                 for off, data in prefetched(
                         iter_byte_blocks(path, self.block_bytes,
@@ -895,6 +949,51 @@ class SpillScanMixin:
                                 nbytes=len(data))
         return self._scan_finish()
 
+    def _scan_encoded_block(self, blk) -> None:
+        """Fold one replayed sidecar block (native.sidecar.
+        SidecarBytesBlock) — the parse-free twin of _scan_block. The
+        sidecar's vocabulary extends this source's in FIRST-SEEN order
+        (minus the infrequent-item marker, which the sidecar keeps but
+        miners drop), which is exactly the order the cold discovery scan
+        would have assigned — so codes, counts and the per-k spill cache
+        come out identical to a cold pass over the same bytes."""
+        if blk.skip != self.skip:
+            raise ValueError(
+                f"sidecar block packed at skip={blk.skip} fed to a "
+                f"skip={self.skip} scan")
+        done = getattr(self, "_sidecar_vocab_done", 0)
+        for tok in blk.vocab[done:blk.vocab_end]:
+            if tok != self._scan_marker and tok not in self.index:
+                self.index[tok] = len(self.vocab)
+                self.vocab.append(tok)
+        self._sidecar_vocab_done = max(done, blk.vocab_end)
+        self._grow_counts()
+        # stored sidecar codes are vocab code + 1 with 0 = the empty
+        # token; map through a LUT onto THIS source's codes, -1 dropping
+        # empties and the marker exactly as the cold region mask does
+        lut = np.full(blk.vocab_end + 1, -1, np.int32)
+        for k in range(blk.vocab_end):
+            tok = blk.vocab[k]
+            if tok != self._scan_marker:
+                lut[k + 1] = self.index[tok]
+        mapped = lut[blk.codes]
+        region = mapped >= 0
+        row_of = np.repeat(np.arange(blk.n, dtype=np.int32), blk.counts)
+        self._scan_counts += distinct_row_code_counts(
+            row_of, mapped, region, len(self.vocab))
+        per_row = np.bincount(row_of[region].astype(np.intp),
+                              minlength=blk.n)
+        if self._cache is not None:
+            self._cache.add_block(per_row, mapped[region])
+        self._note_encoded_rows(per_row, blk.n)
+
+    def _note_encoded_rows(self, per_row: np.ndarray, n: int) -> None:
+        """Subclass hook: update the per-scan row counters for one
+        replayed block (association: transaction count; sequence: row
+        count and max length) — the only part of the block fold the
+        mixin cannot name for both miners."""
+        raise NotImplementedError
+
     def scan_consumer(self):
         """Shared-scan sink: pass 1 driven by EXTERNAL raw byte blocks
         (core.stream.SharedScan fans one disk read to N such sinks).
@@ -905,7 +1004,12 @@ class SpillScanMixin:
         label = type(self).__name__
 
         class _ScanSink:
-            def consume(self, data: bytes) -> None:
+            def consume(self, data) -> None:
+                if not isinstance(data, (bytes, bytearray)):
+                    # a sidecar-replayed block from a sidecar-aware
+                    # shared feed: parse-free fold, no stream.parse span
+                    src._scan_encoded_block(data)
+                    return
                 # pass-1 parse/encode of an externally-read block: the
                 # same stream.parse span the own-read scan records
                 t0 = _obs.now()

@@ -125,6 +125,7 @@ def run_job(name: str, conf, inputs: Sequence[str], output: str = "") -> JobResu
         os.makedirs(parent, exist_ok=True)
     session = _autotune_begin([canonical], [cfg], inputs)
     rss0 = _rss_now()
+    sc0 = _sidecar_counters()
     t0 = _obs.now()
     try:
         res = fn(cfg, list(inputs), output)
@@ -133,6 +134,7 @@ def run_job(name: str, conf, inputs: Sequence[str], output: str = "") -> JobResu
             session.close()   # a leaked session would contaminate
         raise                 # every later one in this process
     _obs.record("job.run", t0, job=canonical)
+    _note_sidecar_counters(canonical, res, sc0)
     _add_mem_counters(canonical, cfg, inputs, res, rss0=rss0)
     if session is not None:
         session.finish({canonical: res})
@@ -183,6 +185,8 @@ def _add_mem_counters(canonical: str, cfg: JobConfig,
     res.counters.setdefault("Cache:HitBlocks", 0.0)
     res.counters.setdefault("Cache:DeltaBlocks", 0.0)
     res.counters.setdefault("Resume:SkippedBytes", 0.0)
+    res.counters.setdefault("Sidecar:HitBlocks", 0.0)
+    res.counters.setdefault("Sidecar:DeltaBlocks", 0.0)
     try:
         import resource
 
@@ -229,6 +233,40 @@ def _add_mem_counters(canonical: str, cfg: JobConfig,
                 tune.record_residual(
                     canonical, cfg, paths,
                     res.counters["Mem:PredictedPeakBytes"], rss - rss0)
+    except Exception:
+        pass
+
+
+def _sidecar_counters() -> Optional[dict]:
+    """Snapshot of the process-global sidecar hit/delta counters taken
+    before a scan; _note_sidecar_counters pairs it with a second one to
+    attribute the delta to a JobResult. None (and no attribution) when
+    the sidecar layer cannot load."""
+    try:
+        from avenir_tpu.native import sidecar
+
+        return sidecar.counters_snapshot()
+    except Exception:
+        return None
+
+
+def _note_sidecar_counters(canonical: str, res: JobResult,
+                           before: Optional[dict]) -> None:
+    """Report the sidecar blocks this scan replayed (Sidecar:HitBlocks)
+    vs parsed cold into the sidecar (Sidecar:DeltaBlocks). Counters are
+    process-global, so a FUSED run attributes the shared scan's totals
+    to every fold it fed — the replays genuinely served each of them.
+    Advisory: any failure leaves the zeros _add_mem_counters installs."""
+    if before is None or canonical not in _STREAM_FOLDS:
+        return
+    try:
+        from avenir_tpu.native import sidecar
+
+        after = sidecar.counters_snapshot()
+        res.counters["Sidecar:HitBlocks"] = float(
+            after["hit_blocks"] - before["hit_blocks"])
+        res.counters["Sidecar:DeltaBlocks"] = float(
+            after["delta_blocks"] - before["delta_blocks"])
     except Exception:
         pass
 
@@ -623,13 +661,49 @@ class _MarkovPerClassFold:
             if lab not in vocab:
                 vocab.append(lab)
         self.vocab = vocab
+        self._index = {t: i for i, t in enumerate(vocab)}
         self.label_codes = np.asarray([vocab.index(lab)
                                        for lab in self.class_labels or []])
         self.native = native_seq_ready(self.delim)
         self.rows = 0
 
-    def consume(self, data: bytes) -> None:
-        if self.native:
+    def consume_encoded(self, blk) -> None:
+        """Fold one sidecar-replayed block (native.sidecar.
+        SidecarBytesBlock): rebuild the CSR code array seq_encode_native
+        would have produced — meta columns re-encoded from their token
+        buffers, tail codes mapped through a sidecar-vocab -> state-vocab
+        LUT (unknown tokens and the empty token both land on -1, exactly
+        the cold encode's sentinels) — and feed fit_csr. No tokenizer,
+        no parse span: this is the parse-free repeat path."""
+        from avenir_tpu.native.ingest import csr_region_mask
+
+        lens = blk.counts + blk.skip
+        offsets = np.zeros(blk.n + 1, np.int64)
+        np.cumsum(lens, out=offsets[1:])
+        total = int(offsets[-1])
+        codes = np.empty(total, np.int32)
+        idx = self._index
+        starts = offsets[:-1]
+        for j in range(blk.skip):
+            codes[starts + j] = [idx.get(t, -1) for t in blk.meta[j]]
+        lut = np.full(blk.vocab_end + 1, -1, np.int32)
+        for k in range(blk.vocab_end):
+            lut[k + 1] = idx.get(blk.vocab[k], -1)
+        if blk.skip:
+            tail = csr_region_mask(offsets, blk.skip, total)
+            codes[tail] = lut[blk.codes]
+        else:
+            codes[:] = lut[blk.codes]
+        self.model.fit_csr(
+            codes, offsets, skip=self.skip,
+            class_ord=self.class_ord if self.class_labels else None,
+            label_codes=self.label_codes)
+        self.rows += blk.n
+
+    def consume(self, data) -> None:
+        if not isinstance(data, (bytes, bytearray)):
+            self.consume_encoded(data)
+        elif self.native:
             from avenir_tpu.native.ingest import seq_encode_native
 
             # cannot be None: availability + 1-byte delim pre-checked
@@ -794,18 +868,34 @@ def _build_miner_source(canonical: str, cfg: JobConfig,
     if canonical == "frequentItemsApriori":
         from avenir_tpu.models.association import StreamingTransactionSource
 
-        return StreamingTransactionSource(
+        src = StreamingTransactionSource(
             list(inputs), delim=cfg.field_delim_regex,
             trans_id_ord=cfg.get_int("tans.id.ord", 0),
             skip_field_count=skip, marker=cfg.get("infreq.item.marker"),
             block_bytes=block, spill_cache=spill,
             cache_budget_bytes=_cache_budget(cfg))
-    from avenir_tpu.models.sequence import StreamingSequenceSource
+    else:
+        from avenir_tpu.models.sequence import StreamingSequenceSource
 
-    return StreamingSequenceSource(
-        list(inputs), delim=cfg.field_delim_regex,
-        skip_field_count=skip, block_bytes=block, spill_cache=spill,
-        cache_budget_bytes=_cache_budget(cfg))
+        src = StreamingSequenceSource(
+            list(inputs), delim=cfg.field_delim_regex,
+            skip_field_count=skip, block_bytes=block, spill_cache=spill,
+            cache_budget_bytes=_cache_budget(cfg))
+    _attach_sidecar_opts(src, cfg)
+    return src
+
+
+def _attach_sidecar_opts(src, cfg: JobConfig) -> None:
+    """Point a miner source's own-read discovery scan at the cross-run
+    columnar sidecar (SpillScanMixin._scan_all); a per-job
+    `stream.sidecar=false` (or a load failure) leaves the attribute
+    None and the scan cold."""
+    try:
+        from avenir_tpu.native import sidecar
+
+        src.sidecar_opts = sidecar.opts_from_cfg(cfg)
+    except Exception:
+        pass
 
 
 class _MinerScanFold:
@@ -1075,7 +1165,17 @@ def run_shared(specs: Sequence[Tuple[str, object, str]],
             schema = _FS.from_file(spaths.pop())
             chunks = stream_job_inputs(cfg0, list(inputs), schema)
         else:
-            chunks = stream_job_byte_blocks(cfg0, list(inputs))
+            # bytes-kind folds all dispatch on SidecarBytesBlock, so the
+            # shared feed opts into the bytes sidecar when the fused
+            # configs agree on the meta skip count (they must: the
+            # packed format is skip-specific); disagreement keeps the
+            # raw feed
+            skips = {cfg.get_int("skip.field.count", 1)
+                     for _, _, cfg, _, _ in built}
+            chunks = stream_job_byte_blocks(
+                cfg0, list(inputs),
+                sidecar_skip=skips.pop() if len(skips) == 1 else None)
+        sc0 = _sidecar_counters()
         scan = SharedScan(chunks)
         folds = []
         for canonical, _kind, cfg, factory, output in built:
@@ -1095,6 +1195,7 @@ def run_shared(specs: Sequence[Tuple[str, object, str]],
                 parent = os.path.dirname(os.path.abspath(output))
                 os.makedirs(parent, exist_ok=True)
             results[canonical] = _finish_fold(fold, output, canonical)
+            _note_sidecar_counters(canonical, results[canonical], sc0)
             _add_mem_counters(canonical, next(
                 cfg for c, _k, cfg, _f, _o in built if c == canonical),
                 inputs, results[canonical], rss0=rss0)
@@ -1371,6 +1472,65 @@ def _plan_finish(plan: _IncrementalPlan) -> JobResult:
     return res
 
 
+def _cold_delta_feed(plan: _IncrementalPlan, path: str, start: int,
+                     size: int):
+    """The historical delta loop body as a (offset, length, hash,
+    payload) tuple feed: raw blocks of [start, size), blanks as payload
+    None, dataset-kind blocks parsed under the stream.parse span."""
+    from avenir_tpu.core import incremental as incr
+    from avenir_tpu.core.stream import (is_blank_block, iter_byte_blocks,
+                                        prefetched)
+
+    feed = prefetched(iter_byte_blocks(path, plan.block,
+                                       byte_range=(start, size),
+                                       with_offsets=True), depth=1)
+    try:
+        for off, data in feed:
+            fp = incr.block_fingerprint(off, data)
+            if is_blank_block(data):
+                yield off, len(data), fp["hash"], None
+                continue
+            if plan.ops.kind == "dataset":
+                t0 = _obs.now()
+                payload = Dataset.from_csv(data, plan.schema,
+                                           delim=plan.delim)
+                _obs.record("stream.parse", t0, path=path,
+                            nbytes=len(data), rows=len(payload))
+            else:
+                payload = data
+            yield off, len(data), fp["hash"], payload
+    finally:
+        feed.close()
+
+
+def _delta_feed(plan: _IncrementalPlan, path: str, start: int, size: int):
+    """One source's delta range as a tuple feed, preferring the columnar
+    sidecar: a refresh whose delta bytes were already packed (by a
+    plain run, or by the previous refresh's extension) replays them
+    parse-free, the genuinely new tail parses cold AND extends the
+    sidecar. Any doubt — no manifest, boundary mismatch with the
+    checkpoint watermark, content drift — falls back to the cold loop,
+    byte-identically."""
+    feed = None
+    try:
+        from avenir_tpu.native import sidecar
+
+        opts = sidecar.opts_from_cfg(plan.cfg)
+        if plan.ops.kind == "dataset":
+            feed = sidecar.dataset_blocks(
+                opts, path, plan.schema, plan.delim, plan.block,
+                byte_range=(start, size))
+        else:
+            feed = sidecar.byte_blocks(
+                opts, path, plan.delim,
+                plan.cfg.get_int("skip.field.count", 1), plan.block,
+                byte_range=(start, size))
+    except Exception:
+        feed = None
+    return feed if feed is not None \
+        else _cold_delta_feed(plan, path, start, size)
+
+
 def run_incremental(name: str, conf, inputs: Sequence[str],
                     output: str = "",
                     state_dir: Optional[str] = None) -> JobResult:
@@ -1400,10 +1560,6 @@ def run_incremental(name: str, conf, inputs: Sequence[str],
     counters: Cache:HitBlocks (restored, fingerprint-verified blocks),
     Cache:DeltaBlocks (blocks folded this run) and Resume:SkippedBytes
     (bytes the restored carry covered)."""
-    from avenir_tpu.core import incremental as incr
-    from avenir_tpu.core.stream import (is_blank_block, iter_byte_blocks,
-                                        prefetched)
-
     canonical, _prefix, cfg = _job_cfg(name, conf)
     inputs = [str(p) for p in inputs]
     # autotune overlay BEFORE the restore plan: the knobs land in the
@@ -1416,6 +1572,7 @@ def run_incremental(name: str, conf, inputs: Sequence[str],
     try:
         plan = _prepare_incremental(canonical, cfg, inputs, output,
                                     state_dir)
+        sc0 = _sidecar_counters()
 
         # --------------------------------------------------- delta fold
         for si, path in enumerate(inputs):
@@ -1423,35 +1580,27 @@ def run_incremental(name: str, conf, inputs: Sequence[str],
             start = plan.watermarks[si]
             if start >= size:
                 continue
-            feed = prefetched(iter_byte_blocks(path, plan.block,
-                                               byte_range=(start, size),
-                                               with_offsets=True), depth=1)
+            feed = _delta_feed(plan, path, start, size)
             try:
-                for off, data in feed:
-                    if not is_blank_block(data):
-                        if plan.ops.kind == "dataset":
-                            t0 = _obs.now()
-                            payload = Dataset.from_csv(data, plan.schema,
-                                                       delim=plan.delim)
-                            _obs.record("stream.parse", t0, path=path,
-                                        nbytes=len(data),
-                                        rows=len(payload))
-                        else:
-                            payload = data
+                for off, length, fp_hash, payload in feed:
+                    if payload is not None:
                         t0 = _obs.now()
                         plan.fold.consume(payload)
                         _obs.record("stream.fold", t0,
                                     sink=plan.canonical)
-                    plan.fps[si].append(incr.block_fingerprint(off, data))
-                    plan.watermarks[si] = off + len(data)
+                    plan.fps[si].append({"offset": int(off),
+                                         "length": int(length),
+                                         "hash": fp_hash})
+                    plan.watermarks[si] = off + length
                     plan.delta_blocks += 1
-                    plan.since_ckpt += len(data)
+                    plan.since_ckpt += length
                     if plan.since_ckpt >= plan.interval:
                         _plan_checkpoint(plan, complete=False)
                         plan.since_ckpt = 0
             finally:
                 feed.close()
         res = _plan_finish(plan)
+        _note_sidecar_counters(canonical, res, sc0)
     except BaseException:
         if session is not None:
             session.close()   # a leaked session would contaminate
@@ -1482,9 +1631,7 @@ def run_incremental_shared(specs: Sequence[Tuple[str, object, str]],
     delimiter, one schema file); `state_dirs` optionally maps canonical
     job names to checkpoint dirs (the job server's managed store) —
     unmapped jobs use their per-(job, corpus) default."""
-    from avenir_tpu.core import incremental as incr
-    from avenir_tpu.core.stream import (SharedScan, is_blank_block,
-                                        iter_byte_blocks, prefetched)
+    from avenir_tpu.core.stream import SharedScan
 
     if not specs:
         return {}
@@ -1535,37 +1682,31 @@ def run_incremental_shared(specs: Sequence[Tuple[str, object, str]],
         groups.setdefault(tuple(plan.watermarks), []).append(plan)
 
     def delta_feed(group: List[_IncrementalPlan]):
-        """(source index, offset, raw block, parsed-once payload) past
-        the group's common watermark; payload is None for blank blocks
-        (folds skip them, fingerprints still cover them)."""
+        """(source index, offset, length, hash, parsed-once payload)
+        past the group's common watermark; payload is None for blank
+        blocks (folds skip them, fingerprints still cover them). Routes
+        through the columnar sidecar (_delta_feed) unless the group's
+        bytes-kind configs disagree on the meta skip count the packed
+        format is keyed to."""
+        sidecar_ok = kind == "dataset" or len(
+            {p.cfg.get_int("skip.field.count", 1) for p in group}) == 1
         for si, path in enumerate(inputs):
             size = os.path.getsize(path)
             start = group[0].watermarks[si]
             if start >= size:
                 continue
-            feed = prefetched(iter_byte_blocks(path, block,
-                                               byte_range=(start, size),
-                                               with_offsets=True), depth=1)
+            feed = (_delta_feed(group[0], path, start, size)
+                    if sidecar_ok
+                    else _cold_delta_feed(group[0], path, start, size))
             try:
-                for off, data in feed:
-                    payload = None
-                    if not is_blank_block(data):
-                        if kind == "dataset":
-                            t0 = _obs.now()
-                            payload = Dataset.from_csv(data, schema,
-                                                       delim=delim)
-                            _obs.record("stream.parse", t0, path=path,
-                                        nbytes=len(data),
-                                        rows=len(payload))
-                        else:
-                            payload = data
-                    yield si, off, data, payload
+                for off, length, fp_hash, payload in feed:
+                    yield si, off, length, fp_hash, payload
             finally:
                 feed.close()
 
     def fold_sink(plan: _IncrementalPlan):
         def consume(item) -> None:
-            payload = item[3]
+            payload = item[4]
             if payload is not None:
                 plan.fold.consume(payload)
         return consume
@@ -1575,18 +1716,20 @@ def run_incremental_shared(specs: Sequence[Tuple[str, object, str]],
         # serializes carries that already folded the current block —
         # the solo driver's exact ordering
         def consume(item) -> None:
-            si, off, data, _payload = item
-            fp = incr.block_fingerprint(off, data)
+            si, off, length, fp_hash, _payload = item
             for plan in group:
-                plan.fps[si].append(fp)
-                plan.watermarks[si] = off + len(data)
+                plan.fps[si].append({"offset": int(off),
+                                     "length": int(length),
+                                     "hash": fp_hash})
+                plan.watermarks[si] = off + length
                 plan.delta_blocks += 1
-                plan.since_ckpt += len(data)
+                plan.since_ckpt += length
                 if plan.since_ckpt >= plan.interval:
                     _plan_checkpoint(plan, complete=False)
                     plan.since_ckpt = 0
         return consume
 
+    sc0 = _sidecar_counters()
     for group in groups.values():
         scan = SharedScan(delta_feed(group))
         for plan in group:
@@ -1598,7 +1741,12 @@ def run_incremental_shared(specs: Sequence[Tuple[str, object, str]],
                     chunks=chunks_scanned,
                     jobs=",".join(p.canonical for p in group))
 
-    return {plan.canonical: _plan_finish(plan) for plan in plans}
+    results: Dict[str, JobResult] = {}
+    for plan in plans:
+        res = _plan_finish(plan)
+        _note_sidecar_counters(plan.canonical, res, sc0)
+        results[plan.canonical] = res
+    return results
 
 
 # =================================================================== bayesian
@@ -2542,6 +2690,7 @@ def gsp_job(cfg: JobConfig, inputs: List[str], output: str) -> JobResult:
                             * (1 << 20)),
             spill_cache=cfg.get_bool("stream.encoded.cache", True),
             cache_budget_bytes=_cache_budget(cfg))
+        _attach_sidecar_opts(src, cfg)
         levels = miner.mine_stream(src)
         n_rows = src.n_rows
         cache_counters = _cache_counters(src)
@@ -2705,6 +2854,7 @@ def apriori_job(cfg: JobConfig, inputs: List[str], output: str) -> JobResult:
                             * (1 << 20)),
             spill_cache=cfg.get_bool("stream.encoded.cache", True),
             cache_budget_bytes=_cache_budget(cfg))
+        _attach_sidecar_opts(src, cfg)
         levels = miner.mine_stream(src)
         n_rows = src.n_trans
         cache_counters = _cache_counters(src)
@@ -2890,7 +3040,13 @@ def markov_model_job(cfg: JobConfig, inputs: List[str], output: str) -> JobResul
     from avenir_tpu.core.stream import stream_job_byte_blocks
 
     fold = _MarkovPerClassFold(cfg, inputs)
-    _drive_fold(fold, stream_job_byte_blocks(cfg, inputs),
+    # the fold dispatches on SidecarBytesBlock (consume_encoded), so the
+    # feed opts into the bytes-kind sidecar at this job's skip count —
+    # a verified repeat scan fits from packed codes without a tokenizer
+    _drive_fold(fold,
+                stream_job_byte_blocks(cfg, inputs,
+                                       sidecar_skip=fold.skip
+                                       if fold.native else None),
                 "markovStateTransitionModel")
     return _finish_fold(fold, output, "markovStateTransitionModel")
 
@@ -3345,16 +3501,29 @@ def run_from_cli(argv: Sequence[str]) -> JobResult:
     short = args.jobname.rsplit(".", 1)[-1]
     name = args.jobname if args.jobname in _REGISTRY else short[0].lower() + short[1:]
     inputs, output = args.paths[:-1], args.paths[-1]
-    if args.shard and args.incremental:
-        ap.error("--shard and --incremental are different drivers; "
-                 "pick one (a sharded refresh is a ROADMAP item)")
+    if args.shard and args.incremental and (
+            _REGISTRY[name][0] if name in _REGISTRY else name) in (
+            "frequentItemsApriori", "candidateGenerationWithSelfJoin"):
+        # every other family composes the two drivers (run_sharded_refresh);
+        # the miners' per-k rounds re-scan the whole corpus per candidate
+        # length, so their 'incremental refresh' would be a hidden full
+        # re-mine — loud over silent
+        ap.error("--shard and --incremental cannot compose for the "
+                 "miners: per-k candidate rounds re-scan the whole "
+                 "corpus; run --shard (full re-mine) or --incremental "
+                 "alone")
     if args.shard and args.autotune:
         # the sharded driver does not consult the profile store yet;
         # accepting the flag would silently tune nothing — the same
         # loud-over-silent contract the knob guard holds everywhere
         ap.error("--shard does not support --autotune yet; the sharded "
                  "driver applies no tuned knobs")
-    if args.shard:
+    if args.shard and args.incremental:
+        from avenir_tpu.dist.driver import run_sharded_refresh
+
+        res = run_sharded_refresh(name, props, inputs, output,
+                                  procs=args.shard)
+    elif args.shard:
         from avenir_tpu.dist import run_sharded
 
         res = run_sharded(name, props, inputs, output,

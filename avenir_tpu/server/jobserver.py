@@ -417,7 +417,11 @@ class WarmStore:
     def pin(self, key: tuple, src) -> None:
         with self._lock:
             old = self._sources.pop(key, None)
-            if old is not None and old is not src:
+            if old is not None and old is not src \
+                    and not getattr(old, "cache_durable", False):
+                # a durable entry (sidecar handle) shares its on-disk
+                # state with the replacement — same key, same directory
+                # — so closing it here would rmtree what we are pinning
                 old.close()
             if not src.cache_ready():
                 src.close()               # nothing replayable to pin
@@ -503,7 +507,11 @@ class WarmStore:
     def close(self) -> None:
         with self._lock:
             for src in self._sources.values():
-                src.close()
+                # durable entries (sidecar handles) outlive the server:
+                # shutdown drops the PIN, not the on-disk cache — only
+                # budget eviction / staleness deletes a sidecar
+                if not getattr(src, "cache_durable", False):
+                    src.close()
             self._sources.clear()
             self._last_used.clear()
         if self._own_root:
@@ -1237,6 +1245,7 @@ class JobServer:
         if batch.mode == "refresh":
             state_dirs = {}
             managed: List[str] = []
+            self._checkout_sidecars(reqs)
             try:
                 for req in reqs:
                     canonical = _scoped(req.job, req.conf)[0]
@@ -1253,6 +1262,7 @@ class JobServer:
             finally:
                 for sd in managed:
                     self.warm.release_dir(sd)
+                self._pin_sidecars(reqs)
             return [shared[_scoped(r.job, r.conf)[0]] for r in reqs], 0.0
         if not batch.streamable:
             return [run_job(reqs[0].job,
@@ -1272,6 +1282,7 @@ class JobServer:
                 fold.keep_sources = True
                 captured[canonical] = fold
 
+        self._checkout_sidecars(reqs)
         try:
             shared = run_shared(
                 [(r.job, self._conf_with_tune_dir(r.conf), r.output)
@@ -1296,7 +1307,72 @@ class JobServer:
             cfg = _scoped(req.job, req.conf)[2]
             self.warm.pin(
                 WarmStore.source_key(canonical, req.inputs, cfg), fold.src)
+        self._pin_sidecars(reqs)
         return [shared[_scoped(r.job, r.conf)[0]] for r in reqs], 0.0
+
+    def _sidecar_keys(self, reqs):
+        """(key, path, dirpath) for every input sidecar a streamed batch
+        could touch, resolved from each request's own config — the dir
+        name bakes in schema/delimiter/block size, so two jobs over the
+        same file with different parse configs pin distinct entries."""
+        from avenir_tpu.native import sidecar as sc
+        from avenir_tpu.runner import _schema, stream_fold_ops
+
+        out = []
+        seen = set()
+        for req in reqs:
+            try:
+                canonical, _prefix, cfg = _scoped(req.job, req.conf)
+                ops = stream_fold_ops(canonical)
+                opts = sc.opts_from_cfg(cfg)
+                if opts is None:
+                    continue
+                block = int(cfg.get_float("stream.block.size.mb",
+                                          64.0) * (1 << 20))
+                delim = cfg.field_delim_regex
+                for path in req.inputs:
+                    if ops.kind == "dataset":
+                        dirpath = sc.dataset_dir(opts, path, _schema(cfg),
+                                                 delim, block)
+                    else:
+                        dirpath = sc.bytes_dir(
+                            opts, path, delim,
+                            cfg.get_int("skip.field.count", 1), block)
+                    key = ("sidecar", os.path.abspath(path),
+                           os.path.basename(dirpath))
+                    if key in seen:
+                        continue
+                    seen.add(key)
+                    out.append((key, path, dirpath))
+            except Exception:  # noqa: BLE001 — advisory resolution
+                continue
+        return out
+
+    def _checkout_sidecars(self, reqs) -> None:
+        """Exclusively check pinned sidecar entries out of the warm
+        store for the duration of a streamed batch so a concurrent
+        budget squeeze cannot rmtree a directory the scan is replaying.
+        The checked-out handles are deliberately dropped (a handle owns
+        no fd); _pin_sidecars() re-registers fresh ones afterwards."""
+        try:
+            for key, _path, _dirpath in self._sidecar_keys(reqs):
+                self.warm.lookup(key)
+        except Exception:  # noqa: BLE001 — advisory
+            pass
+
+    def _pin_sidecars(self, reqs) -> None:
+        """After a streamed batch, pin each input's (now-written)
+        sidecar under the warm store's byte budget.  Eviction calls
+        SidecarHandle.close(), which deletes the directory — the
+        sidecar is a bounded cache, and the server is its landlord."""
+        try:
+            from avenir_tpu.native import sidecar as sc
+            for key, path, dirpath in self._sidecar_keys(reqs):
+                handle = sc.SidecarHandle(path, dirpath)
+                if handle.cache_ready():
+                    self.warm.pin(key, handle)
+        except Exception:  # noqa: BLE001 — advisory
+            pass
 
     def _try_warm_miner(self, req: JobRequest):
         from avenir_tpu.runner import run_warm_miner

@@ -37,7 +37,7 @@ class RunSignals:
     producer_bound_s: float = 0.0      # consumer waited on producer
     consumer_bound_s: float = 0.0      # producer waited on consumer
     checkpoint_s: float = 0.0
-    chunks: int = 0                    # raw blocks read (stream.read)
+    chunks: int = 0                    # ingest blocks (read or replayed)
     bytes_read: int = 0
     fold_ms_by_sink: Dict[str, float] = field(default_factory=dict)
 
@@ -117,6 +117,15 @@ def extract_signals(spans: Iterable,
                 sig.bytes_read += int(sp.attrs.get("nbytes", 0))
         elif sp.name == "stream.parse":
             sig.parse_s += sp.dur
+        elif sp.name == "stream.sidecar.replay":
+            # a parse-free sidecar replay IS the run's ingest: chunks
+            # and ingest seconds must stay visible to the block and
+            # prefetch policies on warm scans, or a packed corpus
+            # records a signal-less profile and the tuner goes inert
+            sig.read_s += sp.dur
+            sig.chunks += 1
+            if sp.attrs:
+                sig.bytes_read += int(sp.attrs.get("nbytes", 0))
         elif sp.name == "stream.fold":
             sig.fold_s += sp.dur
             sink = (sp.attrs or {}).get("sink", "sink")

@@ -509,16 +509,25 @@ class TestRunSharded:
         assert row["counters"]["Shard:Blocks"] >= 2
         assert open(solo, "rb").read() == open(out, "rb").read()
 
-    @pytest.mark.parametrize("combo,msg", [
-        (["--shard", "2", "--incremental"], "--shard and --incremental"),
-        (["--shard", "2", "--autotune"], "does not support --autotune"),
+    @pytest.mark.parametrize("job,combo,msg", [
+        # --shard + --incremental composes for fold families now
+        # (run_sharded_refresh); it stays a loud error ONLY for the
+        # miners, whose per-k rounds re-scan the whole corpus
+        ("frequentItemsApriori", ["--shard", "2", "--incremental"],
+         "cannot compose for the miners"),
+        ("candidateGenerationWithSelfJoin",
+         ["--shard", "2", "--incremental"],
+         "cannot compose for the miners"),
+        ("mutualInformation", ["--shard", "2", "--autotune"],
+         "does not support --autotune"),
     ])
-    def test_shard_flag_combinations_rejected_loudly(self, combo, msg):
+    def test_shard_flag_combinations_rejected_loudly(self, job, combo,
+                                                     msg):
         import subprocess
         import sys
 
         proc = subprocess.run(
-            [sys.executable, "-m", "avenir_tpu", "mutualInformation",
+            [sys.executable, "-m", "avenir_tpu", job,
              *combo, "in.csv", "out.txt"],
             capture_output=True, text=True, timeout=120, cwd=REPO,
             env=dict(os.environ, JAX_PLATFORMS="cpu",

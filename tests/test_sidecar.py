@@ -1,0 +1,345 @@
+"""The columnar sidecar's contracts (perf PR: parse-free repeat scans).
+
+1. Equivalence — every registered fold family produces BYTE-IDENTICAL
+   artifacts three ways: sidecar disabled (cold), sidecar packing its
+   first pass, and sidecar replaying a warm pass — across the Dataset
+   feed, the raw-byte feed, and the miners' own-read discovery scans.
+2. Parse-free — the warm pass records ZERO `stream.parse` spans and
+   >= 1 `stream.sidecar.replay` span: the repeat scan never touches
+   the CSV text.
+3. Never serve a wrong block — a torn columns.bin write (manifest is
+   committed LAST, so a crash leaves a stale or absent manifest), an
+   in-place content edit, or a schema/config change all re-prove
+   against the file and fall back to parsing from the first divergent
+   block; outputs stay byte-identical to a cold scan of the CURRENT
+   bytes.
+4. Append — only the tail past the verified prefix is parsed; the
+   prefix replays.
+5. Bounded cache — a tiny byte budget (writer-side abort, or a
+   WarmStore eviction rmtree-ing the directory) only ever costs speed,
+   never correctness.
+"""
+
+import glob
+import os
+
+import numpy as np
+import pytest
+
+from avenir_tpu.native import sidecar
+from avenir_tpu.runner import run_job
+
+
+# ---------------------------------------------------------------- fixtures
+def _churn(tmp_path, rows=1500):
+    from avenir_tpu.data import churn_schema, generate_churn
+
+    csv = tmp_path / "churn.csv"
+    csv.write_text(generate_churn(rows, seed=11, as_csv=True))
+    schema = tmp_path / "churn.json"
+    churn_schema().save(str(schema))
+    return str(csv), str(schema)
+
+
+def _seq(tmp_path, rows=800):
+    rng = np.random.default_rng(12)
+    states = ["L", "M", "H"]
+    csv = tmp_path / "seq.csv"
+    with open(csv, "w") as fh:
+        for i in range(rows):
+            up = i % 2 == 0
+            s, toks = 1, []
+            for _ in range(6):
+                p = [0.1, 0.3, 0.6] if up else [0.6, 0.3, 0.1]
+                s = int(np.clip(s + rng.choice([-1, 0, 1], p=p), 0, 2))
+                toks.append(states[s])
+            fh.write(f"c{i},{'T' if up else 'F'}," + ",".join(toks) + "\n")
+    return str(csv)
+
+
+def _conf(prefix, tmp_path, schema=None, block="0.01", **extra):
+    c = {f"{prefix}.stream.block.size.mb": block,
+         f"{prefix}.stream.sidecar.dir": str(tmp_path / "sc")}
+    if schema is not None:
+        c[f"{prefix}.feature.schema.file.path"] = schema
+    c.update({f"{prefix}.{k}": v for k, v in extra.items()})
+    return c
+
+
+def _mi_conf(tmp_path, schema, **kw):
+    return _conf("mut", tmp_path, schema,
+                 **{"mutual.info.score.algorithms":
+                    "mutual.info.maximization", **kw})
+
+
+def _mst_conf(tmp_path, **kw):
+    return _conf("mst", tmp_path, **{
+        "model.states": "L,M,H", "class.label.field.ord": "1",
+        "skip.field.count": "2", "class.labels": "T,F", **kw})
+
+
+def _bytes_of(res):
+    blobs = []
+    for p in sorted(res.outputs):
+        with open(p, "rb") as fh:
+            blobs.append(fh.read())
+    return b"\n".join(blobs)
+
+
+def _sc(res, key):
+    return res.counters.get(f"Sidecar:{key}", 0.0)
+
+
+def _manifest_dirs(tmp_path):
+    return sorted(os.path.dirname(p) for p in glob.glob(
+        str(tmp_path / "sc" / "*" / sidecar.MANIFEST)))
+
+
+# ------------------------------------------------- 1. equivalence, all six
+_FAMILIES = [
+    ("bayesianDistr", "bad", "churn", {}),
+    ("mutualInformation", "mut", "churn",
+     {"mutual.info.score.algorithms": "mutual.info.maximization"}),
+    ("fisherDiscriminant", "fid", "churn", {}),
+    ("markovStateTransitionModel", "mst", "seq",
+     {"model.states": "L,M,H", "class.label.field.ord": "1",
+      "skip.field.count": "2", "class.labels": "T,F"}),
+    ("frequentItemsApriori", "fia", "seq",
+     {"support.threshold": "0.3", "item.set.length": "2",
+      "skip.field.count": "2"}),
+    ("candidateGenerationWithSelfJoin", "cgs", "seq",
+     {"support.threshold": "0.3", "item.set.length": "2",
+      "skip.field.count": "2"}),
+]
+
+
+@pytest.mark.parametrize("job,prefix,corpus,extra",
+                         _FAMILIES, ids=[f[0] for f in _FAMILIES])
+def test_cold_pack_warm_byte_identical(tmp_path, job, prefix, corpus, extra):
+    """Disabled vs packing vs replaying: one artifact, three scans."""
+    churn_csv, schema = _churn(tmp_path)
+    csv = churn_csv if corpus == "churn" else _seq(tmp_path)
+    conf = _conf(prefix, tmp_path,
+                 schema=schema if corpus == "churn" else None, **extra)
+    cold = run_job(job, {**conf, f"{prefix}.stream.sidecar": "false"},
+                   [csv], str(tmp_path / "out_cold"))
+    pack = run_job(job, conf, [csv], str(tmp_path / "out_pack"))
+    warm = run_job(job, conf, [csv], str(tmp_path / "out_warm"))
+    assert _bytes_of(pack) == _bytes_of(cold)
+    assert _bytes_of(warm) == _bytes_of(cold)
+    assert _sc(cold, "DeltaBlocks") == 0 and _sc(cold, "HitBlocks") == 0
+    assert _sc(pack, "DeltaBlocks") >= 1, pack.counters
+    assert _sc(warm, "HitBlocks") == _sc(pack, "DeltaBlocks")
+    assert _sc(warm, "DeltaBlocks") == 0, warm.counters
+
+
+@pytest.mark.parametrize("family", ["dataset", "bytes"])
+def test_warm_replay_is_parse_free(tmp_path, family):
+    """The acceptance bar stated literally: zero `stream.parse` spans on
+    the happy replay path, asserted from a trace capture."""
+    from avenir_tpu.obs import trace
+
+    if family == "dataset":
+        csv, schema = _churn(tmp_path)
+        job, conf = "mutualInformation", _mi_conf(tmp_path, schema)
+    else:
+        csv = _seq(tmp_path)
+        job, conf = "markovStateTransitionModel", _mst_conf(tmp_path)
+    run_job(job, conf, [csv], str(tmp_path / "out_pack"))
+    with trace.capture() as rec:
+        warm = run_job(job, conf, [csv], str(tmp_path / "out_warm"))
+    spans = rec.spans()
+    parse = [s for s in spans if s.name == "stream.parse"]
+    replay = [s for s in spans if s.name == "stream.sidecar.replay"]
+    assert not parse, f"warm replay parsed {len(parse)} block(s)"
+    assert len(replay) == _sc(warm, "HitBlocks") >= 1
+
+
+# --------------------------------------------- 3. torn writes and drift
+def test_torn_write_never_commits(tmp_path):
+    """The manifest is written LAST: a truncated segment (crash between
+    the columns.bin append and the manifest rename — here the inverse,
+    a manifest surviving a lost segment tail), a leftover staging tmp,
+    and a manifest-less garbage dir must all re-prove, re-parse, and
+    reproduce the cold artifact — never replay a torn block."""
+    csv, schema = _churn(tmp_path)
+    conf = _mi_conf(tmp_path, schema)
+    cold = run_job("mutualInformation",
+                   {**conf, "mut.stream.sidecar": "false"},
+                   [csv], str(tmp_path / "out_cold"))
+    run_job("mutualInformation", conf, [csv], str(tmp_path / "out_pack"))
+    (scdir,) = _manifest_dirs(tmp_path)
+    seg = os.path.join(scdir, sidecar.SEGMENT)
+    # a) segment torn mid-block: manifest entries now point past EOF
+    with open(seg, "rb+") as fh:
+        fh.truncate(max(os.path.getsize(seg) // 2, 1))
+    torn = run_job("mutualInformation", conf, [csv],
+                   str(tmp_path / "out_torn"))
+    assert _bytes_of(torn) == _bytes_of(cold)
+    # the repack healed the sidecar; b) a leftover writer staging file
+    # (the crash-BEFORE-rename artifact) must not disturb a full replay
+    with open(os.path.join(scdir, sidecar.SEGMENT + ".tmp.99999"),
+              "wb") as fh:
+        fh.write(b"\x00garbage")
+    warm = run_job("mutualInformation", conf, [csv],
+                   str(tmp_path / "out_tmpfile"))
+    assert _bytes_of(warm) == _bytes_of(cold)
+    assert _sc(warm, "HitBlocks") >= 1 and _sc(warm, "DeltaBlocks") == 0
+    # c) no manifest at all (crash before the FIRST commit): garbage
+    # segment alone is never trusted
+    os.remove(os.path.join(scdir, sidecar.MANIFEST))
+    with open(seg, "wb") as fh:
+        fh.write(b"\x00" * 64)
+    fresh = run_job("mutualInformation", conf, [csv],
+                    str(tmp_path / "out_nomanifest"))
+    assert _bytes_of(fresh) == _bytes_of(cold)
+    assert _sc(fresh, "HitBlocks") == 0 and _sc(fresh, "DeltaBlocks") >= 1
+
+
+def test_content_drift_invalidates_from_edit_point(tmp_path):
+    """An in-place edit mid-file: blocks before the edit still replay
+    (content re-proof passes), the edited block and everything after
+    re-parse; the artifact tracks the CURRENT bytes."""
+    csv, schema = _churn(tmp_path)
+    conf = _mi_conf(tmp_path, schema)
+    pack = run_job("mutualInformation", conf, [csv],
+                   str(tmp_path / "out_pack"))
+    n_blocks = _sc(pack, "DeltaBlocks")
+    assert n_blocks >= 3, "need a multi-block corpus for this test"
+    blob = bytearray(open(csv, "rb").read())
+    # flip one digit ~60% in (same length: offsets, and therefore every
+    # block boundary, stay put — only content hashes diverge)
+    at = blob.index(b"1", int(len(blob) * 0.6))
+    blob[at:at + 1] = b"7"
+    with open(csv, "wb") as fh:
+        fh.write(bytes(blob))
+    cold = run_job("mutualInformation",
+                   {**conf, "mut.stream.sidecar": "false"},
+                   [csv], str(tmp_path / "out_cold_edited"))
+    warm = run_job("mutualInformation", conf, [csv],
+                   str(tmp_path / "out_warm_edited"))
+    assert _bytes_of(warm) == _bytes_of(cold)
+    assert 1 <= _sc(warm, "HitBlocks") < n_blocks
+    assert _sc(warm, "DeltaBlocks") >= 1
+    assert _sc(warm, "HitBlocks") + _sc(warm, "DeltaBlocks") == n_blocks
+
+
+def test_schema_and_config_drift_select_fresh_sidecars(tmp_path):
+    """Schema content, delimiter, block size and (for byte feeds) the
+    skip count are all baked into the directory digest: drifting any of
+    them can NEVER alias onto a stale cache. Discovery side effects are
+    normalized OUT, so the same schema re-loaded (or mutated by a scan)
+    keeps hitting its own sidecar."""
+    from avenir_tpu.core.schema import FeatureSchema
+
+    csv, schema = _churn(tmp_path)
+    opts = {"dir": str(tmp_path / "sc"), "budget": 1 << 30}
+    sch = FeatureSchema.from_file(schema)
+    base = sidecar.dataset_dir(opts, csv, sch, ",", 1 << 16)
+    # discovery normalization: a reload maps to the SAME directory
+    assert sidecar.dataset_dir(
+        opts, csv, FeatureSchema.from_file(schema), ",", 1 << 16) == base
+    variants = {
+        "block": sidecar.dataset_dir(opts, csv, sch, ",", 1 << 17),
+        "delim": sidecar.dataset_dir(opts, csv, sch, ";", 1 << 16),
+        "kind": sidecar.bytes_dir(opts, csv, ",", 2, 1 << 16),
+        "skip": sidecar.bytes_dir(opts, csv, ",", 3, 1 << 16),
+    }
+    sch2 = FeatureSchema.from_file(schema)
+    list(sch2)[0].name = "renamed"
+    variants["schema"] = sidecar.dataset_dir(opts, csv, sch2, ",", 1 << 16)
+    dirs = [base] + list(variants.values())
+    assert len(set(dirs)) == len(dirs), variants
+    # and a manifest written at one block size refuses to serve another
+    run_job("mutualInformation",
+            _mi_conf(tmp_path, schema), [csv], str(tmp_path / "o"))
+    (scdir,) = _manifest_dirs(tmp_path)
+    packed_block = int(0.01 * (1 << 20))      # _mi_conf's 0.01MB blocks
+    assert sidecar.verified_offsets(scdir, csv, packed_block)
+    assert sidecar.verified_offsets(scdir, csv, packed_block * 2) == []
+
+
+# ----------------------------------------------------------- 4. append
+def test_append_replays_prefix_parses_tail(tmp_path):
+    """After an append, the committed prefix replays and ONLY the tail
+    is parsed: parse spans == delta blocks, replay spans == hit blocks,
+    and the hit/delta split covers the new block count exactly."""
+    from avenir_tpu.data import generate_churn
+    from avenir_tpu.obs import trace
+
+    csv, schema = _churn(tmp_path, rows=2000)
+    conf = _mi_conf(tmp_path, schema)
+    pack = run_job("mutualInformation", conf, [csv],
+                   str(tmp_path / "out_pack"))
+    n0 = _sc(pack, "DeltaBlocks")
+    assert n0 >= 3
+    with open(csv, "a") as fh:
+        fh.write(generate_churn(200, seed=13, as_csv=True))
+    cold = run_job("mutualInformation",
+                   {**conf, "mut.stream.sidecar": "false"},
+                   [csv], str(tmp_path / "out_cold_app"))
+    with trace.capture() as rec:
+        warm = run_job("mutualInformation", conf, [csv],
+                       str(tmp_path / "out_warm_app"))
+    assert _bytes_of(warm) == _bytes_of(cold)
+    hits, delta = _sc(warm, "HitBlocks"), _sc(warm, "DeltaBlocks")
+    # the old final block was partial: the append grew it, so it (plus
+    # the genuinely new blocks) parses; every full old block replays
+    assert hits >= n0 - 1 >= 1 and delta >= 1
+    spans = rec.spans()
+    assert len([s for s in spans if s.name == "stream.parse"]) == delta
+    assert len([s for s in spans
+                if s.name == "stream.sidecar.replay"]) == hits
+    # and the healed sidecar now covers the whole appended file
+    again = run_job("mutualInformation", conf, [csv],
+                    str(tmp_path / "out_again"))
+    assert _bytes_of(again) == _bytes_of(cold)
+    assert _sc(again, "HitBlocks") == hits + delta
+    assert _sc(again, "DeltaBlocks") == 0
+
+
+# ----------------------------------------------------- 5. bounded cache
+def test_tiny_budget_never_costs_correctness(tmp_path):
+    """A budget smaller than one packed block: the writer aborts rather
+    than commit a partial lie, every run stays cold — and byte-identical."""
+    csv, schema = _churn(tmp_path)
+    conf = _mi_conf(tmp_path, schema,
+                    **{"stream.sidecar.budget.mb": "0.001"})
+    cold = run_job("mutualInformation",
+                   {**conf, "mut.stream.sidecar": "false"},
+                   [csv], str(tmp_path / "out_cold"))
+    first = run_job("mutualInformation", conf, [csv],
+                    str(tmp_path / "out_first"))
+    second = run_job("mutualInformation", conf, [csv],
+                     str(tmp_path / "out_second"))
+    assert _bytes_of(first) == _bytes_of(cold)
+    assert _bytes_of(second) == _bytes_of(cold)
+    assert _sc(second, "HitBlocks") == 0       # nothing fit: no replay
+    for scdir in _manifest_dirs(tmp_path):
+        assert sidecar.sidecar_nbytes(scdir) <= 1024
+
+
+def test_warmstore_eviction_keeps_byte_identity(tmp_path):
+    """The server-side landlord: evicting a pinned SidecarHandle rmtrees
+    the directory; the next scan repacks cold and reproduces the same
+    bytes. A zero-budget store must never hold (or half-delete) a dir."""
+    from avenir_tpu.server.jobserver import WarmStore
+
+    csv, schema = _churn(tmp_path)
+    conf = _mi_conf(tmp_path, schema)
+    cold = run_job("mutualInformation",
+                   {**conf, "mut.stream.sidecar": "false"},
+                   [csv], str(tmp_path / "out_cold"))
+    run_job("mutualInformation", conf, [csv], str(tmp_path / "out_pack"))
+    (scdir,) = _manifest_dirs(tmp_path)
+    handle = sidecar.SidecarHandle(csv, scdir)
+    assert handle.cache_ready() and handle.cache_nbytes > 0
+    store = WarmStore(byte_budget=1)          # tinier than any sidecar
+    store.pin(("sidecar", csv, os.path.basename(scdir)), handle)
+    assert store.stats()["pinned_sources"] == 0
+    assert not os.path.exists(scdir), "eviction must rmtree the sidecar"
+    repack = run_job("mutualInformation", conf, [csv],
+                     str(tmp_path / "out_repack"))
+    assert _bytes_of(repack) == _bytes_of(cold)
+    assert _sc(repack, "HitBlocks") == 0 and _sc(repack, "DeltaBlocks") >= 1
+    store.close()

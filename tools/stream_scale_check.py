@@ -71,10 +71,19 @@ per itemset file asserted, the Shard:PerKRounds/PerKBlocks/
 PerKSeconds counters recorded, and the summary gains
 `shard_miner_speedup`.
 
+With --sidecar, additionally measures the columnar sidecar: each anchor
+family runs three passes in one child — a jit-warmup pass with the
+sidecar disabled, a cold pass that packs a fresh sidecar next to the
+corpus, and a warm pass that replays it parse-free — recording
+`sidecar_speedup` (cold seconds / warm seconds, both jit-warm so the
+ratio prices ONLY the parse elimination), the sidecar's bytes-on-disk
+ratio vs the CSV, and the Sidecar:HitBlocks / Sidecar:DeltaBlocks
+counters; warm output asserted byte-identical to cold.
+
 Usage: python tools/stream_scale_check.py [--rows N_MILLION] [--extra]
                                           [--fused] [--incremental]
                                           [--server] [--shard]
-                                          [--no-audits]
+                                          [--sidecar] [--no-audits]
 """
 
 import json
@@ -260,6 +269,59 @@ print(json.dumps({"job": job, "seconds": round(dt, 1),
                   "scan_seconds": res.counters["Shard:ScanSeconds"],
                   "peak_rss_mb": round(rss, 1),
                   "counters": res.counters, "outputs": res.outputs}))
+'''
+
+
+_CHILD_SIDECAR = r'''
+import json, os, resource, shutil, sys, time
+sys.path.insert(0, ".")
+import jax
+jax.config.update("jax_platforms", "cpu")
+from avenir_tpu.runner import run_job
+
+job, conf_json, inp, outdir = sys.argv[1:5]
+conf = json.loads(conf_json)
+prefix = next(iter(conf)).split(".", 1)[0]
+scdir = os.path.join(outdir, "sidecar")
+shutil.rmtree(scdir, ignore_errors=True)
+
+def blobs(path):
+    if os.path.isdir(path):
+        return {f: open(os.path.join(path, f), "rb").read()
+                for f in sorted(os.listdir(path))}
+    with open(path, "rb") as fh:
+        return {".": fh.read()}
+
+# pass 0: jit warmup with the sidecar DISABLED, so the cold pass below
+# times parsing, not first-compile — the speedup must price only the
+# parse elimination
+run_job(job, {**conf, prefix + ".stream.sidecar": "false"}, [inp],
+        os.path.join(outdir, job + "_jitwarm"))
+conf[prefix + ".stream.sidecar.dir"] = scdir
+cold_out = os.path.join(outdir, job + "_cold")
+t0 = time.perf_counter()
+cold = run_job(job, conf, [inp], cold_out)
+t_cold = time.perf_counter() - t0
+warm_out = os.path.join(outdir, job + "_warm")
+t0 = time.perf_counter()
+warm = run_job(job, conf, [inp], warm_out)
+t_warm = time.perf_counter() - t0
+assert blobs(cold_out) == blobs(warm_out), "warm output != cold output"
+assert cold.counters.get("Sidecar:DeltaBlocks", 0) > 0, cold.counters
+assert warm.counters.get("Sidecar:HitBlocks", 0) > 0, warm.counters
+sc_bytes = sum(os.path.getsize(os.path.join(r, f))
+               for r, _d, fs in os.walk(scdir) for f in fs)
+rss = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / 1024.0
+print(json.dumps({
+    "job": job, "cold_seconds": round(t_cold, 2),
+    "warm_seconds": round(t_warm, 2),
+    "sidecar_speedup": round(t_cold / max(t_warm, 1e-9), 2),
+    "sidecar_bytes": sc_bytes,
+    "bytes_on_disk_ratio": round(sc_bytes / os.path.getsize(inp), 3),
+    "hit_blocks": warm.counters.get("Sidecar:HitBlocks"),
+    "delta_blocks": cold.counters.get("Sidecar:DeltaBlocks"),
+    "peak_rss_mb": round(rss, 1),
+    "outputs_byte_identical": True}))
 '''
 
 
@@ -575,6 +637,41 @@ def main():
         line["shard_speedup"] = round(
             line["solo_seconds"] / max(line["scan_seconds"], 1e-9), 2)
         results["sharded_frequentItemsApriori"] = line
+    if "--sidecar" in sys.argv:
+        # columnar-sidecar A/B: cold pack (parse + write sidecar) vs
+        # warm replay (parse-free) per anchor family, in one child with
+        # a jit-warmup pass so the ratio prices only the parse work
+        import shutil
+
+        outdir = f"/tmp/avenir_scale_sidecar_{ROWS_M}m"
+        shutil.rmtree(outdir, ignore_errors=True)
+        os.makedirs(outdir, exist_ok=True)
+        env = dict(os.environ, AVENIR_SKIP_DEVICE_PROBE="1")
+        sc_jobs = [
+            ("mutualInformation",
+             {"mut.feature.schema.file.path": schema_path,
+              "mut.mutual.info.score.algorithms":
+                  "mutual.info.maximization"},
+             CHURN_CSV),
+            ("markovStateTransitionModel",
+             {"mst.model.states": "L,M,H",
+              "mst.class.label.field.ord": "1",
+              "mst.skip.field.count": "2", "mst.class.labels": "T,F"},
+             SEQ_CSV),
+        ]
+        for job, conf, inp in sc_jobs:
+            proc = subprocess.run(
+                [sys.executable, "-c", _CHILD_SIDECAR, job,
+                 json.dumps(conf), inp, outdir],
+                capture_output=True, text=True, timeout=7200, env=env)
+            if proc.returncode != 0:
+                raise RuntimeError(
+                    f"sidecar {job} failed: {proc.stderr[-500:]}")
+            line = json.loads(proc.stdout.strip().splitlines()[-1])
+            print(json.dumps(line), flush=True)
+            assert line["peak_rss_mb"] < RSS_LIMIT_MB, \
+                f"sidecar {job} RSS {line['peak_rss_mb']}MB not O(block)"
+            results[f"sidecar_{job}"] = line
     if "--server" in sys.argv:
         # resident-server anchor: the 3-tenant mixed-kind open-loop
         # load served by an in-process JobServer vs one-job-at-a-time,
@@ -665,6 +762,21 @@ def main():
         miner = shard_cols.get("sharded_frequentItemsApriori")
         if miner is not None:
             summary["shard_miner_speedup"] = miner["shard_speedup"]
+    # the sidecar columns: parse-free warm replay vs cold pack per
+    # family, the on-disk cost of the cache, and the hit/delta block
+    # counters the two JobResults carried
+    sc_cols = {job[len("sidecar_"):]: line for job, line in results.items()
+               if job.startswith("sidecar_")}
+    if sc_cols:
+        summary["sidecar_speedup"] = {
+            job: line["sidecar_speedup"] for job, line in sc_cols.items()}
+        summary["sidecar_bytes_ratio"] = {
+            job: line["bytes_on_disk_ratio"]
+            for job, line in sc_cols.items()}
+        summary["sidecar_counters"] = {
+            job: {"hit_blocks": line["hit_blocks"],
+                  "delta_blocks": line["delta_blocks"]}
+            for job, line in sc_cols.items()}
     # the served-jobs/min column: batched multi-tenant serving vs
     # one-job-at-a-time, plus the served requests' Server:* counters
     if "jobServer" in results:
