@@ -283,6 +283,10 @@ class Dataset:
             bins.append(nb)
         codes = (np.stack(cols, axis=1) if cols
                  else np.zeros((self.n_rows, 0), dtype=np.int32))
+        # the cached matrix is SHARED across callers (a SharedScan chunk
+        # feeds several consumers): freeze it so an in-place write in
+        # one fused job raises instead of corrupting every other's codes
+        codes.setflags(write=False)
         self._codes_cache[memo_key] = (codes, tuple(bins))
         return codes, bins
 

@@ -538,7 +538,6 @@ def run_sharded_refresh(name: str, conf, inputs: Sequence[str],
     the whole corpus per level, so a 'delta refresh' of one is not an
     O(delta) operation and pretending otherwise would silently hide a
     full re-mine behind an incremental flag."""
-    from avenir_tpu.core import incremental as incr
     from avenir_tpu.runner import (_job_cfg, _note_sidecar_counters,
                                    _plan_finish, _prepare_incremental,
                                    _sidecar_counters, stream_fold_ops)
@@ -580,6 +579,7 @@ def run_sharded_refresh(name: str, conf, inputs: Sequence[str],
         plan.prefix = prefix
         plan.props = {k: str(v) for k, v in cfg.props.items()
                       if k != "__job_name__"}
+        plan.record_fps = True
         write_plan(plan, os.path.join(root, "plan.json"))
         ledger = BlockLedger(root)
         logs = os.path.join(root, "logs")
@@ -641,23 +641,46 @@ def run_sharded_refresh(name: str, conf, inputs: Sequence[str],
                                    inputs, root, schema=iplan.schema)
         iplan.fold = (ops.merge_states(iplan.fold, delta)
                       if iplan.hit_blocks > 0 else delta)
-        # the delta blocks' fingerprints extend the checkpoint: re-hash
-        # each plan block's byte range (newline-aligned, so the next
-        # solo or sharded refresh verifies the same content prefix)
+        # the delta blocks' fingerprints extend the checkpoint — the
+        # WORKER-recorded fingerprints of the exact chunks each fold
+        # consumed (ledger.load_fps), never a coordinator re-read: a
+        # source appended to between a worker's fold and this merge
+        # must not stamp never-folded bytes into the checkpoint. A
+        # block whose fingerprints are missing or do not tile its
+        # range (commit-crash window) poisons the whole extension: the
+        # merged carry already contains that block, so a checkpoint
+        # stamped without its fingerprints would double-fold it on the
+        # next refresh — keep the PREVIOUS checkpoint instead (the next
+        # refresh re-parses the delta: a cold fallback, never a wrong
+        # one).
+        gap = False
         for blk in plan.blocks:
             if blk.start >= blk.end:
                 continue
-            path = plan.inputs[blk.input]["path"]
-            with open(path, "rb") as fh:
-                fh.seek(blk.start)
-                data = fh.read(blk.end - blk.start)
-            iplan.fps[blk.input].append(
-                incr.block_fingerprint(blk.start, data))
-            iplan.watermarks[blk.input] = blk.end
             iplan.delta_blocks += 1
+            if gap:
+                continue
+            fps = ledger.load_fps(blk.id)
+            ok = bool(fps)
+            if ok:
+                expect = blk.start
+                try:
+                    for fp in fps:
+                        if int(fp["offset"]) != expect:
+                            ok = False
+                            break
+                        expect += int(fp["length"])
+                except (KeyError, TypeError, ValueError):
+                    ok = False
+                ok = ok and expect == blk.end
+            if not ok:
+                gap = True
+                continue
+            iplan.fps[blk.input].extend(fps)
+            iplan.watermarks[blk.input] = blk.end
         merge_ms = (time.perf_counter() - t_merge) * 1e3
         t0 = _obs.now()
-        res = _plan_finish(iplan)
+        res = _plan_finish(iplan, checkpoint=not gap)
         _obs.record("job.dispatch", t0, mode="sharded-refresh",
                     procs=procs, blocks=n_blocks, jobs=canonical)
         _note_sidecar_counters(canonical, res, sc0)

@@ -149,12 +149,25 @@ class BlockLedger:
     def state_path(self, block_id: int) -> str:
         return os.path.join(self.states_dir, f"b{block_id}.npz")
 
-    def commit(self, block_id: int, worker: int, blob: bytes) -> bool:
+    def fps_path(self, block_id: int) -> str:
+        return os.path.join(self.states_dir, f"b{block_id}.fps.json")
+
+    def commit(self, block_id: int, worker: int, blob: bytes,
+               fps: Optional[List[Dict]] = None) -> bool:
         """Publish a block's serialized fold state, FIRST COMMIT WINS.
         Returns True when this state is the one the coordinator will
         merge; False when the block was already committed — the
         duplicate is rejected (never merged: the fold families are
-        non-idempotent) and recorded under ``dups/``."""
+        non-idempotent) and recorded under ``dups/``.
+
+        ``fps`` (refresh plans) are the content fingerprints of the
+        chunks THIS fold consumed; only the winning commit publishes
+        them (a losing mirror may have re-read different bytes), so
+        the coordinator's checkpoint extension always describes the
+        state it merges. Published after the state link — a crash in
+        between leaves a committed block with no fingerprints, which
+        the coordinator treats as end-of-extension (cold next refresh
+        from there), never as a wrong checkpoint."""
         path = self.state_path(block_id)
         tmp = os.path.join(self.states_dir,
                            f".tmp.b{block_id}.{uuid.uuid4().hex}")
@@ -162,6 +175,11 @@ class BlockLedger:
             fh.write(blob)
         try:
             os.link(tmp, path)
+            if fps is not None:
+                fptmp = f"{tmp}.fps"
+                with open(fptmp, "w") as fh:
+                    json.dump(fps, fh)
+                os.replace(fptmp, self.fps_path(block_id))
             return True
         except FileExistsError:
             self._mark_dup(block_id, worker)
@@ -171,6 +189,17 @@ class BlockLedger:
                 os.remove(tmp)
             except OSError:
                 pass
+
+    def load_fps(self, block_id: int) -> Optional[List[Dict]]:
+        """The winning commit's folded-chunk fingerprints, or None when
+        the block committed without them (non-refresh plan, or a crash
+        between the state link and the fingerprint publish)."""
+        try:
+            with open(self.fps_path(block_id)) as fh:
+                fps = json.load(fh)
+            return list(fps) if isinstance(fps, list) else None
+        except (OSError, ValueError):
+            return None
 
     def _mark_dup(self, block_id: int, worker: int) -> None:
         """Record one rejected duplicate commit — worker-namespaced so

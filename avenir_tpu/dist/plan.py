@@ -100,6 +100,12 @@ class ShardPlan:
     #: per-k candidate-counting loop against the level-namespaced
     #: ledger (driver publishes candidate manifests, workers count)
     per_k: bool = False
+    #: refresh plans: workers fingerprint the exact chunks each block
+    #: fold consumes and commit them with the block state, so the
+    #: coordinator extends the incremental checkpoint from folded
+    #: bytes instead of re-reading files a concurrent writer may have
+    #: changed since the fold
+    record_fps: bool = False
 
     def input_paths(self) -> List[str]:
         return [str(i["path"]) for i in self.inputs]
@@ -114,7 +120,8 @@ class ShardPlan:
                 "inputs": [dict(i) for i in self.inputs],
                 "blocks": [b.to_dict() for b in self.blocks],
                 "policy": dict(self.policy),
-                "per_k": bool(self.per_k)}
+                "per_k": bool(self.per_k),
+                "record_fps": bool(self.record_fps)}
 
     @classmethod
     def from_dict(cls, obj: Dict) -> "ShardPlan":
@@ -126,7 +133,8 @@ class ShardPlan:
                    blocks=[ShardBlock.from_dict(b)
                            for b in obj.get("blocks", [])],
                    policy=dict(obj.get("policy", {})),
-                   per_k=bool(obj.get("per_k", False)))
+                   per_k=bool(obj.get("per_k", False)),
+                   record_fps=bool(obj.get("record_fps", False)))
 
 
 def _snap_cut(b: int, lo: int, size: int,

@@ -99,7 +99,8 @@ def _sidecar_range_feed(canonical: str, cfg, ops, schema, path: str,
 
 
 def fold_block(canonical: str, cfg, ops, schema, inputs: List[str],
-               path: str, start: int, end: int):
+               path: str, start: int, end: int,
+               fps_out: Optional[list] = None):
     """Fold ONE plan block — the byte range ``[start, end)`` of
     ``path`` — through the registered fold sink, and return the fed
     fold. Newline-aligned plan blocks make the range self-contained:
@@ -108,8 +109,18 @@ def fold_block(canonical: str, cfg, ops, schema, inputs: List[str],
     sidecar, the fold streams replayed payloads instead of parsing the
     CSV (the fold sinks dispatch on payload type). Shared by the worker
     loop and the graftlint --merge sharded-steal leg, so the audited
-    fold path IS the production one."""
-    from avenir_tpu.core.stream import CsvBlockReader, iter_byte_blocks
+    fold path IS the production one.
+
+    ``fps_out`` (refresh plans) collects the content fingerprints of
+    the EXACT chunks the fold consumed — the sidecar feed's verified
+    hashes, or a hash of each raw block as it is read — tiling
+    [start, end) gap-free. The coordinator extends the incremental
+    checkpoint from these instead of re-reading the file, so a source
+    appended to between this fold and the merge can never stamp
+    never-folded bytes into the checkpoint."""
+    from avenir_tpu.core import incremental as incr
+    from avenir_tpu.core.stream import (CsvBlockReader, iter_byte_blocks,
+                                        prefetched)
     from avenir_tpu.runner import _drive_fold
 
     fold = ops.factory(cfg, list(inputs), schema)
@@ -120,8 +131,27 @@ def fold_block(canonical: str, cfg, ops, schema, inputs: List[str],
         feed = _sidecar_range_feed(canonical, cfg, ops, schema, path,
                                    start, end, block_bytes)
     if feed is not None:
-        chunks = (payload for _o, _l, _h, payload in feed
-                  if payload is not None)
+        def _sidecar_chunks():
+            for off, length, hsh, payload in feed:
+                if fps_out is not None:
+                    fps_out.append({"offset": int(off),
+                                    "length": int(length), "hash": hsh})
+                if payload is not None:
+                    yield payload
+        chunks = _sidecar_chunks()
+    elif fps_out is not None:
+        reader = CsvBlockReader(path, schema, cfg.field_delim_regex,
+                                block_bytes, byte_range=(start, end)) \
+            if ops.kind == "dataset" else None
+
+        def _fingerprinted_chunks():
+            for off, data in prefetched(
+                    iter_byte_blocks(path, block_bytes,
+                                     byte_range=(start, end),
+                                     with_offsets=True), depth=1):
+                fps_out.append(incr.block_fingerprint(off, data))
+                yield reader._parse(data) if reader is not None else data
+        chunks = _fingerprinted_chunks()
     elif ops.kind == "dataset":
         chunks = iter(CsvBlockReader(path, schema, cfg.field_delim_regex,
                                      block_bytes, byte_range=(start, end)))
@@ -235,8 +265,13 @@ class _Worker:
     # ------------------------------------------------------- fold path
     def _fold_and_commit(self, blk: ShardBlock) -> None:
         src = self.plan.inputs[blk.input]["path"]
+        # refresh plans: fingerprint the exact chunks this fold reads so
+        # the coordinator extends the checkpoint from folded bytes, not
+        # from a post-hoc re-read a concurrent writer may have changed
+        fps = [] if self.plan.record_fps else None
         fold = fold_block(self.canonical, self.cfg, self.ops, self.schema,
-                          self.inputs, src, blk.start, blk.end)
+                          self.inputs, src, blk.start, blk.end,
+                          fps_out=fps)
         if self.per_k:
             # seal NOW: commits this block's encoded spill cache, so the
             # per-k rounds replay it instead of re-parsing the CSV. The
@@ -244,7 +279,7 @@ class _Worker:
             # per-k merge reads only vocab/counts/n from it.
             fold._seal()
         blob = self.ops.serialize_state(fold)
-        if self.ledger.commit(blk.id, self.worker, blob):
+        if self.ledger.commit(blk.id, self.worker, blob, fps=fps):
             self.stats["folded"] += 1
         else:
             self.stats["dedup_rejected"] += 1

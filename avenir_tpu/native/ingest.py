@@ -868,6 +868,8 @@ class SpillScanMixin:
     def _scan_begin(self) -> None:
         self._reset_scan_state()
         self._scan_counts = np.zeros(0, np.int64)
+        self._sidecar_vocab_src = None
+        self._sidecar_vocab_done = 0
         self._scan_encoder = (
             BlockScanEncoder(self.delim, self.skip, self.vocab, self.index,
                              marker=self._scan_marker)
@@ -961,7 +963,15 @@ class SpillScanMixin:
             raise ValueError(
                 f"sidecar block packed at skip={blk.skip} fed to a "
                 f"skip={self.skip} scan")
-        done = getattr(self, "_sidecar_vocab_done", 0)
+        # the merge watermark is PER SIDECAR: each source's manifest has
+        # its own vocabulary (one shared list per feed), so key the
+        # watermark on that list's identity — a scan crossing inputs
+        # (own-read multi-path or a shared feed) restarts at 0 for the
+        # next source instead of skipping its unseen tokens
+        if getattr(self, "_sidecar_vocab_src", None) is not blk.vocab:
+            self._sidecar_vocab_src = blk.vocab
+            self._sidecar_vocab_done = 0
+        done = self._sidecar_vocab_done
         for tok in blk.vocab[done:blk.vocab_end]:
             if tok != self._scan_marker and tok not in self.index:
                 self.index[tok] = len(self.vocab)

@@ -1453,11 +1453,18 @@ def _plan_checkpoint(plan: _IncrementalPlan, complete: bool) -> None:
         hook(saved)
 
 
-def _plan_finish(plan: _IncrementalPlan) -> JobResult:
+def _plan_finish(plan: _IncrementalPlan,
+                 checkpoint: bool = True) -> JobResult:
     """Final (complete) checkpoint — written BEFORE finish() so the
     carry never reflects a finished/sealed fold — then the artifact and
-    the delta-accounting counters."""
-    _plan_checkpoint(plan, complete=True)
+    the delta-accounting counters. ``checkpoint=False`` (the sharded
+    refresh's missing-worker-fingerprints fallback) emits the artifact
+    without touching the store: the PREVIOUS checkpoint stays the
+    newest — its carry and fingerprints are still mutually consistent,
+    whereas stamping this carry with partial fingerprints would make
+    the next refresh re-fold bytes the carry already covers."""
+    if checkpoint:
+        _plan_checkpoint(plan, complete=True)
     if plan.output:
         parent = os.path.dirname(os.path.abspath(plan.output))
         os.makedirs(parent, exist_ok=True)

@@ -1284,30 +1284,36 @@ class JobServer:
 
         self._checkout_sidecars(reqs)
         try:
-            shared = run_shared(
-                [(r.job, self._conf_with_tune_dir(r.conf), r.output)
-                 for r in reqs],
-                inputs, fold_hook=fold_hook)
-        except BaseException:
-            # a fold marked keep_sources holds its source (and spill
-            # cache) open for pinning; on a failed batch nothing will
-            # pin it — close here or a resident server leaks an fd and
-            # on-disk cache segments per failed request
-            for fold in captured.values():
-                src = getattr(fold, "src", None)
-                if src is not None:
-                    try:
-                        src.close()
-                    except Exception:  # noqa: BLE001 — teardown
-                        pass
-            raise
-        for canonical, fold in captured.items():
-            req = next(r for r in reqs
-                       if _scoped(r.job, r.conf)[0] == canonical)
-            cfg = _scoped(req.job, req.conf)[2]
-            self.warm.pin(
-                WarmStore.source_key(canonical, req.inputs, cfg), fold.src)
-        self._pin_sidecars(reqs)
+            try:
+                shared = run_shared(
+                    [(r.job, self._conf_with_tune_dir(r.conf), r.output)
+                     for r in reqs],
+                    inputs, fold_hook=fold_hook)
+            except BaseException:
+                # a fold marked keep_sources holds its source (and spill
+                # cache) open for pinning; on a failed batch nothing will
+                # pin it — close here or a resident server leaks an fd
+                # and on-disk cache segments per failed request
+                for fold in captured.values():
+                    src = getattr(fold, "src", None)
+                    if src is not None:
+                        try:
+                            src.close()
+                        except Exception:  # noqa: BLE001 — teardown
+                            pass
+                raise
+            for canonical, fold in captured.items():
+                req = next(r for r in reqs
+                           if _scoped(r.job, r.conf)[0] == canonical)
+                cfg = _scoped(req.job, req.conf)[2]
+                self.warm.pin(
+                    WarmStore.source_key(canonical, req.inputs, cfg),
+                    fold.src)
+        finally:
+            # checked-out sidecar entries MUST return to the warm
+            # store's byte accounting even when the batch raises —
+            # mirrors the refresh branch (pin is advisory-safe)
+            self._pin_sidecars(reqs)
         return [shared[_scoped(r.job, r.conf)[0]] for r in reqs], 0.0
 
     def _sidecar_keys(self, reqs):

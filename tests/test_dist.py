@@ -186,6 +186,23 @@ class TestLedger:
         assert ledger.load_state(0) == f"state-{winner}".encode()
         assert ledger.dup_count() == 5
 
+    def test_commit_publishes_winner_fps_only(self, tmp_path):
+        # refresh plans: the WINNING commit's folded-chunk fingerprints
+        # are what the coordinator stamps into the checkpoint — a losing
+        # duplicate (which may have re-read different bytes) must never
+        # replace them, and a block committed without fps reads None
+        ledger = BlockLedger(str(tmp_path))
+        fps = [{"offset": 0, "length": 4, "hash": "aa"},
+               {"offset": 4, "length": 3, "hash": "bb"}]
+        assert ledger.commit(5, worker=0, blob=b"s0", fps=fps)
+        assert not ledger.commit(
+            5, worker=1, blob=b"s1",
+            fps=[{"offset": 0, "length": 7, "hash": "cc"}])
+        assert ledger.load_fps(5) == fps
+        assert ledger.committed() == [5]
+        assert ledger.commit(6, worker=0, blob=b"s")
+        assert ledger.load_fps(6) is None
+
     def test_level_namespaces_are_independent(self, tmp_path):
         # per-k rounds ride the same ledger under ledger/k<k>/: one
         # block id claims/commits independently per level, and a
@@ -535,6 +552,150 @@ class TestRunSharded:
                      + os.environ.get("PYTHONPATH", "")))
         assert proc.returncode != 0
         assert msg in proc.stderr
+
+    def test_fold_block_fingerprints_the_folded_bytes(self, corpus,
+                                                      tmp_path):
+        # the sharded-refresh checkpoint contract: fps_out describes the
+        # EXACT bytes the fold consumed, tiling [start, end) gap-free —
+        # so a concurrent append AFTER the fold can never leak
+        # never-folded content into the fingerprints
+        import shutil
+
+        from avenir_tpu.core import incremental as incr
+        from avenir_tpu.dist.worker import fold_block
+        from avenir_tpu.runner import _job_cfg, _schema, stream_fold_ops
+
+        csv = str(tmp_path / "copy.csv")
+        shutil.copy(corpus["csv"], csv)
+        canonical, _p, cfg = _job_cfg(
+            "mutualInformation",
+            {"mut.feature.schema.file.path": corpus["schema"],
+             "mut.stream.block.size.mb": "0.02",
+             "mut.stream.sidecar.dir": str(tmp_path / "sc")})
+        ops = stream_fold_ops(canonical)
+        schema = _schema(cfg)
+        size = os.path.getsize(csv)
+        with open(csv, "rb") as fh:
+            before = fh.read()
+        fps = []
+        fold_block(canonical, cfg, ops, schema, [csv], csv, 0, size,
+                   fps_out=fps)
+        # the concurrent-writer scenario: the file grows after the fold
+        with open(csv, "a") as fh:
+            fh.write("zz,77,1,2,3\n")
+        assert len(fps) >= 2
+        expect = 0
+        for fp in fps:
+            assert fp["offset"] == expect
+            chunk = before[fp["offset"]:fp["offset"] + fp["length"]]
+            assert fp["hash"] == incr.block_hash(chunk)
+            expect += fp["length"]
+        assert expect == size
+
+    def test_sharded_refresh_checkpoint_from_worker_fps(self, tmp_path):
+        # --shard + --incremental: the delta blocks' fingerprints come
+        # from the workers' committed fps (never a coordinator re-read);
+        # the extended checkpoint must verify cleanly on the next solo
+        # refresh, and the artifact must match a solo refresh twin
+        import shutil
+
+        from avenir_tpu.data import churn_schema, generate_churn
+        from avenir_tpu.dist.driver import run_sharded_refresh
+        from avenir_tpu.runner import run_incremental
+
+        rows = generate_churn(2000, seed=23, as_csv=True)
+        cut = rows.rindex("\n", 0, len(rows) * 2 // 3) + 1
+        csv = str(tmp_path / "churn.csv")
+        with open(csv, "w") as fh:
+            fh.write(rows[:cut])
+        schema = str(tmp_path / "churn.json")
+        churn_schema().save(schema)
+        conf = {"mut.feature.schema.file.path": schema,
+                "mut.stream.block.size.mb": "0.02",
+                "mut.stream.sidecar.dir": str(tmp_path / "sc")}
+        sd_shard = str(tmp_path / "state_shard")
+        run_incremental("mutualInformation", conf, [csv],
+                        str(tmp_path / "seed.txt"), state_dir=sd_shard)
+        sd_solo = str(tmp_path / "state_solo")
+        shutil.copytree(sd_shard, sd_solo)
+        with open(csv, "a") as fh:
+            fh.write(rows[cut:])
+        solo = str(tmp_path / "solo.txt")
+        run_incremental("mutualInformation", conf, [csv], solo,
+                        state_dir=sd_solo)
+        res = run_sharded_refresh(
+            "mutualInformation", conf, [csv],
+            str(tmp_path / "shard.txt"), procs=2,
+            policy=StragglerPolicy(mirror_floor_s=60.0),
+            state_dir=sd_shard)
+        assert open(solo, "rb").read() == \
+            open(str(tmp_path / "shard.txt"), "rb").read()
+        assert res.counters["Shard:Workers"] == 2.0
+        assert res.counters["Cache:DeltaBlocks"] >= 1.0
+        # the sharded-extended checkpoint verifies end to end: the
+        # follow-up solo refresh restores the WHOLE file warm
+        again = run_incremental("mutualInformation", conf, [csv],
+                                str(tmp_path / "again.txt"),
+                                state_dir=sd_shard)
+        assert again.counters["Cache:DeltaBlocks"] == 0.0
+        assert again.counters["Resume:SkippedBytes"] == \
+            float(os.path.getsize(csv))
+        assert open(str(tmp_path / "again.txt"), "rb").read() == \
+            open(solo, "rb").read()
+
+    def test_sharded_refresh_missing_fps_fall_back_cold(self, tmp_path,
+                                                        monkeypatch):
+        # a crash between the state link and the fps publish leaves a
+        # committed block with no fingerprints: the coordinator must
+        # keep the PREVIOUS checkpoint (the merged carry already holds
+        # that block — stamping it with partial fingerprints would
+        # double-fold on the next refresh), so the next refresh
+        # re-parses the delta — cold, never wrong
+        import shutil
+
+        from avenir_tpu.data import churn_schema, generate_churn
+        from avenir_tpu.dist.driver import run_sharded_refresh
+        from avenir_tpu.runner import run_incremental
+
+        rows = generate_churn(1200, seed=29, as_csv=True)
+        cut = rows.rindex("\n", 0, len(rows) // 2) + 1
+        csv = str(tmp_path / "churn.csv")
+        with open(csv, "w") as fh:
+            fh.write(rows[:cut])
+        schema = str(tmp_path / "churn.json")
+        churn_schema().save(schema)
+        conf = {"mut.feature.schema.file.path": schema,
+                "mut.stream.block.size.mb": "0.02",
+                "mut.stream.sidecar.dir": str(tmp_path / "sc")}
+        sd = str(tmp_path / "state")
+        run_incremental("mutualInformation", conf, [csv],
+                        str(tmp_path / "seed.txt"), state_dir=sd)
+        sd_solo = str(tmp_path / "state_solo")
+        shutil.copytree(sd, sd_solo)
+        with open(csv, "a") as fh:
+            fh.write(rows[cut:])
+        solo = str(tmp_path / "solo.txt")
+        run_incremental("mutualInformation", conf, [csv], solo,
+                        state_dir=sd_solo)
+        # the coordinator sees no fps (workers still commit states
+        # normally in their own processes)
+        monkeypatch.setattr(BlockLedger, "load_fps",
+                            lambda self, bid: None)
+        res = run_sharded_refresh(
+            "mutualInformation", conf, [csv],
+            str(tmp_path / "shard.txt"), procs=2,
+            policy=StragglerPolicy(mirror_floor_s=60.0), state_dir=sd)
+        assert open(solo, "rb").read() == \
+            open(str(tmp_path / "shard.txt"), "rb").read()
+        assert res.counters["Cache:DeltaBlocks"] >= 1.0
+        # checkpoint was NOT rewritten: the next solo refresh restores
+        # the OLD one, re-parses the delta, and lands on the same bytes
+        again = run_incremental("mutualInformation", conf, [csv],
+                                str(tmp_path / "again.txt"),
+                                state_dir=sd)
+        assert again.counters["Cache:DeltaBlocks"] >= 1.0
+        assert open(str(tmp_path / "again.txt"), "rb").read() == \
+            open(solo, "rb").read()
 
     def test_lost_workers_raise_with_blocks_outstanding(self, corpus,
                                                         tmp_path):

@@ -383,6 +383,35 @@ def test_warm_source_missed_on_different_trans_id_ord(tmp_path):
         assert _read(a) == _read(b)
 
 
+def test_failed_batch_returns_sidecars_to_warm_store(tmp_path, monkeypatch):
+    """A streamed batch checks sidecar entries OUT of the warm store so
+    a concurrent budget squeeze cannot delete a directory mid-replay.
+    If the batch then fails, the entries must be re-pinned anyway —
+    otherwise the resident server permanently loses byte accounting for
+    those directories and the budget landlord can never evict them."""
+    import avenir_tpu.runner as runner
+
+    csv, schema = _churn(tmp_path)
+    srv = _server(tmp_path, workers=1)
+    with srv:
+        srv.submit(JobRequest("mutualInformation", _mi_conf(schema),
+                              [csv], str(tmp_path / "p1.txt"))).result(120)
+        pinned = srv.stats()["warm_pinned_sources"]
+        assert pinned >= 1.0
+        real = runner.run_shared
+
+        def boom(*_a, **_kw):
+            raise RuntimeError("injected batch failure")
+
+        monkeypatch.setattr(runner, "run_shared", boom)
+        t = srv.submit(JobRequest("mutualInformation", _mi_conf(schema),
+                                  [csv], str(tmp_path / "p2.txt")))
+        with pytest.raises(RuntimeError, match="injected batch failure"):
+            t.result(120)
+        monkeypatch.setattr(runner, "run_shared", real)
+        assert srv.stats()["warm_pinned_sources"] == pinned
+
+
 def test_refresh_served_from_managed_checkpoint_store(tmp_path):
     from avenir_tpu.data import generate_churn
 

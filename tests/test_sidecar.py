@@ -259,6 +259,36 @@ def test_schema_and_config_drift_select_fresh_sidecars(tmp_path):
     assert sidecar.verified_offsets(scdir, csv, packed_block * 2) == []
 
 
+def test_multi_input_warm_scan_disjoint_vocabularies(tmp_path):
+    """Each input has its OWN sidecar with an independent first-seen
+    vocabulary: the miners' vocab-merge watermark must restart at every
+    source. A watermark carried over from input 1 made input 2's replay
+    skip its unseen tokens and crash the LUT build (KeyError) — the
+    'sidecar makes a scan faster, never wrong' regression."""
+    def write(path, toks):
+        with open(path, "w") as fh:
+            for i in range(300):
+                row = [toks[(i + j) % len(toks)] for j in range(4)]
+                fh.write(f"c{i},T," + ",".join(row) + "\n")
+
+    a, b = str(tmp_path / "a.csv"), str(tmp_path / "b.csv")
+    write(a, ["aa", "ab", "ac"])
+    write(b, ["ba", "bb", "bc"])          # fully disjoint from a's
+    conf = _conf("fia", tmp_path, **{"support.threshold": "0.2",
+                                     "item.set.length": "2",
+                                     "skip.field.count": "2"})
+    cold = run_job("frequentItemsApriori",
+                   {**conf, "fia.stream.sidecar": "false"},
+                   [a, b], str(tmp_path / "out_cold"))
+    run_job("frequentItemsApriori", conf, [a, b],
+            str(tmp_path / "out_pack"))
+    warm = run_job("frequentItemsApriori", conf, [a, b],
+                   str(tmp_path / "out_warm"))
+    assert _bytes_of(warm) == _bytes_of(cold)
+    assert _sc(warm, "HitBlocks") >= 2      # >= 1 per input
+    assert _sc(warm, "DeltaBlocks") == 0
+
+
 # ----------------------------------------------------------- 4. append
 def test_append_replays_prefix_parses_tail(tmp_path):
     """After an append, the committed prefix replays and ONLY the tail
