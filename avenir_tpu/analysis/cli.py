@@ -1,7 +1,7 @@
 """graftlint CLI: `graftlint <paths>` (console script) or
 `python tools/graftlint.py <paths>`.
 
-Five modes sharing one report/baseline/exit contract, plus ``--all``:
+Six modes sharing one report/baseline/exit contract, plus ``--all``:
 
 - AST (default): lint source paths with the rules.py catalog.
 - IR (``--ir``, no paths): trace the kernel manifest
@@ -19,7 +19,12 @@ Five modes sharing one report/baseline/exit contract, plus ``--all``:
   fold-state merge-algebra rules (analysis/merge.py) plus the
   shard-merge/resume audit proving every streamed job's carry merges
   across P ∈ {2, 4} shards and checkpoint-resumes byte-identically.
-- All (``--all``): the five tiers in ONE process — combined JSON under
+- Proto (``--proto``, paths optional — defaults to the shared-
+  filesystem protocol surface): the publish/read protocol-discipline
+  rules (analysis/proto.py) plus the commit-point crash auditor that
+  hard-kills a real publish per registered commit site at
+  before-rename and after-rename and proves recovery byte-identical.
+- All (``--all``): the six tiers in ONE process — combined JSON under
   a ``modes`` key and a single worst-of exit code (one command for CI
   and the bench tripwire's local reproduction).
 
@@ -28,14 +33,15 @@ Exit-code contract (stable — bench_scaling.py and CI tripwire on it):
   1  findings — non-allowlisted findings, stale baseline entries, or
      parse errors in the linted sources
   2  usage-or-trace-error — bad flags/baseline format/unreadable input,
-     a manifest entry that failed to trace/lower (--ir), or a stream
-     kernel that failed to run (--flow / --mem / --merge)
+     a manifest entry that failed to trace/lower (--ir), a stream
+     kernel that failed to run (--flow / --mem / --merge), or a crash
+     child / commit-site registry failure (--proto)
 ``--all`` exits with the WORST code any tier produced.
 
 `--json` prints one machine-readable object in every single-tier mode
 (same schema: `payload_audit` is empty outside --ir, `invariance_audit`
 outside --flow, `footprint_audit` outside --mem, `merge_audit` outside
---merge); ``--all --json`` prints ``{"modes": {<tier>: <report>},
+--merge, `proto_audit` outside --proto); ``--all --json`` prints ``{"modes": {<tier>: <report>},
 "clean": bool}`` with every tier's report under its name.
 """
 
@@ -51,8 +57,8 @@ from avenir_tpu.analysis.engine import (default_baseline_path, load_baseline,
                                         run_paths)
 from avenir_tpu.analysis.rules import ALL_RULES, rule_ids
 
-#: the five analysis tiers, in audit-cost order (cheapest first)
-TIERS = ("ast", "ir", "flow", "mem", "merge")
+#: the six analysis tiers, in audit-cost order (cheapest first)
+TIERS = ("ast", "ir", "flow", "mem", "merge", "proto")
 
 
 def build_parser() -> argparse.ArgumentParser:
@@ -84,8 +90,16 @@ def build_parser() -> argparse.ArgumentParser:
                         "surface) + the shard-merge/resume audit proving "
                         "every streamed job's carry merges across shards "
                         "and checkpoint-resumes byte-identically")
+    p.add_argument("--proto", action="store_true",
+                   help="shared-filesystem protocol-discipline analysis: "
+                        "the proto-* rules over the paths (default: the "
+                        "protocol surface) + the commit-point crash audit "
+                        "that hard-kills a real publish per registered "
+                        "commit site at before-rename and after-rename and "
+                        "proves recovery byte-identical with no stranded "
+                        "tmp")
     p.add_argument("--all", action="store_true", dest="all_tiers",
-                   help="run all five tiers in one process: combined JSON "
+                   help="run all six tiers in one process: combined JSON "
                         "(modes keyed by tier) and a single worst-of exit "
                         "code")
     p.add_argument("--baseline", default=None,
@@ -99,8 +113,9 @@ def build_parser() -> argparse.ArgumentParser:
                    help=f"comma-separated subset of: {', '.join(rule_ids())} "
                         f"(or the ir-* ids with --ir, the flow-* ids with "
                         f"--flow, the mem-* ids with --mem, the merge-* ids "
-                        f"with --merge; --all accepts ids from any tier and "
-                        f"skips tiers with none selected)")
+                        f"with --merge, the proto-* ids with --proto; --all "
+                        f"accepts ids from any tier and skips tiers with "
+                        f"none selected)")
     p.add_argument("--no-md", action="store_true",
                    help="skip ```python fences in .md files")
     p.add_argument("--allow-stale", action="store_true",
@@ -184,6 +199,11 @@ def _print_report(report, is_ir: bool) -> None:
         ok = sum(1 for a in report.merge_audit if a["merge_validated"])
         tail += (f", merge audit {ok}/{len(report.merge_audit)} "
                  f"stream kernels validated")
+    if report.proto_audit:
+        ok = sum(1 for a in report.proto_audit
+                 if a["commit_point_validated"])
+        tail += (f", commit-point audit {ok}/"
+                 f"{len(report.proto_audit)} commit sites validated")
     print(f"graftlint: {len(report.scanned)} {unit}, "
           f"{len(report.findings)} finding(s), "
           f"{len(report.suppressed)} allowlisted, "
@@ -201,7 +221,7 @@ def _exit_code(report, args) -> int:
 
 
 def _run_all(args, baseline, wanted: Optional[List[str]]) -> int:
-    """The ``--all`` mode: five tiers, one process, worst-of exit.
+    """The ``--all`` mode: six tiers, one process, worst-of exit.
 
     A ``--rules`` subset skips every tier it names no rules of (its
     audit included only when the tier's audit pseudo-rule is named), so
@@ -217,6 +237,8 @@ def _run_all(args, baseline, wanted: Optional[List[str]]) -> int:
                                          MemAuditError, run_mem)
     from avenir_tpu.analysis.merge import (ALL_MERGE_RULES, MERGE_AUDIT_RULE,
                                            MergeAuditError, run_merge)
+    from avenir_tpu.analysis.proto import (ALL_PROTO_RULES, PROTO_AUDIT_RULE,
+                                           ProtoAuditError, run_proto)
 
     paths = args.paths or None
     root = _report_root(args)
@@ -256,6 +278,11 @@ def _run_all(args, baseline, wanted: Optional[List[str]]) -> int:
                            baseline=baseline, root=root, include_md=md,
                            audit=want_audit(MERGE_AUDIT_RULE)),
          lambda: bool(pick(ALL_MERGE_RULES)) or want_audit(MERGE_AUDIT_RULE)),
+        ("proto", ProtoAuditError, "commit-point audit error",
+         lambda: run_proto(paths=paths, rules=pick(ALL_PROTO_RULES),
+                           baseline=baseline, root=root, include_md=md,
+                           audit=want_audit(PROTO_AUDIT_RULE)),
+         lambda: bool(pick(ALL_PROTO_RULES)) or want_audit(PROTO_AUDIT_RULE)),
     ]
     for name, err_cls, err_label, run, active in runs:
         if wanted is not None and not active():
@@ -293,12 +320,13 @@ def _default_surface() -> List[str]:
 def main(argv: Optional[List[str]] = None) -> int:
     parser = build_parser()
     args = parser.parse_args(argv)
-    tier_flags = sum(1 for m in (args.ir, args.flow, args.mem, args.merge)
+    tier_flags = sum(1 for m in (args.ir, args.flow, args.mem, args.merge,
+                                 args.proto)
                      if m)
     if tier_flags > 1 or (args.all_tiers and tier_flags):
-        print("graftlint: --ir, --flow, --mem and --merge are separate "
-              "analysis tiers; run them as separate invocations (or use "
-              "--all for every tier at once)", file=sys.stderr)
+        print("graftlint: --ir, --flow, --mem, --merge and --proto are "
+              "separate analysis tiers; run them as separate invocations "
+              "(or use --all for every tier at once)", file=sys.stderr)
         return 2
     if args.ir and args.paths:
         print("graftlint: --ir lints the kernel manifest; do not pass "
@@ -307,8 +335,8 @@ def main(argv: Optional[List[str]] = None) -> int:
         return 2
     if not args.all_tiers and not tier_flags and not args.paths:
         print("graftlint: pass paths to lint, or --ir / --flow / --mem / "
-              "--merge for the manifest audits (or --all for every tier)",
-              file=sys.stderr)
+              "--merge / --proto for the manifest audits (or --all for "
+              "every tier)", file=sys.stderr)
         return 2
 
     if args.ir:
@@ -339,14 +367,23 @@ def main(argv: Optional[List[str]] = None) -> int:
                                                MergeAuditError,
                                                merge_rule_ids, run_merge)
         known = merge_rule_ids()
+    elif args.proto:
+        # the commit-point audit spawns real publish jobs: same pin
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        from avenir_tpu.analysis.proto import (ALL_PROTO_RULES,
+                                               PROTO_AUDIT_RULE,
+                                               ProtoAuditError,
+                                               proto_rule_ids, run_proto)
+        known = proto_rule_ids()
     elif args.all_tiers:
         from avenir_tpu.analysis.flow import flow_rule_ids
         from avenir_tpu.analysis.mem import mem_rule_ids
         from avenir_tpu.analysis.merge import merge_rule_ids
+        from avenir_tpu.analysis.proto import proto_rule_ids
         # ir_rule_ids needs no jax; import via the module like the rest
         from avenir_tpu.analysis.ir import ir_rule_ids
         known = (rule_ids() + ir_rule_ids() + flow_rule_ids()
-                 + mem_rule_ids() + merge_rule_ids())
+                 + mem_rule_ids() + merge_rule_ids() + proto_rule_ids())
     else:
         known = rule_ids()
 
@@ -422,6 +459,22 @@ def main(argv: Optional[List[str]] = None) -> int:
                                include_md=not args.no_md, audit=audit)
         except MergeAuditError as e:
             print(f"graftlint: merge audit error: {e}", file=sys.stderr)
+            return 2
+        except OSError as e:
+            print(f"graftlint: cannot read input: {e}", file=sys.stderr)
+            return 2
+    elif args.proto:
+        proto_rules = ([r() for r in ALL_PROTO_RULES] if wanted is None
+                       else [r() for r in ALL_PROTO_RULES
+                             if r.rule_id in wanted])
+        audit = wanted is None or PROTO_AUDIT_RULE in wanted
+        try:
+            report = run_proto(paths=args.paths or None, rules=proto_rules,
+                               baseline=baseline, root=_report_root(args),
+                               include_md=not args.no_md, audit=audit)
+        except ProtoAuditError as e:
+            print(f"graftlint: commit-point audit error: {e}",
+                  file=sys.stderr)
             return 2
         except OSError as e:
             print(f"graftlint: cannot read input: {e}", file=sys.stderr)

@@ -78,9 +78,11 @@ class Report:
     job with its measured-RSS-vs-analytic-footprint verdict.
     `merge_audit` is filled only by merge runs (analysis/merge.py): one
     entry per streamed fold kernel with its shard-merge/checkpoint-
-    resume byte-identity verdict. Other modes leave them empty — the
-    keys are always present in the JSON so downstream tripwires can
-    parse one schema."""
+    resume byte-identity verdict. `proto_audit` is filled only by proto
+    runs (analysis/proto.py): one entry per registered commit site with
+    its kill-injection crash/recovery byte-identity verdict. Other
+    modes leave them empty — the keys are always present in the JSON
+    so downstream tripwires can parse one schema."""
 
     findings: List[Finding] = field(default_factory=list)
     suppressed: List[Finding] = field(default_factory=list)
@@ -91,6 +93,7 @@ class Report:
     invariance_audit: List[dict] = field(default_factory=list)
     footprint_audit: List[dict] = field(default_factory=list)
     merge_audit: List[dict] = field(default_factory=list)
+    proto_audit: List[dict] = field(default_factory=list)
 
     def counts(self) -> Dict[str, int]:
         out: Dict[str, int] = {}
@@ -114,6 +117,7 @@ class Report:
             "invariance_audit": self.invariance_audit,
             "footprint_audit": self.footprint_audit,
             "merge_audit": self.merge_audit,
+            "proto_audit": self.proto_audit,
             "clean": self.clean,
         }
 

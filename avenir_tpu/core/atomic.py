@@ -1,0 +1,145 @@
+"""Atomic-publish discipline: the one way a shared file commits.
+
+Every shared-filesystem protocol in this repo — spool results, leases,
+ledger claims/states, shard plans, checkpoints, sidecar manifests, tune
+profiles — publishes through the same three-step discipline: write the
+complete payload to a UNIQUELY-NAMED SIBLING tmp file, commit it with
+one atomic ``os.replace`` (or ``os.link`` for first-commit-wins), and
+clean the tmp up on every exit path. A reader then sees either no file
+or a complete one, two racing writers can never collide on a tmp name,
+and a rename can never silently become a cross-filesystem copy (the
+tmp is a sibling by construction). graftlint's proto tier
+(analysis/proto.py) checks the discipline statically and this module is
+its runtime half:
+
+- :func:`unique_tmp` / :func:`publish_bytes` / :func:`publish_json` —
+  the shared publish helpers the protocol modules commit through.
+- :func:`crash_point` — the ``AVENIR_PROTO_CRASH`` kill-injection hook:
+  each registered commit site calls it immediately before and after
+  its rename, and the crash-point auditor (``graftlint --proto``) runs
+  a real job per site with the hook armed, hard-kills the process at
+  both stages, and asserts recovery is byte-identical to an uncrashed
+  run. Production never sets the variable, so the hook is a dict probe.
+- :func:`sweep_stale_tmps` — startup GC for the tmp files hard-killed
+  writers leave behind: age-gated (mtime), so a LIVE tmp mid-commit is
+  never swept, and matched on the ``.tmp`` naming convention only, so
+  committed artifacts are never touched.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+import uuid
+from typing import List, Optional
+
+#: the kill-injection env var: ``"<site>:<stage>"`` hard-exits the
+#: process at that registered commit point (graftlint --proto only)
+CRASH_ENV = "AVENIR_PROTO_CRASH"
+
+#: crash stages every registered commit site exposes
+BEFORE_RENAME = "before-rename"
+AFTER_RENAME = "after-rename"
+
+#: the injected crash's exit code — distinguishable from a real error
+CRASH_EXIT = 43
+
+#: a tmp file untouched for this long is orphaned: no publish in this
+#: repo holds a tmp open for minutes, so the only writer that can have
+#: left it is one that died before its rename
+STALE_TMP_AGE_S = 300.0
+
+
+def crash_point(site: str, stage: str) -> None:
+    """Hard-kill the process (``os._exit``) when the auditor armed this
+    exact ``site:stage``; a no-op (one env probe) otherwise. Called by
+    every registered commit site right before and right after its
+    atomic rename — the two instants a crash must provably not corrupt
+    or strand shared state."""
+    if os.environ.get(CRASH_ENV, "") == f"{site}:{stage}":
+        os._exit(CRASH_EXIT)
+
+
+def unique_tmp(path: str) -> str:
+    """A uniquely-named tmp path in the SAME directory as `path`: two
+    racing writers can never collide on it, and the commit rename is
+    same-filesystem (atomic) by construction. Dot-prefixed so directory
+    scans for committed names never pick it up; ``.tmp``-suffixed so
+    :func:`sweep_stale_tmps` can GC it if the writer dies."""
+    head, base = os.path.split(path)
+    return os.path.join(head, f".{base}.{uuid.uuid4().hex[:8]}.tmp")
+
+
+def publish_bytes(payload: bytes, path: str, site: Optional[str] = None,
+                  fsync: bool = False) -> str:
+    """Atomically publish `payload` at `path`: unique sibling tmp,
+    ``os.replace``, tmp removed on every failure path. ``site`` names a
+    registered commit point (the crash-point auditor's hook fires on
+    both sides of the rename); ``fsync`` flushes the payload to disk
+    before the commit (the sidecar manifest's durability contract)."""
+    tmp = unique_tmp(path)
+    try:
+        with open(tmp, "wb") as fh:
+            fh.write(payload)
+            if fsync:
+                fh.flush()
+                os.fsync(fh.fileno())
+        if site is not None:
+            crash_point(site, BEFORE_RENAME)
+        os.replace(tmp, path)
+    except BaseException:
+        try:
+            os.remove(tmp)
+        except OSError:
+            pass
+        raise
+    if site is not None:
+        crash_point(site, AFTER_RENAME)
+    return path
+
+
+def publish_json(obj, path: str, site: Optional[str] = None,
+                 indent: Optional[int] = None,
+                 fsync: bool = False) -> str:
+    """:func:`publish_bytes` for one JSON document."""
+    return publish_bytes(json.dumps(obj, indent=indent).encode("utf-8"),
+                         path, site=site, fsync=fsync)
+
+
+def is_tmp_name(name: str) -> bool:
+    """True when `name` follows the protocol tmp naming convention —
+    the only files :func:`sweep_stale_tmps` may remove."""
+    base = os.path.basename(name)
+    return base.endswith(".tmp") or ".tmp." in base
+
+
+def sweep_stale_tmps(root: str,
+                     min_age_s: float = STALE_TMP_AGE_S) -> List[str]:
+    """GC orphaned protocol tmp files under `root` (recursively): every
+    ``*.tmp`` / ``*.tmp.*`` file whose mtime is older than `min_age_s`
+    is removed. Called at writer startup (ledger, lease store, spool
+    server, checkpoint store, profile store, sidecar writer) so a
+    hard-killed writer's leftovers do not accumulate forever. The age
+    gate is what keeps a LIVE tmp safe: a concurrent writer mid-commit
+    wrote its tmp moments ago, far inside any sane `min_age_s`, while
+    an orphan by definition stopped aging when its writer died.
+    Returns the removed paths; every OSError (racing sweepers, the
+    writer committing first) is survived."""
+    removed: List[str] = []
+    if not os.path.isdir(root):
+        return removed
+    for dirpath, dirnames, filenames in os.walk(root):
+        dirnames[:] = sorted(dirnames)
+        for name in sorted(filenames):
+            if not is_tmp_name(name):
+                continue
+            path = os.path.join(dirpath, name)
+            try:
+                if time.time() - os.stat(path).st_mtime <= min_age_s:
+                    continue
+                os.remove(path)
+            except OSError:
+                continue
+            removed.append(path)
+    return removed

@@ -33,6 +33,8 @@ import json
 import os
 from typing import Dict, Optional, Sequence, Tuple
 
+from avenir_tpu.core.atomic import publish_bytes, sweep_stale_tmps
+
 #: fingerprint hash: sha1. Chosen by MEASURED throughput — the hash is
 #: the incremental driver's per-refresh floor (the whole unchanged
 #: prefix re-hashes before a carry restores), and on this host sha1
@@ -158,12 +160,13 @@ class CheckpointStore:
     def __init__(self, state_dir: str):
         self.dir = state_dir
         os.makedirs(state_dir, exist_ok=True)
+        # startup GC: tmp files a hard-killed writer left behind (the
+        # age gate keeps a concurrent writer's live tmp safe)
+        sweep_stale_tmps(state_dir)
 
-    def _write_atomic(self, path: str, payload: bytes) -> None:
-        tmp = path + ".tmp"
-        with open(tmp, "wb") as fh:
-            fh.write(payload)
-        os.replace(tmp, path)
+    def _write_atomic(self, path: str, payload: bytes,
+                      site: Optional[str] = None) -> None:
+        publish_bytes(payload, path, site=site)
 
     def save(self, meta: dict, blob: bytes) -> dict:
         """Commit one checkpoint; returns the manifest actually written
@@ -173,8 +176,11 @@ class CheckpointStore:
         meta = dict(meta, carry_file=carry, carry_bytes=len(blob),
                     carry_hash=block_hash(blob))
         self._write_atomic(os.path.join(self.dir, carry), blob)
+        # the manifest replace IS the commit point — the carry above is
+        # invisible until the manifest references it
         self._write_atomic(os.path.join(self.dir, self.MANIFEST),
-                           json.dumps(meta, indent=1).encode())
+                           json.dumps(meta, indent=1).encode(),
+                           site="checkpoint.save")
         for name in os.listdir(self.dir):
             if (name.startswith("carry_") and name != carry) \
                     or name.endswith(".tmp"):

@@ -70,6 +70,7 @@ from typing import Callable, Dict, List, Optional, Sequence
 import numpy as np
 
 from avenir_tpu import obs as _obs
+from avenir_tpu.core.atomic import publish_bytes
 from avenir_tpu.dist.detect import StragglerPolicy
 from avenir_tpu.dist.ledger import BlockLedger
 from avenir_tpu.dist.plan import (DEFAULT_FACTOR, ShardPlan, plan_shards,
@@ -152,10 +153,7 @@ def _restore_inputs(canonical: str, plan: ShardPlan, block,
         with open(src, "rb") as fh:
             fh.seek(block.start)
             data = fh.read(block.end - block.start)
-        tmp = f"{slice_path}.tmp"
-        with open(tmp, "wb") as out:
-            out.write(data)
-        os.replace(tmp, slice_path)
+        publish_bytes(data, slice_path)
     return [slice_path]
 
 
@@ -391,10 +389,7 @@ def run_sharded(name: str, conf, inputs: Sequence[str], output: str,
                         f"{timeout_s}s")
                 time.sleep(0.01)
             t_scan = time.perf_counter()
-            with open(os.path.join(root, "go.tmp"), "w") as fh:
-                fh.write("go")
-            os.replace(os.path.join(root, "go.tmp"),
-                       os.path.join(root, "go"))
+            publish_bytes(b"go", os.path.join(root, "go"))
 
             n_blocks = len(plan.blocks)
             if per_k:
@@ -615,10 +610,7 @@ def run_sharded_refresh(name: str, conf, inputs: Sequence[str],
                         f"{timeout_s}s")
                 time.sleep(0.01)
             t_scan = time.perf_counter()
-            with open(os.path.join(root, "go.tmp"), "w") as fh:
-                fh.write("go")
-            os.replace(os.path.join(root, "go.tmp"),
-                       os.path.join(root, "go"))
+            publish_bytes(b"go", os.path.join(root, "go"))
             n_blocks = len(plan.blocks)
             _wait_commits(ledger, n_blocks, workers, logs, deadline,
                           policy.poll_s)

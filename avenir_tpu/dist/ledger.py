@@ -47,6 +47,10 @@ import time
 import uuid
 from typing import Dict, List, Optional
 
+from avenir_tpu.core.atomic import (AFTER_RENAME, BEFORE_RENAME,
+                                    crash_point, publish_json,
+                                    sweep_stale_tmps)
+
 
 class BlockLedger:
     """Claim/commit ledger for one sharded run, rooted at
@@ -63,6 +67,9 @@ class BlockLedger:
         self.dups_dir = os.path.join(self.root, "dups")
         for d in (self.claims_dir, self.states_dir, self.dups_dir):
             os.makedirs(d, exist_ok=True)
+        # startup GC: tmp files a hard-killed worker left behind (the
+        # age gate keeps a concurrent writer's live tmp safe)
+        sweep_stale_tmps(self.root)
 
     def level(self, ns: str) -> "BlockLedger":
         """A NAMESPACED sub-ledger under ``ledger/<ns>/`` — the per-k
@@ -90,10 +97,12 @@ class BlockLedger:
         with open(tmp, "w") as fh:
             json.dump({"block": block_id, "worker": worker,
                        "claimed_at": time.time(), "mirror": mirror}, fh)
+        crash_point("ledger.claim", BEFORE_RENAME)
         try:
             for _ in range(8):
                 try:
                     os.link(tmp, path)
+                    crash_point("ledger.claim", AFTER_RENAME)
                     return True
                 except FileExistsError:
                     if self.claim_info(block_id) is not None:
@@ -173,8 +182,10 @@ class BlockLedger:
                            f".tmp.b{block_id}.{uuid.uuid4().hex}")
         with open(tmp, "wb") as fh:
             fh.write(blob)
+        crash_point("ledger.commit", BEFORE_RENAME)
         try:
             os.link(tmp, path)
+            crash_point("ledger.commit", AFTER_RENAME)
             if fps is not None:
                 fptmp = f"{tmp}.fps"
                 with open(fptmp, "w") as fh:
@@ -206,11 +217,9 @@ class BlockLedger:
         concurrent losers never race one file, atomic so the
         coordinator's count never reads a torn marker."""
         path = os.path.join(self.dups_dir, f"b{block_id}.w{worker}.json")
-        tmp = f"{path}.tmp.{uuid.uuid4().hex}"
-        with open(tmp, "w") as fh:
-            json.dump({"block": block_id, "worker": worker,
-                       "rejected_at": time.time()}, fh)
-        os.replace(tmp, path)
+        publish_json({"block": block_id, "worker": worker,
+                      "rejected_at": time.time()}, path,
+                     site="ledger.dup")
 
     def load_state(self, block_id: int) -> bytes:
         with open(self.state_path(block_id), "rb") as fh:

@@ -36,6 +36,7 @@ import os
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Sequence, Tuple
 
+from avenir_tpu.core.atomic import publish_json
 from avenir_tpu.core.stream import split_byte_ranges
 
 #: default over-partitioning: blocks per worker. 4x keeps the steal/
@@ -251,16 +252,13 @@ def plan_shards(inputs: Sequence[str], procs: int,
 
 
 def write_json_atomic(obj: Dict, path: str) -> str:
-    """Atomically publish one JSON manifest (tmp+rename, the spool
-    discipline): a reader either sees no manifest or a complete one,
-    never a torn table. Shared by the plan manifest and the per-k
-    candidate manifests the sharded mining driver publishes under
-    ``<root>/candidates/``."""
-    tmp = f"{path}.tmp.{os.getpid()}"
-    with open(tmp, "w") as fh:
-        json.dump(obj, fh, indent=1)
-    os.replace(tmp, path)
-    return path
+    """Atomically publish one JSON manifest (unique sibling tmp +
+    rename, the core.atomic discipline): a reader either sees no
+    manifest or a complete one, never a torn table. Shared by the plan
+    manifest and the per-k candidate manifests the sharded mining
+    driver publishes under ``<root>/candidates/``. A registered commit
+    site — graftlint --proto kill-injects both sides of the rename."""
+    return publish_json(obj, path, site="plan.manifest", indent=1)
 
 
 def write_plan(plan: ShardPlan, path: str) -> str:

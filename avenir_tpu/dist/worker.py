@@ -53,6 +53,7 @@ from typing import Dict, List, Optional, Tuple
 import numpy as np
 
 from avenir_tpu import obs as _obs
+from avenir_tpu.core.atomic import publish_bytes, publish_json
 from avenir_tpu.dist.detect import (StragglerPolicy, mirror_after_s,
                                     mirror_after_wall_s)
 from avenir_tpu.dist.ledger import BlockLedger
@@ -233,9 +234,7 @@ class _Worker:
         ready = os.path.join(self.root, "ready")
         os.makedirs(ready, exist_ok=True)
         marker = os.path.join(ready, f"w{self.worker}")
-        with open(marker + ".tmp", "w") as fh:
-            fh.write(str(os.getpid()))
-        os.replace(marker + ".tmp", marker)
+        publish_bytes(str(os.getpid()).encode("utf-8"), marker)
         deadline = time.perf_counter() + timeout_s
         go = os.path.join(self.root, "go")
         while not os.path.exists(go):
@@ -257,10 +256,7 @@ class _Worker:
                 f.src.cache_evicted_bytes for f in replay))
         path = os.path.join(self.root, "stats", f"w{self.worker}.json")
         os.makedirs(os.path.dirname(path), exist_ok=True)
-        tmp = f"{path}.tmp"
-        with open(tmp, "w") as fh:
-            json.dump(self.stats, fh)
-        os.replace(tmp, path)
+        publish_json(self.stats, path)
 
     # ------------------------------------------------------- fold path
     def _fold_and_commit(self, blk: ShardBlock) -> None:
@@ -585,10 +581,7 @@ class _Worker:
         with open(src, "rb") as fh:
             fh.seek(blk.start)
             data = fh.read(blk.end - blk.start)
-        tmp = f"{path}.tmp"
-        with open(tmp, "wb") as out:
-            out.write(data)
-        os.replace(tmp, path)
+        publish_bytes(data, path)
         return path
 
     def _slice_source(self, blk: ShardBlock, mask: List[str]):

@@ -52,6 +52,7 @@ from typing import Iterator, List, Optional, Tuple
 import numpy as np
 
 from avenir_tpu import obs as _obs
+from avenir_tpu.core.atomic import publish_bytes, sweep_stale_tmps
 from avenir_tpu.core.incremental import (block_fingerprint, ends_at_newline,
                                          verified_prefix)
 
@@ -179,12 +180,12 @@ def _load_manifest(dirpath: str) -> Optional[dict]:
 
 
 def _write_manifest(dirpath: str, man: dict) -> None:
-    tmp = os.path.join(dirpath, MANIFEST + ".tmp")
-    with open(tmp, "w") as fh:
-        json.dump(man, fh)
-        fh.flush()
-        os.fsync(fh.fileno())
-    os.replace(tmp, os.path.join(dirpath, MANIFEST))
+    # the manifest rename IS the sidecar commit point: the fsync'd
+    # payload lands via unique sibling tmp + replace, so a reader sees
+    # the old manifest or the new one, never a torn table
+    publish_bytes(json.dumps(man).encode("utf-8"),
+                  os.path.join(dirpath, MANIFEST),
+                  site="sidecar.manifest", fsync=True)
 
 
 _verify_lock = threading.Lock()
@@ -671,6 +672,9 @@ class _Writer:
     def __init__(self, opts, kind, path, dirpath, man, block_bytes, kp,
                  fresh):
         os.makedirs(dirpath, exist_ok=True)
+        # startup GC: tmp files a hard-killed writer left behind (the
+        # age gate keeps a concurrent writer's live tmp safe)
+        sweep_stale_tmps(dirpath)
         self.dirpath = dirpath
         self.kind = kind
         self.kp = kp

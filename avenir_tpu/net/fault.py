@@ -60,6 +60,8 @@ import time
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Sequence
 
+from avenir_tpu.core.atomic import publish_json, sweep_stale_tmps
+
 
 @dataclass
 class FaultPolicy:
@@ -118,7 +120,12 @@ class RestartTracker:
     """Restart/quarantine policy for ONE host: record deaths, answer
     the backoff delay before the next respawn, and flip to quarantine
     when the host dies ``max_restarts`` times inside the window. Pure
-    bookkeeping — callers pass ``now`` so tests drive the clock."""
+    bookkeeping — callers pass ``now`` so tests drive the clock. The
+    clock is ``time.monotonic()``: backoff and the quarantine window
+    are in-process durations, and an NTP step of the wall clock must
+    never stretch or collapse them (the fleet passes its monotonic
+    tick time; only lease files persisted across processes carry wall
+    timestamps)."""
 
     def __init__(self, policy: FaultPolicy):
         self.policy = policy
@@ -195,17 +202,16 @@ class LeaseStore:
     def __init__(self, root: str):
         self.dir = os.path.join(root, "leases")
         os.makedirs(self.dir, exist_ok=True)
+        # startup GC: tmp files a hard-killed front left behind (the
+        # age gate keeps a concurrent writer's live tmp safe)
+        sweep_stale_tmps(self.dir)
 
     def path(self, name: str) -> str:
         return os.path.join(self.dir, name)
 
     def write(self, lease: Lease) -> str:
-        path = self.path(lease.name)
-        tmp = f"{path}.tmp"
-        with open(tmp, "w") as fh:
-            json.dump(lease.to_dict(), fh)
-        os.replace(tmp, path)
-        return path
+        return publish_json(lease.to_dict(), self.path(lease.name),
+                            site="lease.write")
 
     def renew(self, lease: Lease, now: float) -> None:
         """Re-stamp the claim time — the sweep for a HEALTHY host."""

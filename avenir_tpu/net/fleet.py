@@ -59,6 +59,7 @@ import threading
 import time
 from typing import Dict, List, Optional, Sequence, Tuple
 
+from avenir_tpu.core.atomic import publish_json
 from avenir_tpu.net import fault
 from avenir_tpu.net.fault import (FaultPolicy, Lease, LeaseStore,
                                   RestartTracker, Supervisor)
@@ -113,7 +114,14 @@ class _Outstanding:
     every spooled copy (original + requeues + mirrors); the first
     result to land on ANY copy's out path wins and releases all of
     them — re-execution is safe by the idempotency contract, so a late
-    duplicate is an identical write, never a conflict."""
+    duplicate is an identical write, never a conflict.
+
+    ``submitted_at`` and ``stranded_at`` are ``time.monotonic()``
+    stamps: they drive in-process age/patience arithmetic (the hedge's
+    pending-age clock, the stranded-patience bound), which an NTP step
+    of the wall clock must never stretch or collapse. Only the lease's
+    ``claimed_at`` — persisted to disk and compared against file
+    mtimes across processes — stays wall-clock."""
 
     __slots__ = ("copies", "obj", "submitted_at", "lease", "mirrored",
                  "stranded_at")
@@ -202,7 +210,12 @@ class Fleet:
                           for _ in range(hosts)]
         self._host_state = [fault.SERVING] * hosts
         self._restart_at: List[Optional[float]] = [None] * hosts
+        #: wall-clock spawn stamp — compared against lease claimed_at
+        #: (a persisted wall timestamp) for the incarnation check
         self._spawned_at = [0.0] * hosts
+        #: monotonic spawn stamp — drives boot-grace and heartbeat-age
+        #: fallbacks (in-process durations; immune to NTP steps)
+        self._spawned_mono = [0.0] * hosts
         self._supervisor: Optional[Supervisor] = None
         # a heartbeat bound tighter than the metrics refresh would mark
         # every host stalled between writes
@@ -265,6 +278,7 @@ class Fleet:
         with self._lock:
             self._procs[i] = proc
             self._spawned_at[i] = time.time()
+            self._spawned_mono[i] = time.monotonic()
 
     def start(self, timeout: float = 60.0) -> "Fleet":
         for i in range(len(self.host_dirs)):
@@ -457,10 +471,7 @@ class Fleet:
         host_dir = self.host_dirs[placement.host]
         out_name = nonce_result_name(name, obj.get("nonce"))
         out_path = os.path.join(host_dir, "out", out_name)
-        tmp = os.path.join(host_dir, f".{name}.tmp")
-        with open(tmp, "w") as fh:
-            json.dump(obj, fh)
-        os.replace(tmp, os.path.join(host_dir, "in", name))
+        publish_json(obj, os.path.join(host_dir, "in", name))
         return _Copy(placement, name, out_path)
 
     def _spool_to(self, placement: Placement, obj: Dict) -> str:
@@ -474,7 +485,8 @@ class Fleet:
         self._leases.write(lease)
         copy = self._write_copy(placement, name, obj)
         with self._lock:
-            self._outstanding[name] = _Outstanding(copy, obj, now, lease)
+            self._outstanding[name] = _Outstanding(
+                copy, obj, time.monotonic(), lease)
         return name
 
     # ------------------------------------------------------------ collecting
@@ -506,8 +518,14 @@ class Fleet:
             for copy in copies:
                 if not os.path.exists(copy.out_path):
                     continue
-                with open(copy.out_path) as fh:
-                    row = json.load(fh)
+                # the publish is atomic, but this reader still races
+                # deletion (another sweeper collecting the same name):
+                # a vanished/torn row is absent, never a crash
+                try:
+                    with open(copy.out_path) as fh:
+                        row = json.load(fh)
+                except (OSError, ValueError):
+                    continue
                 break                     # first-write-wins
             if row is None:
                 continue
@@ -556,33 +574,43 @@ class Fleet:
     def _fault_tick(self) -> None:
         """One supervisor pass (fault.Supervisor drives this every
         ``poll_interval_s``): sweep finished results, watch the host
-        processes, sweep/renew leases, hedge the hot tail."""
-        now = time.time()
+        processes, sweep/renew leases, hedge the hot tail. Two clocks:
+        ``wall`` stamps/compares the persisted lease records (cross-
+        process file timestamps), ``mono`` drives every in-process
+        duration (backoff, boot grace, patience, hedge age)."""
+        wall = time.time()
+        mono = time.monotonic()
         self._sweep()
-        self._supervise_hosts(now)
-        self._sweep_leases(now)
+        self._supervise_hosts(wall, mono)
+        self._sweep_leases(wall, mono)
         if self.fault.hedge:
-            self._hedge(now)
+            self._hedge(mono)
 
     def _set_host_state(self, i: int, state: str) -> None:
         with self._lock:
             self._host_state[i] = state
         self.router.set_host_state(i, state)
 
-    def _supervise_hosts(self, now: float) -> None:
+    def _supervise_hosts(self, now: float,
+                         mono: Optional[float] = None) -> None:
+        """Host supervision for one tick. ``now`` is wall-clock (only
+        the heartbeat mtime comparison needs it); ``mono`` drives
+        death/backoff/boot-grace arithmetic — restart scheduling must
+        not stretch or collapse under an NTP step."""
+        mono = time.monotonic() if mono is None else mono
         for i in range(len(self.host_dirs)):
             with self._lock:
                 state = self._host_state[i]
                 proc = self._procs[i]
                 restart_at = self._restart_at[i]
-                spawned_at = self._spawned_at[i]
+                spawned_mono = self._spawned_mono[i]
             if state in (fault.QUARANTINED, fault.STOPPED):
                 continue
             rc = proc.poll() if proc is not None else None
             if proc is not None and rc is not None:
                 # death is certain (exit code in hand): requeue its
                 # leases NOW — waiting out the TTL buys nothing
-                verdict = self._trackers[i].record_death(now)
+                verdict = self._trackers[i].record_death(mono)
                 with self._lock:
                     self._procs[i] = None
                 if verdict == fault.QUARANTINED:
@@ -593,11 +621,11 @@ class Fleet:
                     self._set_host_state(i, fault.RESTARTING)
                     with self._lock:
                         self._restart_at[i] = \
-                            now + self._trackers[i].backoff_s()
+                            mono + self._trackers[i].backoff_s()
                 continue
             if state == fault.RESTARTING:
                 if proc is None and restart_at is not None \
-                        and now >= restart_at:
+                        and mono >= restart_at:
                     self._spawn_host(i)
                     with self._lock:
                         self._fault_stats["restarts"] += 1
@@ -618,10 +646,10 @@ class Fleet:
             # process that stopped answering is wedged or stopped
             # (SIGSTOP, hard IO stall) and must not take new
             # placements
-            booting = now - spawned_at <= self._hb_timeout
+            booting = mono - spawned_mono <= self._hb_timeout
             addr = self.listen_addresses.get(i)
             if addr is not None:
-                hb_live = self._probe_host(i, addr, now)
+                hb_live = self._probe_host(i, addr, mono)
                 if state == fault.SERVING and not hb_live \
                         and not booting:
                     self._set_host_state(i, fault.STALLED)
@@ -631,26 +659,27 @@ class Fleet:
             age = fault.heartbeat_age_s(
                 os.path.join(self.host_dirs[i], "metrics.json"), now)
             if age is None:
-                age = now - spawned_at
+                age = mono - spawned_mono
             if state == fault.SERVING and age > self._hb_timeout \
                     and not booting:
                 self._set_host_state(i, fault.STALLED)
             elif state == fault.STALLED and age <= self._hb_timeout:
                 self._set_host_state(i, fault.SERVING)
 
-    def _probe_host(self, i: int, addr: str, now: float) -> bool:
+    def _probe_host(self, i: int, addr: str, mono: float) -> bool:
         """Memoized /healthz liveness of a listener-fronted host:
         re-probes at most every hb_timeout/2 with a timeout bounded
         well under the heartbeat budget, so N wedged listeners can
         never stall the supervisor tick past the lease-renewal
-        window."""
+        window. The memo ages on the monotonic clock — a wall step
+        must not force (or starve) a re-probe."""
         hit = self._probe_memo.get(i)
-        if hit is not None and now - hit[0] < self._hb_timeout / 2.0:
+        if hit is not None and mono - hit[0] < self._hb_timeout / 2.0:
             return hit[1]
         timeout = min(2.0, max(self._hb_timeout / 4.0, 0.25))
         status = fault.probe_healthz(addr, timeout=timeout)
         hb_live = status in ("serving", "draining")
-        self._probe_memo[i] = (now, hb_live)
+        self._probe_memo[i] = (mono, hb_live)
         return hb_live
 
     @staticmethod
@@ -662,7 +691,8 @@ class Fleet:
                 return copy
         return entry.copies[-1]
 
-    def _sweep_leases(self, now: float) -> None:
+    def _sweep_leases(self, now: float,
+                      mono: Optional[float] = None) -> None:
         """Renew the leases of requests sitting on healthy hosts;
         requeue the ones whose host died (immediately) or went
         stale/stalled past the lease TTL. A lease predating its host's
@@ -670,7 +700,13 @@ class Fleet:
         healthy: a claim taken by the dead process sits in its old
         ``work/`` dir, which a restarted host never re-adopts — those
         requeue too (or re-spool to the restarted host when no other
-        host can take them)."""
+        host can take them).
+
+        ``now`` is wall-clock — lease claimed_at stamps and the
+        incarnation comparison are persisted wall timestamps; ``mono``
+        feeds the stranded-patience clock and the hedge's pending-age
+        restart (in-process durations)."""
+        mono = time.monotonic() if mono is None else mono
         with self._lock:
             entries = list(self._outstanding.items())
         for name, entry in entries:
@@ -690,8 +726,8 @@ class Fleet:
                                        "in", copy.name)
                 if os.path.exists(in_path):
                     self._leases.renew(lease, now)
-                elif not self._requeue(name, entry, now):
-                    self._respool(name, entry, now)
+                elif not self._requeue(name, entry, now, mono):
+                    self._respool(name, entry, now, mono)
                 continue
             if healthy:
                 if now - lease.claimed_at > lease.ttl_s / 2.0:
@@ -699,15 +735,15 @@ class Fleet:
                 continue
             if dead or state in (fault.RESTARTING, fault.QUARANTINED) \
                     or lease.expired(now):
-                if not self._requeue(name, entry, now):
+                if not self._requeue(name, entry, now, mono):
                     # the requeue found no excluded-compliant host: a
                     # STRANDED request (trail covers every host) must
                     # respool or abandon in-band, never hang until the
                     # caller's collect() timeout
-                    self._rescue_stranded(name, entry, now)
+                    self._rescue_stranded(name, entry, now, mono)
 
-    def _requeue(self, name: str, entry: _Outstanding,
-                 now: float) -> bool:
+    def _requeue(self, name: str, entry: _Outstanding, now: float,
+                 mono: Optional[float] = None) -> bool:
         """Move one stranded request to a different healthy host,
         excluding every host it already failed on. Capped at
         ``max_requeues`` attempts — a request that kills every host it
@@ -764,7 +800,8 @@ class Fleet:
         lease.hosts.append(placement.host)
         # the hedge's pending-age clock restarts with the new host: an
         # inherited age would make a fresh requeue target look hot
-        entry.submitted_at = now
+        entry.submitted_at = \
+            time.monotonic() if mono is None else mono
         self._leases.write(lease)
         return True
 
@@ -790,7 +827,8 @@ class Fleet:
         self._leases.remove(name)
 
     def _rescue_stranded(self, name: str, entry: _Outstanding,
-                         now: float) -> None:
+                         now: float,
+                         mono: Optional[float] = None) -> None:
         """A request the requeue could not move this tick. Distinguish
         'no headroom yet' (an untried SERVING host may still take it —
         wait, capacity frees when results land) from STRANDED: the
@@ -805,7 +843,10 @@ class Fleet:
         wedged host must not hold the request to the collect()
         timeout — STALLED never respawns, only an exit code does).
         ``attempts`` only grows on moves, so the cap alone can never
-        fire for a request nobody can move."""
+        fire for a request nobody can move. The patience clock runs on
+        ``mono`` — a wall step must neither abandon a request early
+        nor hold it past the bound."""
+        mono = time.monotonic() if mono is None else mono
         lease = entry.lease
         with self._lock:
             states = list(self._host_state)
@@ -821,13 +862,14 @@ class Fleet:
                          and procs[h] is not None]
         if healthy_trail:
             entry.stranded_at = None
-            self._respool(name, entry, now, host=healthy_trail[0])
+            self._respool(name, entry, now, mono,
+                          host=healthy_trail[0])
             return
         if any(s in (fault.RESTARTING, fault.STALLED) for s in states):
             # a host may yet recover: wait, but only within patience
             if entry.stranded_at is None:
-                entry.stranded_at = now
-            if now - entry.stranded_at \
+                entry.stranded_at = mono
+            if mono - entry.stranded_at \
                     <= self.fault.stranded_patience_s:
                 return
         self._abandon(
@@ -836,6 +878,7 @@ class Fleet:
             f"every host and none is healthy (states {states})")
 
     def _respool(self, name: str, entry: _Outstanding, now: float,
+                 mono: Optional[float] = None,
                  host: Optional[int] = None) -> None:
         """Re-spool a stranded request into a trail host's OWN in/ —
         the fallback when the requeue exclusion leaves no other host.
@@ -868,7 +911,8 @@ class Fleet:
         lease.host = host
         lease.claimed_at = now
         lease.attempts += 1
-        entry.submitted_at = now
+        entry.submitted_at = \
+            time.monotonic() if mono is None else mono
         self._leases.write(lease)
 
     def _rolled_p99(self) -> Dict[int, Tuple[float, int]]:
@@ -890,12 +934,13 @@ class Fleet:
                 out[i] = (0.0, 0)
         return out
 
-    def _hedge(self, now: float) -> None:
+    def _hedge(self, mono: float) -> None:
         """Hedged tail dispatch: when one host's queue-wait tail runs
         past ``hedge_multiple``x the fleet median, mirror its queued
         requests onto the least-loaded compatible host and let the
         first result win (module docstring; fault.hot_hosts is the
-        decision)."""
+        decision). The pending-age clock is monotonic: a wall step
+        must not make every queued request look instantly hot."""
         with self._lock:
             healthy = [i for i, s in enumerate(self._host_state)
                        if s == fault.SERVING]
@@ -904,7 +949,7 @@ class Fleet:
         for _name, entry in entries:
             if entry.mirrored:
                 continue
-            age_ms = (now - entry.submitted_at) * 1000.0
+            age_ms = (mono - entry.submitted_at) * 1000.0
             host = entry.lease.host
             pending_age[host] = max(pending_age.get(host, 0.0), age_ms)
         rolled = self._rolled_p99()
@@ -986,11 +1031,7 @@ class Fleet:
 
     def write_metrics(self, path: Optional[str] = None) -> str:
         path = path or os.path.join(self.root, "metrics.json")
-        tmp = f"{path}.{os.getpid()}.tmp"
-        with open(tmp, "w") as fh:
-            json.dump(self.merged_metrics(), fh)
-        os.replace(tmp, path)
-        return path
+        return publish_json(self.merged_metrics(), path)
 
     # ------------------------------------------------------------- stopping
     def stop(self, timeout: float = 120.0) -> List[Optional[int]]:
@@ -1221,7 +1262,4 @@ def fleet_main(argv) -> int:
 
 
 def _write_row(out_dir: str, name: str, row: Dict) -> None:
-    tmp = os.path.join(out_dir, name + ".tmp")
-    with open(tmp, "w") as fh:
-        json.dump(row, fh, indent=1)
-    os.replace(tmp, os.path.join(out_dir, name))
+    publish_json(row, os.path.join(out_dir, name), indent=1)

@@ -70,6 +70,7 @@ from dataclasses import dataclass, field
 from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 from avenir_tpu import obs as _obs
+from avenir_tpu.core.atomic import publish_json
 from avenir_tpu.obs.histogram import LatencyHistogram
 
 #: default admission ceiling: the repo's standing 3GB RSS budget
@@ -893,12 +894,7 @@ class JobServer:
         path = path or self.metrics_path
         if not path:
             return None
-        snap = self.metrics_snapshot()
-        tmp = f"{path}.{os.getpid()}.tmp"
-        with open(tmp, "w") as fh:
-            json.dump(snap, fh)
-        os.replace(tmp, path)
-        return path
+        return publish_json(self.metrics_snapshot(), path)
 
     def _maybe_write_metrics(self) -> None:
         """Scheduler-loop tick: refresh the snapshot at most every

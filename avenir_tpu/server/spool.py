@@ -44,6 +44,8 @@ import time
 import uuid
 from typing import Callable, Dict, List, Optional, Tuple
 
+from avenir_tpu.core.atomic import (publish_bytes, publish_json,
+                                    sweep_stale_tmps)
 from avenir_tpu.server.jobserver import (DEFAULT_BUDGET_BYTES,
                                          DEFAULT_WARM_BUDGET_BYTES,
                                          JobRequest, JobServer, Ticket)
@@ -173,11 +175,9 @@ def dead_letter(spool: str, name: str, work_path: str,
     except OSError:
         dead_path = work_path          # already gone: report in place
     reason_path = os.path.join(dead_dir, f"{name}.reason")
-    tmp = f"{reason_path}.tmp"
     try:
-        with open(tmp, "w") as fh:
-            fh.write(reason + "\n")
-        os.replace(tmp, reason_path)
+        publish_bytes((reason + "\n").encode("utf-8"), reason_path,
+                      site="spool.dead_letter")
     except OSError:
         pass
     return dead_path
@@ -216,6 +216,22 @@ def result_name(name: str, ticket: Ticket) -> str:
                                            None))
 
 
+def publish_result(out_dir: str, out_name: str, row: Dict) -> str:
+    """Atomically publish one result row at ``<out>/<out_name>`` — THE
+    spool result commit (a polling client sees no file or a complete
+    one, never a torn row). A registered commit site: graftlint
+    --proto kill-injects both sides of the rename."""
+    return publish_json(row, os.path.join(out_dir, out_name),
+                        site="spool.result", indent=1)
+
+
+def write_port_file(port_file: str, port: int) -> str:
+    """Atomically publish the bound port for scripts that asked for
+    port 0 — a reader either sees no port file or a complete one."""
+    return publish_bytes(str(port).encode("utf-8"), port_file,
+                         site="spool.port")
+
+
 def serve_spool(server: JobServer, spool: str, once: bool = False,
                 should_stop=None) -> int:
     """Filesystem-spool transport (module docstring). Runs in the
@@ -229,6 +245,10 @@ def serve_spool(server: JobServer, spool: str, once: bool = False,
     returns — what SIGTERM/SIGINT mean for a ``serve --spool``
     session."""
     in_dir, work_dir, out_dir = spool_dirs(spool)
+    # startup GC: tmp files a hard-killed session left behind (the age
+    # gate keeps a concurrent writer's live tmp safe)
+    for d in (in_dir, work_dir, out_dir):
+        sweep_stale_tmps(d)
     pending: List[Tuple[str, str, Ticket]] = []
     failures = 0
     while True:
@@ -263,10 +283,7 @@ def serve_spool(server: JobServer, spool: str, once: bool = False,
             row = result_to_json(ticket)
             failures += 0 if row["ok"] else 1
             out_name = result_name(name, ticket)
-            tmp = os.path.join(out_dir, out_name + ".tmp")
-            with open(tmp, "w") as fh:
-                json.dump(row, fh, indent=1)
-            os.replace(tmp, os.path.join(out_dir, out_name))
+            publish_result(out_dir, out_name, row)
             try:
                 os.remove(work_path)
             except OSError:
@@ -332,10 +349,7 @@ def serve_listen(server: JobServer, listen: str, stop: threading.Event,
                           "address": listener.address}),
               file=sys.stderr, flush=True)
         if port_file:
-            tmp = f"{port_file}.tmp"
-            with open(tmp, "w") as fh:
-                fh.write(str(listener.port))
-            os.replace(tmp, port_file)
+            write_port_file(port_file, listener.port)
         while not stop.is_set():
             stop.wait(_SPOOL_POLL_SECS)
         listener.begin_drain()

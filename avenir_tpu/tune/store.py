@@ -26,9 +26,9 @@ from __future__ import annotations
 import hashlib
 import json
 import os
-import uuid
 from typing import Dict, List, Optional, Sequence
 
+from avenir_tpu.core.atomic import publish_json, sweep_stale_tmps
 from avenir_tpu.tune.knobs import validate_knobs
 
 #: newest run-signal records a profile retains
@@ -75,6 +75,10 @@ class ProfileStore:
 
     def __init__(self, root: str):
         self.root = root
+        # startup GC: tmp files a hard-killed writer left behind (the
+        # age gate keeps a concurrent writer's live tmp safe; a root
+        # that does not exist yet is a no-op)
+        sweep_stale_tmps(root)
 
     def path(self, job: str, digest: str) -> str:
         return os.path.join(self.root, f"{job}_{digest}.json")
@@ -100,11 +104,7 @@ class ProfileStore:
     def _save(self, prof: Dict) -> str:
         os.makedirs(self.root, exist_ok=True)
         path = self.path(prof["job"], prof["corpus_digest"])
-        tmp = f"{path}.{uuid.uuid4().hex[:8]}.tmp"
-        with open(tmp, "w") as fh:
-            json.dump(prof, fh, indent=1)
-        os.replace(tmp, path)
-        return path
+        return publish_json(prof, path, site="profile.save", indent=1)
 
     def _load_or_fresh(self, job: str, digest: str) -> Dict:
         return self.load(job, digest) or _fresh(job, digest)

@@ -28,15 +28,18 @@ import tempfile
 def graftlint_tripwire() -> dict:
     """Run the graftlint CLI (--json) over the package, the --ir
     manifest audit, the --flow concurrency/invariance audit, the
-    --mem footprint audit AND the --merge shard-merge/resume audit,
-    failing the bench on any non-allowlisted finding, stale baseline
-    entry, trace error, a distributed family whose collective payload
-    drifted off the scaling.py analytic model, a streamed fold kernel
-    whose output bytes moved with the chunk layout, a streamed job
-    whose measured peak RSS left the memory model's tolerance band, or
-    a fold state whose shard merge / checkpoint resume drifted a byte —
-    hazard/traffic/determinism/footprint/merge-algebra regressions
-    surface here every round, not at the next 100M-row run. The
+    --mem footprint audit, the --merge shard-merge/resume audit AND
+    the --proto commit-point crash audit, failing the bench on any
+    non-allowlisted finding, stale baseline entry, trace error, a
+    distributed family whose collective payload drifted off the
+    scaling.py analytic model, a streamed fold kernel whose output
+    bytes moved with the chunk layout, a streamed job whose measured
+    peak RSS left the memory model's tolerance band, a fold state
+    whose shard merge / checkpoint resume drifted a byte, or a
+    shared-filesystem commit site whose kill-injected recovery was
+    not byte-identical — hazard/traffic/determinism/footprint/
+    merge-algebra/protocol regressions surface here every round, not
+    at the next 100M-row run. The
     round's memory manifest (the job server's admission oracle) is
     re-derived and written next to the STREAM_SCALE_*.json records."""
     import os
@@ -121,6 +124,19 @@ def graftlint_tripwire() -> dict:
         raise RuntimeError(
             f"incremental-scan audit regression: append/resume output "
             f"drifted for {unincr}")
+    # protocol leg (graftlint-proto): every registered shared-
+    # filesystem commit site, hard-killed at before-rename and
+    # after-rename, must recover byte-identical with no stranded tmp —
+    # the atomic-publish discipline the fleet/ledger/spool/checkpoint
+    # protocols all stand on, >= 10 sites every round
+    proto_rep = run(["--proto"], "--proto")
+    pa = proto_rep["proto_audit"]
+    uncommitted = [r["site"] for r in pa
+                   if not r["commit_point_validated"]]
+    if uncommitted or len(pa) < 10:
+        raise RuntimeError(
+            f"commit-point audit regression: {len(pa)} commit sites "
+            f"audited, failed={uncommitted}")
     # span-coverage leg (avenir-trace): every registered stream entry,
     # run under a captured recorder, must emit the mandatory span set
     # (read/parse/fold/finish) — an instrumentation point lost in a
@@ -158,6 +174,9 @@ def graftlint_tripwire() -> dict:
             "merge_kernels_validated": len(ma),
             "incremental_kernels_validated": len(ma) - len(unincr),
             "shard_dedup_validated": len(ma) - len(undeduped),
+            "proto_findings": 0,
+            "proto_allowlisted": proto_rep["suppressed"],
+            "commit_points_validated": len(pa),
             "span_coverage_validated": len(cov),
             "memory_manifest": "MEMORY_MANIFEST.json"}
 

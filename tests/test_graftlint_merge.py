@@ -12,7 +12,7 @@ Three jobs, mirroring the other analyzer test modules one layer over:
    merge-fold-algebra finding, run failures surface as MergeAuditError
    (CLI exit 2), merge findings round-trip through the shared baseline,
    the --merge CLI speaks the same JSON schema as the other modes, and
-   --all runs the five tiers with one worst-of exit code.
+   --all runs the six tiers with one worst-of exit code.
 """
 
 import json
@@ -418,7 +418,8 @@ def test_cli_all_worst_of_exit_and_combined_schema(tmp_path):
     assert proc.returncode == 1, proc.stdout + proc.stderr
     rep = json.loads(proc.stdout)
     assert set(rep) == {"modes", "clean"} and rep["clean"] is False
-    assert set(rep["modes"]) == {"ast", "ir", "flow", "mem", "merge"}
+    assert set(rep["modes"]) == {"ast", "ir", "flow", "mem", "merge",
+                                 "proto"}
     assert rep["modes"]["ir"] == {"skipped": True}
     assert rep["modes"]["merge"]["counts"] == {"merge-missing-op": 1}
 
@@ -432,5 +433,5 @@ def test_cli_all_worst_of_exit_and_combined_schema(tmp_path):
     # usage errors = 2: --all combined with a single-tier flag
     assert _cli(["--all", "--merge"]).returncode == 2
     assert _cli(["--all", "--ir"]).returncode == 2
-    # unknown rule still refused with --all (union of all five catalogs)
+    # unknown rule still refused with --all (union of all six catalogs)
     assert _cli(["--all", "--rules", "nope"]).returncode == 2

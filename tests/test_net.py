@@ -269,12 +269,14 @@ def test_fleet_quarantine_and_reinstate(tmp_path, monkeypatch):
         with fleet._lock:
             fleet._procs[i] = FakeProc(rc=137)   # dies again instantly
             fleet._spawned_at[i] = time.time()
+            fleet._spawned_mono[i] = time.monotonic()
 
     monkeypatch.setattr(fleet, "_spawn_host", fake_spawn_dying)
     with fleet._lock:
         fleet._procs[0] = FakeProc(rc=137)       # dead on arrival
         fleet._procs[1] = FakeProc()             # healthy
         fleet._spawned_at = [time.time()] * 2
+        fleet._spawned_mono = [time.monotonic()] * 2
     fleet._fault_tick()              # death 1 -> restarting
     assert fleet.host_state(0) == "restarting"
     fleet._fault_tick()              # backoff elapsed -> respawn
@@ -292,6 +294,7 @@ def test_fleet_quarantine_and_reinstate(tmp_path, monkeypatch):
         with fleet._lock:
             fleet._procs[i] = FakeProc()
             fleet._spawned_at[i] = time.time()
+            fleet._spawned_mono[i] = time.monotonic()
 
     monkeypatch.setattr(fleet, "_spawn_host", fake_spawn_ok)
     fleet.reinstate(0)
@@ -1119,6 +1122,7 @@ def _stranded_two_host_fleet(tmp_path, alive):
         fleet._procs = [FakeProc() if alive[h] else None
                         for h in range(2)]
         fleet._spawned_at = [time.time() - 1.0] * 2
+        fleet._spawned_mono = [time.monotonic() - 1.0] * 2
     obj = _req_obj(csv, str(tmp_path / "stranded.txt"))
     req, priced, cost = fleet.price(obj)
     name = fleet._spool_to(
@@ -1236,15 +1240,90 @@ def test_stranded_request_patience_bounds_the_wait(tmp_path):
     with fleet._lock:
         fleet._host_state = [fault.STALLED, fault.RESTARTING]
     t0 = time.time()
-    fleet._sweep_leases(t0)              # starts the patience clock
+    m0 = time.monotonic()
+    fleet._sweep_leases(t0, mono=m0)     # starts the patience clock
     assert fleet.fault_snapshot()["stats"]["abandoned"] == 0
     assert entry.stranded_at is not None
+    # patience is measured on the monotonic clock (a wall step must
+    # never stretch or collapse it): advance mono past the bound
     fleet._sweep_leases(
-        t0 + fleet.fault.stranded_patience_s + 1.0)
+        t0 + fleet.fault.stranded_patience_s + 1.0,
+        mono=m0 + fleet.fault.stranded_patience_s + 1.0)
     snap = fleet.fault_snapshot()
     assert snap["stats"]["abandoned"] == 1
     assert snap["leases_outstanding"] == 0
     assert fleet._collected[name]["ok"] is False
+
+
+def test_wall_clock_step_never_collapses_stranded_patience(tmp_path):
+    """Two-clock discipline regression (graftlint --proto): stranded
+    patience runs on the MONOTONIC clock, so an injected wall-clock
+    step (NTP slam, +10000 s) must not abandon a stranded request
+    early — only the monotonic clock crossing the bound may."""
+    from avenir_tpu.net import fault
+
+    fleet, name, entry = _stranded_two_host_fleet(
+        tmp_path, alive=[False, False])
+    with fleet._lock:
+        fleet._host_state = [fault.STALLED, fault.RESTARTING]
+    t0 = time.time()
+    m0 = time.monotonic()
+    fleet._sweep_leases(t0, mono=m0)     # starts the patience clock
+    assert entry.stranded_at is not None
+    # the step: wall leaps four hours, monotonic advances one second
+    fleet._sweep_leases(t0 + 10000.0, mono=m0 + 1.0)
+    assert fleet.fault_snapshot()["stats"]["abandoned"] == 0
+    assert fleet.fault_snapshot()["leases_outstanding"] == 1
+    # real elapsed time (monotonic) past the bound is what abandons
+    fleet._sweep_leases(
+        t0 + 10000.0,
+        mono=m0 + fleet.fault.stranded_patience_s + 1.0)
+    assert fleet.fault_snapshot()["stats"]["abandoned"] == 1
+
+
+def test_wall_clock_step_never_fires_restart_backoff_early(
+        tmp_path, monkeypatch):
+    """Same discipline, the supervisor's restart backoff: a wall-clock
+    step must neither fire the respawn early nor push it out — the
+    backoff window is monotonic elapsed time."""
+
+    class FakeProc:
+        pid = 4242
+
+        def __init__(self, rc=None):
+            self.rc = rc
+
+        def poll(self):
+            return self.rc
+
+    policy = FaultPolicy(poll_interval_s=0.05, max_restarts=3,
+                         restart_backoff_base_s=5.0, hedge=False)
+    fleet = Fleet(str(tmp_path / "fleet"), hosts=1, fault_policy=policy)
+    spawned = []
+
+    def fake_spawn(i):
+        spawned.append(i)
+        with fleet._lock:
+            fleet._procs[i] = FakeProc()
+            fleet._spawned_at[i] = time.time()
+            fleet._spawned_mono[i] = time.monotonic()
+
+    monkeypatch.setattr(fleet, "_spawn_host", fake_spawn)
+    t0 = time.time()
+    m0 = time.monotonic()
+    with fleet._lock:
+        fleet._procs[0] = FakeProc(rc=137)           # dead on arrival
+        fleet._spawned_at = [t0]
+        fleet._spawned_mono = [m0]
+    fleet._supervise_hosts(t0, mono=m0)              # death -> backoff
+    assert fleet.host_state(0) == "restarting" and spawned == []
+    # wall leaps past any backoff; monotonic has barely moved: no fire
+    fleet._supervise_hosts(t0 + 10000.0, mono=m0 + 1.0)
+    assert spawned == []
+    # monotonic elapses the 5 s backoff: the respawn fires now
+    fleet._supervise_hosts(t0 + 10000.0, mono=m0 + 6.0)
+    assert spawned == [0]
+    assert fleet.fault_snapshot()["stats"]["restarts"] == 1
 
 
 def test_probe_healthz_drives_listener_host_heartbeat(tmp_path):
@@ -1293,28 +1372,30 @@ def test_probe_healthz_drives_listener_host_heartbeat(tmp_path):
             fleet._procs[0] = FakeProc()
             # well past the boot grace: the probe is the heartbeat now
             fleet._spawned_at[0] = time.time() - 60.0
-        # each check advances `now` past the probe memo window (the
-        # supervisor re-probes at most every hb_timeout/2, so wedged
-        # listeners cannot stall every tick)
+            fleet._spawned_mono[0] = time.monotonic() - 60.0
+        # each check advances the monotonic clock past the probe memo
+        # window (the supervisor re-probes at most every hb_timeout/2,
+        # so wedged listeners cannot stall every tick)
         now = time.time()
+        m0 = time.monotonic()
         step = fleet._hb_timeout
-        fleet._supervise_hosts(now)
+        fleet._supervise_hosts(now, mono=m0)
         assert fleet.host_state(0) == "serving"
         # the host's own listener reports quarantined (its overlay):
         # the front marks it stalled — no placements land on it
         status["value"] = "quarantined"
-        fleet._supervise_hosts(now + step)
+        fleet._supervise_hosts(now + step, mono=m0 + step)
         assert fleet.host_state(0) == "stalled"
         assert fleet.router.snapshot()["hosts"][0]["state"] == "stalled"
         # recovery: a serving probe reinstates placement
         status["value"] = "serving"
-        fleet._supervise_hosts(now + 2 * step)
+        fleet._supervise_hosts(now + 2 * step, mono=m0 + 2 * step)
         assert fleet.host_state(0) == "serving"
         # a dead listener (probe refused) is stalled too — the
         # exit-code check stays the authority on actual death
         httpd.shutdown()
         httpd.server_close()
-        fleet._supervise_hosts(now + 3 * step)
+        fleet._supervise_hosts(now + 3 * step, mono=m0 + 3 * step)
         assert fleet.host_state(0) == "stalled"
     finally:
         try:

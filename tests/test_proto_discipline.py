@@ -1,0 +1,168 @@
+"""core.atomic: the publish/sweep/crash-hook primitives under the
+protocol-discipline contract (docs/DESIGN.md "Publish is an atomic
+commit"), plus the writer-startup GC the long-lived stores run.
+
+The graftlint --proto crash auditor proves the END-TO-END property
+(kill-injected recovery byte-identity per commit site); this module
+pins the primitives it stands on: unique sibling tmps, tmp cleanup on
+every failure path, the AVENIR_PROTO_CRASH hook's exact exit, and a
+sweeper that collects stale stranded tmps without ever racing a LIVE
+writer's in-flight stage file.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+import pytest
+
+from avenir_tpu.core.atomic import (CRASH_ENV, CRASH_EXIT,
+                                    STALE_TMP_AGE_S, crash_point,
+                                    is_tmp_name, publish_bytes,
+                                    publish_json, sweep_stale_tmps,
+                                    unique_tmp)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ------------------------------------------------------- unique_tmp shape
+def test_unique_tmp_is_a_dot_prefixed_sibling():
+    tmp = unique_tmp("/data/shared/plan.json")
+    head, base = os.path.split(tmp)
+    assert head == "/data/shared"        # SIBLING: same fs as target
+    assert base.startswith(".plan.json.")
+    assert is_tmp_name(base)
+    # per-writer unique: two stages of the same target never collide
+    assert unique_tmp("/data/shared/plan.json") != tmp
+
+
+def test_is_tmp_name_matches_every_stage_convention():
+    assert is_tmp_name(".plan.json.deadbeef.tmp")   # unique_tmp
+    assert is_tmp_name("segment.bin.tmp")           # plain suffix
+    assert is_tmp_name(".tmp.b3.0a1b2c")            # ledger stage
+    assert not is_tmp_name("plan.json")
+    assert not is_tmp_name("rows.csv")
+    assert not is_tmp_name("tmpdir_notes.txt")
+
+
+# ------------------------------------------------------- publish_* paths
+def test_publish_bytes_lands_content_with_no_leftover_stage(tmp_path):
+    path = str(tmp_path / "out.bin")
+    assert publish_bytes(b"payload", path) == path
+    assert open(path, "rb").read() == b"payload"
+    assert os.listdir(tmp_path) == ["out.bin"]      # stage cleaned
+
+
+def test_publish_json_round_trips(tmp_path):
+    path = str(tmp_path / "row.json")
+    publish_json({"ok": True, "n": 3}, path)
+    assert json.load(open(path)) == {"ok": True, "n": 3}
+
+
+def test_publish_bytes_cleans_the_tmp_when_the_commit_raises(
+        tmp_path, monkeypatch):
+    path = str(tmp_path / "out.bin")
+
+    def exploding_replace(src, dst):
+        raise OSError("synthetic EXDEV")
+
+    monkeypatch.setattr(os, "replace", exploding_replace)
+    with pytest.raises(OSError, match="EXDEV"):
+        publish_bytes(b"payload", path)
+    # the failed stage is removed on the way out: nothing strands
+    assert os.listdir(tmp_path) == []
+    assert not os.path.exists(path)
+
+
+# -------------------------------------------------------- the crash hook
+def test_crash_point_is_inert_without_the_env_hook(monkeypatch):
+    monkeypatch.delenv(CRASH_ENV, raising=False)
+    crash_point("any.site", "before-rename")        # must not exit
+    monkeypatch.setenv(CRASH_ENV, "other.site:before-rename")
+    crash_point("any.site", "before-rename")        # wrong site: inert
+    monkeypatch.setenv(CRASH_ENV, "any.site:after-rename")
+    crash_point("any.site", "before-rename")        # wrong stage: inert
+
+
+def test_crash_point_hard_kills_with_the_audit_exit_code():
+    env = dict(os.environ)
+    env[CRASH_ENV] = "kill.me:before-rename"
+    env["PYTHONPATH"] = os.pathsep.join(
+        p for p in (REPO, env.get("PYTHONPATH")) if p)
+    proc = subprocess.run(
+        [sys.executable, "-c",
+         "from avenir_tpu.core.atomic import crash_point\n"
+         "crash_point('kill.me', 'before-rename')\n"
+         "print('survived')"],
+        env=env, capture_output=True, text=True, timeout=60)
+    assert proc.returncode == CRASH_EXIT
+    assert "survived" not in proc.stdout             # os._exit: no finally
+
+
+# ------------------------------------------------------------ the sweeper
+def test_sweeper_collects_stale_tmps_and_never_live_ones(tmp_path):
+    stale = tmp_path / ".out.json.deadbeef.tmp"
+    stale.write_text("torn half")
+    old = time.time() - (STALE_TMP_AGE_S + 60.0)
+    os.utime(stale, (old, old))                      # a crashed writer's
+    live = tmp_path / ".out.json.0a1b2c3d.tmp"
+    live.write_text("in-flight stage")               # fresh mtime: LIVE
+    real = tmp_path / "out.json"
+    real.write_text("{}")
+    removed = sweep_stale_tmps(str(tmp_path))
+    assert [os.path.basename(p) for p in removed] == [stale.name]
+    assert not stale.exists()
+    assert live.exists()                             # never raced
+    assert real.exists()                             # never a tmp
+
+
+def test_sweeper_age_zero_forces_collection_and_spares_non_tmps(tmp_path):
+    (tmp_path / ".x.abcd0123.tmp").write_text("x")
+    (tmp_path / "data.bin").write_text("keep")
+    removed = sweep_stale_tmps(str(tmp_path), min_age_s=0.0)
+    assert len(removed) == 1
+    assert sorted(os.listdir(tmp_path)) == ["data.bin"]
+
+
+def test_sweeper_recurses_and_tolerates_missing_roots(tmp_path):
+    sub = tmp_path / "a" / "b"
+    sub.mkdir(parents=True)
+    (sub / ".deep.ffff0000.tmp").write_text("x")
+    assert len(sweep_stale_tmps(str(tmp_path), min_age_s=0.0)) == 1
+    assert sweep_stale_tmps(str(tmp_path / "nope")) == []
+
+
+# --------------------------------------------- writer-startup GC contract
+def test_lease_store_startup_sweeps_stale_stage_files(tmp_path):
+    from avenir_tpu.net.fault import Lease, LeaseStore
+
+    lease_dir = tmp_path / "leases"                  # the store's subdir
+    lease_dir.mkdir()
+    stranded = lease_dir / ".r000001.json.deadbeef.tmp"
+    stranded.write_text("torn")
+    old = time.time() - (STALE_TMP_AGE_S + 60.0)
+    os.utime(stranded, (old, old))
+    live = lease_dir / ".r000002.json.12345678.tmp"
+    live.write_text("in-flight")
+    store = LeaseStore(str(tmp_path))                # startup GC runs here
+    assert not stranded.exists()
+    assert live.exists()
+    # and the store still publishes over the swept root
+    store.write(Lease(name="r000003.json", host=0,
+                      claimed_at=1000.0, ttl_s=5.0))
+    assert store.names() == ["r000003.json"]
+
+
+def test_checkpoint_store_startup_sweeps_stale_stage_files(tmp_path):
+    from avenir_tpu.core.incremental import CheckpointStore
+
+    root = tmp_path / "state"
+    root.mkdir()
+    stranded = root / ".manifest.json.deadbeef.tmp"
+    stranded.write_text("torn")
+    old = time.time() - (STALE_TMP_AGE_S + 60.0)
+    os.utime(stranded, (old, old))
+    CheckpointStore(str(root))
+    assert not stranded.exists()

@@ -5,6 +5,9 @@
     python tools/graftlint.py --ir [--json]     # kernel-manifest IR audit
     python tools/graftlint.py --flow [--json]   # concurrency + invariance
     python tools/graftlint.py --mem [--json]    # footprint rules + audit
+    python tools/graftlint.py --merge [--json]  # merge algebra + audit
+    python tools/graftlint.py --proto [--json]  # protocol + crash audit
+    python tools/graftlint.py --all [--json]    # all six tiers, worst-of
 
 Same entry point as the `graftlint` console script. Exit codes: 0 clean,
 1 findings/stale/parse errors, 2 usage-or-trace errors. See
