@@ -31,8 +31,21 @@ Entry points:
     (``graftlint --merge``): fold-state merge-algebra rules + the
     mechanical shard-merge/resume auditor, which proves every streamed
     job's carry merges across P ∈ {2, 4} shards and checkpoint-resumes
-    byte-identically through the registered ``runner.StreamFoldOps``
-    (``graftlint --all`` runs all five tiers with one worst-of exit);
+    byte-identically through the registered ``runner.StreamFoldOps``;
+  - ``avenir_tpu.analysis.proto.run_proto`` — the proto layer
+    (``graftlint --proto``): shared-filesystem protocol-discipline
+    rules + the commit-point crash auditor, which hard-kills a real
+    publish per registered commit site and proves recovery
+    byte-identical;
+  - ``avenir_tpu.analysis.race.run_race`` — the race layer
+    (``graftlint --race``): cross-process race rules + the
+    deterministic-interleaving explorer, which steps two real actor
+    subprocesses through every registered interleave site's
+    ``sched_point`` schedule space and proves exactly-one-winner /
+    conservation / solo byte-identity per schedule, every failure a
+    replayable ``--schedule`` trace (``graftlint --all`` runs all
+    seven tiers with one worst-of exit; ``--all --parallel`` fans
+    them out as subprocesses);
   - ``graftlint_baseline.txt`` — the allowlist: accepted findings keyed
     by ``path::rule::scope`` with a one-line justification each, shared
     by both modes.

@@ -1,7 +1,7 @@
 """graftlint CLI: `graftlint <paths>` (console script) or
 `python tools/graftlint.py <paths>`.
 
-Six modes sharing one report/baseline/exit contract, plus ``--all``:
+Seven modes sharing one report/baseline/exit contract, plus ``--all``:
 
 - AST (default): lint source paths with the rules.py catalog.
 - IR (``--ir``, no paths): trace the kernel manifest
@@ -24,9 +24,20 @@ Six modes sharing one report/baseline/exit contract, plus ``--all``:
   rules (analysis/proto.py) plus the commit-point crash auditor that
   hard-kills a real publish per registered commit site at
   before-rename and after-rename and proves recovery byte-identical.
-- All (``--all``): the six tiers in ONE process — combined JSON under
-  a ``modes`` key and a single worst-of exit code (one command for CI
-  and the bench tripwire's local reproduction).
+- Race (``--race``, paths optional — defaults to the multi-writer
+  protocol surface): the cross-process race rules (analysis/race.py)
+  plus the deterministic-interleaving explorer that steps two real
+  actor subprocesses through every registered interleave site's
+  sched_point schedule space and proves exactly-one-winner /
+  conservation / solo byte-identity per schedule. A failing schedule
+  prints a replayable trace; ``--schedule <site>:<digits>`` replays
+  exactly that interleaving.
+- All (``--all``): the seven tiers in ONE process — combined JSON
+  under a ``modes`` key (each tier's report carries its ``wall_s``)
+  and a single worst-of exit code (one command for CI and the bench
+  tripwire's local reproduction). ``--all --parallel`` fans the tiers
+  out as subprocesses — same combined JSON, same worst-of exit, the
+  wall clock of the slowest tier instead of the sum.
 
 Exit-code contract (stable — bench_scaling.py and CI tripwire on it):
   0  clean: no findings, no stale baseline entries, no parse errors
@@ -34,14 +45,16 @@ Exit-code contract (stable — bench_scaling.py and CI tripwire on it):
      parse errors in the linted sources
   2  usage-or-trace-error — bad flags/baseline format/unreadable input,
      a manifest entry that failed to trace/lower (--ir), a stream
-     kernel that failed to run (--flow / --mem / --merge), or a crash
-     child / commit-site registry failure (--proto)
+     kernel that failed to run (--flow / --mem / --merge), a crash
+     child / commit-site registry failure (--proto), or an actor pool
+     / scheduler / interleave-site registry failure (--race)
 ``--all`` exits with the WORST code any tier produced.
 
 `--json` prints one machine-readable object in every single-tier mode
 (same schema: `payload_audit` is empty outside --ir, `invariance_audit`
 outside --flow, `footprint_audit` outside --mem, `merge_audit` outside
---merge, `proto_audit` outside --proto); ``--all --json`` prints ``{"modes": {<tier>: <report>},
+--merge, `proto_audit` outside --proto, `race_audit` outside --race);
+``--all --json`` prints ``{"modes": {<tier>: <report>},
 "clean": bool}`` with every tier's report under its name.
 """
 
@@ -57,8 +70,8 @@ from avenir_tpu.analysis.engine import (default_baseline_path, load_baseline,
                                         run_paths)
 from avenir_tpu.analysis.rules import ALL_RULES, rule_ids
 
-#: the six analysis tiers, in audit-cost order (cheapest first)
-TIERS = ("ast", "ir", "flow", "mem", "merge", "proto")
+#: the seven analysis tiers, in audit-cost order (cheapest first)
+TIERS = ("ast", "ir", "flow", "mem", "merge", "proto", "race")
 
 
 def build_parser() -> argparse.ArgumentParser:
@@ -98,10 +111,27 @@ def build_parser() -> argparse.ArgumentParser:
                         "commit site at before-rename and after-rename and "
                         "proves recovery byte-identical with no stranded "
                         "tmp")
+    p.add_argument("--race", action="store_true",
+                   help="cross-process race analysis: the race-* rules "
+                        "over the paths (default: the multi-writer "
+                        "protocol surface) + the deterministic-"
+                        "interleaving explorer that steps two real actor "
+                        "subprocesses through every registered interleave "
+                        "site's schedule space and proves exactly-one-"
+                        "winner / conservation / solo byte-identity per "
+                        "schedule")
+    p.add_argument("--schedule", default=None, metavar="SITE:DIGITS",
+                   help="with --race: replay exactly one interleaving "
+                        "trace (as printed by a failing schedule), e.g. "
+                        "ledger.claim:01101")
     p.add_argument("--all", action="store_true", dest="all_tiers",
-                   help="run all six tiers in one process: combined JSON "
-                        "(modes keyed by tier) and a single worst-of exit "
-                        "code")
+                   help="run all seven tiers in one process: combined "
+                        "JSON (modes keyed by tier) and a single "
+                        "worst-of exit code")
+    p.add_argument("--parallel", action="store_true",
+                   help="with --all: fan the tiers out as subprocesses "
+                        "(same combined JSON and worst-of exit; per-tier "
+                        "wall_s recorded either way)")
     p.add_argument("--baseline", default=None,
                    help="allowlist file (default: "
                         "avenir_tpu/analysis/graftlint_baseline.txt)")
@@ -113,9 +143,9 @@ def build_parser() -> argparse.ArgumentParser:
                    help=f"comma-separated subset of: {', '.join(rule_ids())} "
                         f"(or the ir-* ids with --ir, the flow-* ids with "
                         f"--flow, the mem-* ids with --mem, the merge-* ids "
-                        f"with --merge, the proto-* ids with --proto; --all "
-                        f"accepts ids from any tier and skips tiers with "
-                        f"none selected)")
+                        f"with --merge, the proto-* ids with --proto, the "
+                        f"race-* ids with --race; --all accepts ids from "
+                        f"any tier and skips tiers with none selected)")
     p.add_argument("--no-md", action="store_true",
                    help="skip ```python fences in .md files")
     p.add_argument("--allow-stale", action="store_true",
@@ -204,6 +234,14 @@ def _print_report(report, is_ir: bool) -> None:
                  if a["commit_point_validated"])
         tail += (f", commit-point audit {ok}/"
                  f"{len(report.proto_audit)} commit sites validated")
+    if report.race_audit:
+        ok = sum(1 for a in report.race_audit
+                 if a["interleaving_validated"])
+        n_sched = sum(sum(a["schedules"].values())
+                      for a in report.race_audit)
+        tail += (f", interleaving audit {ok}/"
+                 f"{len(report.race_audit)} sites validated over "
+                 f"{n_sched} schedules")
     print(f"graftlint: {len(report.scanned)} {unit}, "
           f"{len(report.findings)} finding(s), "
           f"{len(report.suppressed)} allowlisted, "
@@ -220,13 +258,121 @@ def _exit_code(report, args) -> int:
     return 0
 
 
+def _tier_rule_ids() -> dict:
+    """Every tier's known rule ids (audit pseudo-rules included) —
+    the skip decision for a ``--rules`` subset, shared by the
+    sequential and ``--parallel`` fan-outs."""
+    from avenir_tpu.analysis.flow import flow_rule_ids
+    from avenir_tpu.analysis.ir import ir_rule_ids
+    from avenir_tpu.analysis.mem import mem_rule_ids
+    from avenir_tpu.analysis.merge import merge_rule_ids
+    from avenir_tpu.analysis.proto import proto_rule_ids
+    from avenir_tpu.analysis.race import race_rule_ids
+
+    return {"ast": rule_ids(), "ir": ir_rule_ids(),
+            "flow": flow_rule_ids(), "mem": mem_rule_ids(),
+            "merge": merge_rule_ids(), "proto": proto_rule_ids(),
+            "race": race_rule_ids()}
+
+
+def _run_all_parallel(args, wanted: Optional[List[str]]) -> int:
+    """The ``--all --parallel`` mode: one subprocess per tier, same
+    combined JSON (each tier's report under ``modes`` with its
+    measured ``wall_s``) and the same worst-of exit as the sequential
+    ``--all`` — but the wall clock of the slowest tier instead of the
+    sum. Tier subprocesses re-enter this CLI in single-tier --json
+    mode, so the per-tier contract is exactly the documented one."""
+    import subprocess
+    import time
+
+    known = _tier_rule_ids()
+    modes = {}
+    worst = 0
+    procs = []
+    for name in TIERS:
+        sub_wanted = None
+        if wanted is not None:
+            sub_wanted = [w for w in wanted if w in known[name]]
+            if not sub_wanted:
+                modes[name] = {"skipped": True}
+                continue
+        argv = [sys.executable, "-m", "avenir_tpu.analysis.cli",
+                "--json"]
+        if name == "ast":
+            argv.extend(args.paths or _default_surface())
+        else:
+            argv.append(f"--{name}")
+            if args.paths and name != "ir":
+                argv.extend(args.paths)
+        if args.no_baseline:
+            argv.append("--no-baseline")
+        elif args.baseline:
+            argv.extend(["--baseline", args.baseline])
+        if args.no_md:
+            argv.append("--no-md")
+        if args.allow_stale:
+            argv.append("--allow-stale")
+        if sub_wanted is not None:
+            argv.extend(["--rules", ",".join(sub_wanted)])
+        env = dict(os.environ)
+        env.setdefault("JAX_PLATFORMS", "cpu")
+        # -m avenir_tpu.analysis.cli must resolve even when the parent
+        # was launched from outside the checkout (tools/graftlint.py
+        # patches sys.path, which children don't inherit)
+        pkg_root = os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))))
+        env["PYTHONPATH"] = os.pathsep.join(
+            [pkg_root] + [p for p in env.get("PYTHONPATH", "").split(
+                os.pathsep) if p])
+        procs.append((name, time.monotonic(), subprocess.Popen(
+            argv, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+            env=env, text=True)))
+    for name, t0, proc in procs:
+        out, err = proc.communicate()
+        wall = time.monotonic() - t0
+        if proc.returncode not in (0, 1):
+            tail = (err or out).strip()[-400:]
+            print(f"graftlint [{name}]: {tail}", file=sys.stderr)
+            modes[name] = {"error": tail, "wall_s": round(wall, 3)}
+            worst = 2
+            continue
+        try:
+            rep = json.loads(out)
+        except ValueError:
+            print(f"graftlint [{name}]: unparsable tier output",
+                  file=sys.stderr)
+            modes[name] = {"error": "unparsable tier output",
+                           "wall_s": round(wall, 3)}
+            worst = 2
+            continue
+        rep["wall_s"] = round(wall, 3)
+        modes[name] = rep
+        worst = max(worst, proc.returncode)
+        if not args.as_json:
+            print(f"-- {name} ({wall:.2f}s): "
+                  f"{len(rep.get('findings', []))} finding(s), "
+                  f"clean={rep.get('clean')}")
+    clean = worst == 0
+    if args.as_json:
+        print(json.dumps({"modes": modes, "clean": clean}, indent=1))
+    else:
+        print(f"graftlint --all --parallel: "
+              f"{sum(1 for m in modes.values() if 'skipped' in m)} "
+              f"tier(s) skipped, worst exit {worst}")
+    return worst
+
+
 def _run_all(args, baseline, wanted: Optional[List[str]]) -> int:
-    """The ``--all`` mode: six tiers, one process, worst-of exit.
+    """The ``--all`` mode: seven tiers, one process, worst-of exit.
 
     A ``--rules`` subset skips every tier it names no rules of (its
     audit included only when the tier's audit pseudo-rule is named), so
     fixture-level CI checks stay fast; the full run is what the bench
     tripwire executes every round."""
+    if args.parallel:
+        return _run_all_parallel(args, wanted)
+    import time
+
     _bootstrap_ir_env()
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
     from avenir_tpu.analysis.flow import (ALL_FLOW_RULES, FLOW_AUDIT_RULE,
@@ -239,6 +385,8 @@ def _run_all(args, baseline, wanted: Optional[List[str]]) -> int:
                                            MergeAuditError, run_merge)
     from avenir_tpu.analysis.proto import (ALL_PROTO_RULES, PROTO_AUDIT_RULE,
                                            ProtoAuditError, run_proto)
+    from avenir_tpu.analysis.race import (ALL_RACE_RULES, RACE_AUDIT_RULE,
+                                          RaceAuditError, run_race)
 
     paths = args.paths or None
     root = _report_root(args)
@@ -283,20 +431,28 @@ def _run_all(args, baseline, wanted: Optional[List[str]]) -> int:
                            baseline=baseline, root=root, include_md=md,
                            audit=want_audit(PROTO_AUDIT_RULE)),
          lambda: bool(pick(ALL_PROTO_RULES)) or want_audit(PROTO_AUDIT_RULE)),
+        ("race", RaceAuditError, "interleaving audit error",
+         lambda: run_race(paths=paths, rules=pick(ALL_RACE_RULES),
+                          baseline=baseline, root=root, include_md=md,
+                          audit=want_audit(RACE_AUDIT_RULE)),
+         lambda: bool(pick(ALL_RACE_RULES)) or want_audit(RACE_AUDIT_RULE)),
     ]
     for name, err_cls, err_label, run, active in runs:
         if wanted is not None and not active():
             modes[name] = {"skipped": True}
             continue
+        t0 = time.monotonic()
         try:
             report = run()
         except tuple(c for c in (err_cls, OSError) if c is not None) as e:
             label = err_label or "error"
             print(f"graftlint [{name}]: {label}: {e}", file=sys.stderr)
-            modes[name] = {"error": str(e)}
+            modes[name] = {"error": str(e),
+                           "wall_s": round(time.monotonic() - t0, 3)}
             worst = 2
             continue
-        modes[name] = report.to_json()
+        modes[name] = dict(report.to_json(),
+                           wall_s=round(time.monotonic() - t0, 3))
         if not args.as_json:
             print(f"-- {name} " + "-" * (68 - len(name)))
             _print_report(report, is_ir=(name == "ir"))
@@ -321,22 +477,31 @@ def main(argv: Optional[List[str]] = None) -> int:
     parser = build_parser()
     args = parser.parse_args(argv)
     tier_flags = sum(1 for m in (args.ir, args.flow, args.mem, args.merge,
-                                 args.proto)
+                                 args.proto, args.race)
                      if m)
     if tier_flags > 1 or (args.all_tiers and tier_flags):
-        print("graftlint: --ir, --flow, --mem, --merge and --proto are "
-              "separate analysis tiers; run them as separate invocations "
-              "(or use --all for every tier at once)", file=sys.stderr)
+        print("graftlint: --ir, --flow, --mem, --merge, --proto and "
+              "--race are separate analysis tiers; run them as separate "
+              "invocations (or use --all for every tier at once)",
+              file=sys.stderr)
         return 2
     if args.ir and args.paths:
         print("graftlint: --ir lints the kernel manifest; do not pass "
               "paths (run the two modes as two invocations)",
               file=sys.stderr)
         return 2
+    if args.schedule and not args.race:
+        print("graftlint: --schedule replays an interleaving trace and "
+              "needs --race", file=sys.stderr)
+        return 2
+    if args.parallel and not args.all_tiers:
+        print("graftlint: --parallel fans out the tiers and needs --all",
+              file=sys.stderr)
+        return 2
     if not args.all_tiers and not tier_flags and not args.paths:
         print("graftlint: pass paths to lint, or --ir / --flow / --mem / "
-              "--merge / --proto for the manifest audits (or --all for "
-              "every tier)", file=sys.stderr)
+              "--merge / --proto / --race for the manifest audits (or "
+              "--all for every tier)", file=sys.stderr)
         return 2
 
     if args.ir:
@@ -375,15 +540,16 @@ def main(argv: Optional[List[str]] = None) -> int:
                                                ProtoAuditError,
                                                proto_rule_ids, run_proto)
         known = proto_rule_ids()
+    elif args.race:
+        # the interleaving audit spawns real actor children: same pin
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        from avenir_tpu.analysis.race import (ALL_RACE_RULES,
+                                              RACE_AUDIT_RULE,
+                                              RaceAuditError,
+                                              race_rule_ids, run_race)
+        known = race_rule_ids()
     elif args.all_tiers:
-        from avenir_tpu.analysis.flow import flow_rule_ids
-        from avenir_tpu.analysis.mem import mem_rule_ids
-        from avenir_tpu.analysis.merge import merge_rule_ids
-        from avenir_tpu.analysis.proto import proto_rule_ids
-        # ir_rule_ids needs no jax; import via the module like the rest
-        from avenir_tpu.analysis.ir import ir_rule_ids
-        known = (rule_ids() + ir_rule_ids() + flow_rule_ids()
-                 + mem_rule_ids() + merge_rule_ids() + proto_rule_ids())
+        known = [rid for ids in _tier_rule_ids().values() for rid in ids]
     else:
         known = rule_ids()
 
@@ -474,6 +640,31 @@ def main(argv: Optional[List[str]] = None) -> int:
                                include_md=not args.no_md, audit=audit)
         except ProtoAuditError as e:
             print(f"graftlint: commit-point audit error: {e}",
+                  file=sys.stderr)
+            return 2
+        except OSError as e:
+            print(f"graftlint: cannot read input: {e}", file=sys.stderr)
+            return 2
+    elif args.race:
+        race_rules = ([r() for r in ALL_RACE_RULES] if wanted is None
+                      else [r() for r in ALL_RACE_RULES
+                            if r.rule_id in wanted])
+        audit = wanted is None or RACE_AUDIT_RULE in wanted
+        schedule = None
+        if args.schedule:
+            from avenir_tpu.analysis.race import parse_schedule
+            try:
+                schedule = parse_schedule(args.schedule)
+            except ValueError as e:
+                print(f"graftlint: {e}", file=sys.stderr)
+                return 2
+        try:
+            report = run_race(paths=args.paths or None, rules=race_rules,
+                              baseline=baseline, root=_report_root(args),
+                              include_md=not args.no_md, audit=audit,
+                              schedule=schedule)
+        except RaceAuditError as e:
+            print(f"graftlint: interleaving audit error: {e}",
                   file=sys.stderr)
             return 2
         except OSError as e:

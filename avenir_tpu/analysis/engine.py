@@ -80,9 +80,12 @@ class Report:
     entry per streamed fold kernel with its shard-merge/checkpoint-
     resume byte-identity verdict. `proto_audit` is filled only by proto
     runs (analysis/proto.py): one entry per registered commit site with
-    its kill-injection crash/recovery byte-identity verdict. Other
-    modes leave them empty — the keys are always present in the JSON
-    so downstream tripwires can parse one schema."""
+    its kill-injection crash/recovery byte-identity verdict.
+    `race_audit` is filled only by race runs (analysis/race.py): one
+    entry per registered interleave site with its schedule-exploration
+    verdict. Other modes leave them empty — the keys are always
+    present in the JSON so downstream tripwires can parse one
+    schema."""
 
     findings: List[Finding] = field(default_factory=list)
     suppressed: List[Finding] = field(default_factory=list)
@@ -94,6 +97,7 @@ class Report:
     footprint_audit: List[dict] = field(default_factory=list)
     merge_audit: List[dict] = field(default_factory=list)
     proto_audit: List[dict] = field(default_factory=list)
+    race_audit: List[dict] = field(default_factory=list)
 
     def counts(self) -> Dict[str, int]:
         out: Dict[str, int] = {}
@@ -118,6 +122,7 @@ class Report:
             "footprint_audit": self.footprint_audit,
             "merge_audit": self.merge_audit,
             "proto_audit": self.proto_audit,
+            "race_audit": self.race_audit,
             "clean": self.clean,
         }
 

@@ -20,6 +20,12 @@ its runtime half:
   a real job per site with the hook armed, hard-kills the process at
   both stages, and asserts recovery is byte-identical to an uncrashed
   run. Production never sets the variable, so the hook is a dict probe.
+- :func:`sched_point` — the ``AVENIR_RACE_SCHED`` file-turnstile hook:
+  each registered interleave site calls it at every schedule-sensitive
+  step, and the interleaving explorer (``graftlint --race``) steps two
+  REAL actor processes through exhaustive + seeded schedules, asserting
+  the shared outcome is schedule-independent. Same production contract
+  as ``crash_point``: one env probe, nothing more.
 - :func:`sweep_stale_tmps` — startup GC for the tmp files hard-killed
   writers leave behind: age-gated (mtime), so a LIVE tmp mid-commit is
   never swept, and matched on the ``.tmp`` naming convention only, so
@@ -37,6 +43,15 @@ from typing import List, Optional
 #: the kill-injection env var: ``"<site>:<stage>"`` hard-exits the
 #: process at that registered commit point (graftlint --proto only)
 CRASH_ENV = "AVENIR_PROTO_CRASH"
+
+#: the interleaving-turnstile env var: ``"<turnstile-dir>:<actor-idx>"``
+#: parks the process at every :func:`sched_point` until the scheduler
+#: grants its next step (graftlint --race only)
+SCHED_ENV = "AVENIR_RACE_SCHED"
+
+#: how long a parked actor waits for a grant before declaring the
+#: scheduler dead — generous, the explorer normally grants in ~1ms
+SCHED_TIMEOUT_S = 120.0
 
 #: crash stages every registered commit site exposes
 BEFORE_RENAME = "before-rename"
@@ -59,6 +74,48 @@ def crash_point(site: str, stage: str) -> None:
     or strand shared state."""
     if os.environ.get(CRASH_ENV, "") == f"{site}:{stage}":
         os._exit(CRASH_EXIT)
+
+
+#: per-arming step counters for :func:`sched_point` — keyed by the env
+#: value so a fresh turnstile dir (a new explored schedule) restarts
+#: the sequence at 0 inside a long-lived actor process
+_SCHED_SEQ: dict = {}
+
+
+def sched_point(name: str) -> None:
+    """Deterministic-interleaving hook: a no-op (one env probe) in
+    production; when ``AVENIR_RACE_SCHED=<turnstile-dir>:<actor-idx>``
+    is set, the process PARKS here until the interleaving explorer
+    (``graftlint --race``) grants its next step. The rendezvous is
+    file-based so any two real protocol actors can be stepped without
+    shared memory: the actor atomically publishes
+    ``ready.<actor>.<seq>`` (content: `name`, so the scheduler can
+    trace WHICH protocol step it is granting) into the turnstile dir,
+    then polls for the matching ``go.<actor>.<seq>`` token. Every
+    registered interleave site (analysis/race.py INTERLEAVE_SITES)
+    calls it at each step where schedule order can change the shared
+    outcome — right where the matching ``crash_point`` sits, plus the
+    reads a concurrent writer can invalidate."""
+    spec = os.environ.get(SCHED_ENV, "")
+    if not spec:
+        return
+    turnstile, _, actor = spec.rpartition(":")
+    seq = _SCHED_SEQ.get(spec, 0)
+    _SCHED_SEQ[spec] = seq + 1
+    tag = f"{actor}.{seq:04d}"
+    ready = os.path.join(turnstile, f"ready.{tag}")
+    wip = ready + ".wip"
+    with open(wip, "w") as fh:
+        fh.write(name)
+    os.replace(wip, ready)
+    go = os.path.join(turnstile, f"go.{tag}")
+    deadline = time.monotonic() + SCHED_TIMEOUT_S
+    while not os.path.exists(go):
+        if time.monotonic() > deadline:
+            raise RuntimeError(
+                f"sched_point({name!r}): no grant for step {tag} "
+                f"within {SCHED_TIMEOUT_S:.0f}s (scheduler gone?)")
+        time.sleep(0.0005)
 
 
 def unique_tmp(path: str) -> str:

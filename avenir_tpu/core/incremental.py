@@ -33,7 +33,8 @@ import json
 import os
 from typing import Dict, Optional, Sequence, Tuple
 
-from avenir_tpu.core.atomic import publish_bytes, sweep_stale_tmps
+from avenir_tpu.core.atomic import (publish_bytes, sched_point,
+                                    sweep_stale_tmps)
 
 #: fingerprint hash: sha1. Chosen by MEASURED throughput — the hash is
 #: the incremental driver's per-refresh floor (the whole unchanged
@@ -153,7 +154,14 @@ class CheckpointStore:
     resume, so the only cost of an unflushed page at power loss is a
     re-scan — while an fsync per checkpoint was measured at ~0.2s, a
     per-refresh floor the delta-scan driver cannot afford. Superseded
-    carry files are removed only after the new manifest is in place."""
+    carry files are removed only after the new manifest is in place.
+
+    Single-writer: one incremental scan owns a state dir (the dir is
+    keyed per (job, corpus)); concurrent SAVERS are out of contract.
+    Concurrent READERS are in contract — the hash-verified load plus
+    content-addressed carry names make every interleaving of save()
+    and load() a consistent pair or a detected cold fallback
+    (graftlint --race, checkpoint.save site)."""
 
     MANIFEST = "MANIFEST.json"
 
@@ -175,12 +183,18 @@ class CheckpointStore:
         carry = f"carry_{token}.npz"
         meta = dict(meta, carry_file=carry, carry_bytes=len(blob),
                     carry_hash=block_hash(blob))
+        sched_point("checkpoint.save")
         self._write_atomic(os.path.join(self.dir, carry), blob)
         # the manifest replace IS the commit point — the carry above is
         # invisible until the manifest references it
+        sched_point("checkpoint.save")
         self._write_atomic(os.path.join(self.dir, self.MANIFEST),
                            json.dumps(meta, indent=1).encode(),
                            site="checkpoint.save")
+        # superseded-carry GC races a concurrent load() holding the OLD
+        # manifest: the loader finds its carry gone and reports None —
+        # the cold-fallback contract, never a wrong resume
+        sched_point("checkpoint.save")
         for name in os.listdir(self.dir):
             if (name.startswith("carry_") and name != carry) \
                     or name.endswith(".tmp"):
@@ -196,8 +210,10 @@ class CheckpointStore:
         (missing/short/corrupt carry, unparsable manifest). A None here
         is the cold-scan fallback signal, never an error."""
         try:
+            sched_point("checkpoint.load")
             with open(os.path.join(self.dir, self.MANIFEST), "rb") as fh:
                 meta = json.loads(fh.read().decode())
+            sched_point("checkpoint.load")
             with open(os.path.join(self.dir, str(meta["carry_file"])),
                       "rb") as fh:
                 blob = fh.read()

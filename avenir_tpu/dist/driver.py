@@ -70,7 +70,7 @@ from typing import Callable, Dict, List, Optional, Sequence
 import numpy as np
 
 from avenir_tpu import obs as _obs
-from avenir_tpu.core.atomic import publish_bytes
+from avenir_tpu.core.atomic import publish_bytes, sched_point
 from avenir_tpu.dist.detect import StragglerPolicy
 from avenir_tpu.dist.ledger import BlockLedger
 from avenir_tpu.dist.plan import (DEFAULT_FACTOR, ShardPlan, plan_shards,
@@ -197,6 +197,16 @@ def _level_tids(blob: bytes) -> List[List[str]]:
     return json.loads(blob.decode("utf-8"))["tids"]
 
 
+def publish_candidates(cand_dir: str, name: str, man: dict) -> str:
+    """Publish one per-k candidates manifest (``k<k>.json`` / ``tids
+    .json`` / ``final.json``) into `cand_dir` — the coordinator's side
+    of the manifest-vs-worker-poll seam the race auditor steps."""
+    path = os.path.join(cand_dir, f"{name}.json")
+    sched_point("cand.publish")
+    write_json_atomic(man, path)
+    return path
+
+
 def _wait_commits(ledger: BlockLedger, n_blocks: int, workers, logs: str,
                   deadline: float, poll_s: float) -> None:
     """Wait until every block id is committed in ``ledger``'s
@@ -258,10 +268,10 @@ def _coordinate_per_k(canonical: str, cfg, plan: ShardPlan,
 
     def run_level(tag: str, cands, c_pad: int, parse_state):
         lk = ledger.level(tag)
-        write_json_atomic(
+        publish_candidates(
+            cand_dir, tag,
             {"tag": tag, "job": canonical, "mask": mask,
-             "cands": [list(cd) for cd in cands], "c_pad": int(c_pad)},
-            os.path.join(cand_dir, f"{tag}.json"))
+             "cands": [list(cd) for cd in cands], "c_pad": int(c_pad)})
         _wait_commits(lk, n_blocks, workers, logs, deadline,
                       policy.poll_s)
         t1 = time.perf_counter()
@@ -301,8 +311,8 @@ def _coordinate_per_k(canonical: str, cfg, plan: ShardPlan,
     else:
         levels = miner._merged_rounds(support1, n, count_level)
     # release the workers: no further manifests are coming
-    write_json_atomic({"done": True, "rounds": stats["rounds"]},
-                      os.path.join(cand_dir, "final.json"))
+    publish_candidates(cand_dir, "final",
+                       {"done": True, "rounds": stats["rounds"]})
     return {"levels": levels, "n": n, "rounds": stats["rounds"],
             "blocks": stats["blocks"], "tags": stats["tags"],
             "merge_s": stats["merge_s"],

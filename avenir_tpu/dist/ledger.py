@@ -49,7 +49,7 @@ from typing import Dict, List, Optional
 
 from avenir_tpu.core.atomic import (AFTER_RENAME, BEFORE_RENAME,
                                     crash_point, publish_json,
-                                    sweep_stale_tmps)
+                                    sched_point, sweep_stale_tmps)
 
 
 class BlockLedger:
@@ -100,9 +100,11 @@ class BlockLedger:
         crash_point("ledger.claim", BEFORE_RENAME)
         try:
             for _ in range(8):
+                sched_point("ledger.claim")
                 try:
                     os.link(tmp, path)
                     crash_point("ledger.claim", AFTER_RENAME)
+                    sched_point("ledger.claim")
                     return True
                 except FileExistsError:
                     if self.claim_info(block_id) is not None:
@@ -184,8 +186,10 @@ class BlockLedger:
             fh.write(blob)
         crash_point("ledger.commit", BEFORE_RENAME)
         try:
+            sched_point("ledger.commit")
             os.link(tmp, path)
             crash_point("ledger.commit", AFTER_RENAME)
+            sched_point("ledger.commit")
             if fps is not None:
                 fptmp = f"{tmp}.fps"
                 with open(fptmp, "w") as fh:

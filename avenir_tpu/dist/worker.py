@@ -53,7 +53,8 @@ from typing import Dict, List, Optional, Tuple
 import numpy as np
 
 from avenir_tpu import obs as _obs
-from avenir_tpu.core.atomic import publish_bytes, publish_json
+from avenir_tpu.core.atomic import (publish_bytes, publish_json,
+                                    sched_point)
 from avenir_tpu.dist.detect import (StragglerPolicy, mirror_after_s,
                                     mirror_after_wall_s)
 from avenir_tpu.dist.ledger import BlockLedger
@@ -392,6 +393,7 @@ class _Worker:
 
     # ------------------------------------------------------ per-k path
     def _load_manifest(self, path: str) -> Optional[Dict]:
+        sched_point("cand.poll")
         try:
             with open(path) as fh:
                 return json.load(fh)

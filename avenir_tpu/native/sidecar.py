@@ -37,6 +37,14 @@ appends) only the tail; an in-place edit invalidates from the edit point;
 a torn write never commits (the manifest is written last, and cold
 segment writes land under tmp+rename). Every failure path degrades to the
 cold parse — the sidecar can make a scan faster, never wrong.
+
+Concurrency contract — last-write-wins: the sidecar is a CACHE, so two
+concurrent packers of the same corpus may each publish a manifest and
+the later atomic replace wins; the loser's work is wasted, never wrong,
+because every served manifest re-proves against the current corpus
+bytes. A reader racing the warm store's eviction degrades the same way:
+a replay that loses its segment mid-scan finishes COLD from the last
+yielded block boundary (graftlint --race, warm.evict site).
 """
 
 from __future__ import annotations
@@ -52,7 +60,8 @@ from typing import Iterator, List, Optional, Tuple
 import numpy as np
 
 from avenir_tpu import obs as _obs
-from avenir_tpu.core.atomic import publish_bytes, sweep_stale_tmps
+from avenir_tpu.core.atomic import (publish_bytes, sched_point,
+                                    sweep_stale_tmps)
 from avenir_tpu.core.incremental import (block_fingerprint, ends_at_newline,
                                          verified_prefix)
 
@@ -183,6 +192,7 @@ def _write_manifest(dirpath: str, man: dict) -> None:
     # the manifest rename IS the sidecar commit point: the fsync'd
     # payload lands via unique sibling tmp + replace, so a reader sees
     # the old manifest or the new one, never a torn table
+    sched_point("sidecar.manifest")
     publish_bytes(json.dumps(man).encode("utf-8"),
                   os.path.join(dirpath, MANIFEST),
                   site="sidecar.manifest", fsync=True)
@@ -543,7 +553,8 @@ def _feed(opts, kind, path, dirpath, block_bytes, byte_range, write, kp):
     if not write:
         if not replay or rep_end < end:
             return None            # ranged readers replay all or nothing
-        return _replay_only(path, dirpath, man, replay, kind, kp)
+        return _replay_only(path, dirpath, man, replay, kind, kp,
+                            block_bytes, end)
     # write mode: extension is legal only when the cold tail starts
     # exactly where verified coverage ends (manifest blocks must tile
     # gap-free from their first offset) and the range runs to EOF
@@ -564,6 +575,7 @@ def _replay_entries(path, dirpath, man, entries, kind, kp):
     sequentially. Blank (zero-row) entries yield payload None."""
     vocab = man.get("vocab") if kind == "bytes" else None
     seg = os.path.join(dirpath, SEGMENT)
+    sched_point("sidecar.replay")
     fh = open(seg, "rb") if any(int(b["seg_len"]) for b in entries) \
         else None
     try:
@@ -573,6 +585,7 @@ def _replay_entries(path, dirpath, man, entries, kind, kp):
                 yield off, length, b["hash"], None
                 continue
             t0 = _obs.now()
+            sched_point("sidecar.replay")
             fh.seek(int(b["seg_off"]))
             buf = fh.read(int(b["seg_len"]))
             if len(buf) != int(b["seg_len"]):
@@ -593,8 +606,23 @@ def _replay_entries(path, dirpath, man, entries, kind, kp):
             fh.close()
 
 
-def _replay_only(path, dirpath, man, entries, kind, kp):
-    return _replay_entries(path, dirpath, man, entries, kind, kp)
+def _replay_only(path, dirpath, man, entries, kind, kp, block_bytes,
+                 end):
+    """The write=False feed: a pure replay run — except that the warm
+    store may EVICT the sidecar directory mid-replay (SidecarHandle
+    eviction is whole-directory rmtree, racing any open scan). The
+    replayed prefix stays valid — every yielded block was verified
+    against the live corpus bytes — so the scan finishes COLD from the
+    last yielded boundary instead of crashing the consumer."""
+    cursor = int(entries[0]["offset"])
+    try:
+        for off, length, bhash, payload in _replay_entries(
+                path, dirpath, man, entries, kind, kp):
+            yield off, length, bhash, payload
+            cursor = off + length
+    except (OSError, RuntimeError):
+        yield from _cold_tail(path, cursor, end, block_bytes, kind, kp,
+                              None)
 
 
 def _feed_gen(opts, kind, path, dirpath, man, replay, rep_end, end,
@@ -602,13 +630,22 @@ def _feed_gen(opts, kind, path, dirpath, man, replay, rep_end, end,
     """The full feed: verified replay prefix, then the cold tail —
     parsed (dataset) or raw (bytes) — packed into the sidecar when
     `extend` says the tiling stays gap-free. Writer failures abort the
-    sidecar, never the scan."""
-    from avenir_tpu.core.dataset import Dataset
-    from avenir_tpu.core.stream import (is_blank_block, iter_byte_blocks,
-                                        prefetched)
-
+    sidecar, never the scan; and a replay failure (the warm store
+    evicting this sidecar under an open scan) degrades to a cold
+    finish from the last yielded block boundary — entry boundaries
+    come from the verified tiling, so the splice is newline-aligned by
+    construction — never a consumer crash."""
     if replay:
-        yield from _replay_entries(path, dirpath, man, replay, kind, kp)
+        cursor = int(replay[0]["offset"])
+        try:
+            for off, length, bhash, payload in _replay_entries(
+                    path, dirpath, man, replay, kind, kp):
+                yield off, length, bhash, payload
+                cursor = off + length
+        except (OSError, RuntimeError):
+            yield from _cold_tail(path, cursor, end, block_bytes, kind,
+                                  kp, None)
+            return
     if rep_end >= end:
         return
     writer = None
@@ -618,9 +655,22 @@ def _feed_gen(opts, kind, path, dirpath, man, replay, rep_end, end,
                              kp, fresh=extend == "fresh")
         except Exception:
             writer = None
-    enc = writer.encoder if writer is not None else None
+    yield from _cold_tail(path, rep_end, end, block_bytes, kind, kp,
+                          writer)
+
+
+def _cold_tail(path, start, end, block_bytes, kind, kp, writer):
+    """The cold half of a feed: every block in ``[start, end)`` parsed
+    (dataset) or handed through raw (bytes), packed into `writer` when
+    one is given. Writer failures abort the sidecar, never the scan."""
+    from avenir_tpu.core.dataset import Dataset
+    from avenir_tpu.core.stream import (is_blank_block, iter_byte_blocks,
+                                        prefetched)
+
+    if start >= end:
+        return
     blocks = prefetched(iter_byte_blocks(path, block_bytes,
-                                         byte_range=(rep_end, end),
+                                         byte_range=(start, end),
                                          with_offsets=True), depth=1)
     try:
         for off, data in blocks:
@@ -862,4 +912,5 @@ class SidecarHandle:
         return nb
 
     def close(self) -> None:
+        sched_point("warm.evict")
         shutil.rmtree(self.dirpath, ignore_errors=True)

@@ -60,7 +60,8 @@ import time
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Sequence
 
-from avenir_tpu.core.atomic import publish_json, sweep_stale_tmps
+from avenir_tpu.core.atomic import (publish_json, sched_point,
+                                    sweep_stale_tmps, unique_tmp)
 
 
 @dataclass
@@ -215,6 +216,7 @@ class LeaseStore:
 
     def renew(self, lease: Lease, now: float) -> None:
         """Re-stamp the claim time — the sweep for a HEALTHY host."""
+        sched_point("lease.renew")
         lease.claimed_at = now
         self.write(lease)
 
@@ -224,6 +226,37 @@ class LeaseStore:
                 return Lease.from_dict(json.load(fh))
         except (OSError, ValueError, KeyError):
             return None           # torn mid-rename or already swept
+
+    def take(self, name: str) -> Optional[Lease]:
+        """Atomically CLAIM a lease file for exclusive handling: rename
+        it aside (exactly one of N racing sweepers wins the rename),
+        parse the taken copy, remove the aside, return the Lease — or
+        None when someone else took/removed it first or the copy is
+        torn. This is the sweep's compare-and-swap: between a plain
+        :meth:`load` and the requeue that acts on it, a healthy front
+        may RENEW the lease, and destroying that renewal double-places
+        the request. ``take`` moves the decision onto one atomic
+        rename: whatever state the taken copy shows is the state the
+        caller owns. The aside uses the protocol tmp naming so a
+        crashed taker's leftover is GC'd by :func:`sweep_stale_tmps`
+        and never read back as a live lease by :meth:`names`."""
+        sched_point("lease.sweep")
+        aside = unique_tmp(self.path(name))
+        try:
+            os.rename(self.path(name), aside)
+        except OSError:
+            return None            # lost the race (taken or removed)
+        sched_point("lease.sweep")
+        try:
+            with open(aside) as fh:
+                return Lease.from_dict(json.load(fh))
+        except (OSError, ValueError, KeyError):
+            return None           # torn by an external writer
+        finally:
+            try:
+                os.remove(aside)
+            except OSError:
+                pass
 
     def remove(self, name: str) -> None:
         try:

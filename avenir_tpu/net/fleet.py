@@ -146,7 +146,14 @@ class Fleet:
     JSON schema), ``collect()`` rows, ``stop()``. The budget vector is
     one ``budget_mb`` entry per host; ``profile_dir`` opts placement
     into fold-cost weighting and is forwarded to every host as its
-    autotune store."""
+    autotune store.
+
+    Single-writer: one Fleet coordinates one spool tree — request
+    names come from a per-instance sequence and every ``in/`` spool
+    write is this process's alone (hosts only ever RENAME requests out
+    and publish results to ``out/``). The one cross-process seam, the
+    lease trail, is serialized through ``LeaseStore.take``'s
+    rename-aside CAS (graftlint --race, lease.sweep site)."""
 
     def __init__(self, root: str, hosts: int = 2,
                  budget_mb: float = 3072.0, workers: int = 1,
@@ -735,7 +742,30 @@ class Fleet:
                 continue
             if dead or state in (fault.RESTARTING, fault.QUARANTINED) \
                     or lease.expired(now):
+                taken = None
+                if not dead and state not in (fault.RESTARTING,
+                                              fault.QUARANTINED):
+                    # pure TTL expiry: the verdict above came from an
+                    # IN-MEMORY stamp, and a concurrent front may have
+                    # renewed the lease FILE since — a plain
+                    # requeue-on-load would destroy that renewal and
+                    # double-place the request. take() is the CAS:
+                    # exactly one sweeper owns the file, and whatever
+                    # the taken copy says is the truth acted on.
+                    taken = self._leases.take(name)
+                    if taken is None:
+                        continue   # completed or taken under us
+                    if not taken.expired(now):
+                        self._leases.write(taken)  # renewed under us
+                        continue
+                    entry.lease = lease = taken    # own the real trail
                 if not self._requeue(name, entry, now, mono):
+                    if taken is not None:
+                        # took the file but could not move the request:
+                        # put the trail back on disk before waiting,
+                        # so the claim stays operator-visible and the
+                        # next tick's take() finds it again
+                        self._leases.write(taken)
                     # the requeue found no excluded-compliant host: a
                     # STRANDED request (trail covers every host) must
                     # respool or abandon in-band, never hang until the

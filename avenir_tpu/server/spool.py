@@ -45,7 +45,7 @@ import uuid
 from typing import Callable, Dict, List, Optional, Tuple
 
 from avenir_tpu.core.atomic import (publish_bytes, publish_json,
-                                    sweep_stale_tmps)
+                                    sched_point, sweep_stale_tmps)
 from avenir_tpu.server.jobserver import (DEFAULT_BUDGET_BYTES,
                                          DEFAULT_WARM_BUDGET_BYTES,
                                          JobRequest, JobServer, Ticket)
@@ -148,6 +148,7 @@ def _claim(in_dir: str, work_dir: str) -> List[Tuple[str, str]]:
             continue
         src = os.path.join(in_dir, name)
         dst = os.path.join(work_dir, f"{name}.{uuid.uuid4().hex[:8]}")
+        sched_point("spool.claim")
         try:
             os.replace(src, dst)
         except OSError:

@@ -71,7 +71,14 @@ def _fresh(job: str, digest: str) -> Dict:
 
 
 class ProfileStore:
-    """Load/update profiles under one autotune directory."""
+    """Load/update profiles under one autotune directory.
+
+    Concurrency contract — last-write-wins: profiles are ADVISORY
+    measurements (placement weights, knob priors), republished whole
+    via atomic tmp+rename. Two hosts recording runs concurrently may
+    drop one run's record; the cost is a slightly staler prior, never
+    a wrong result, and serializing writers would put a lock on every
+    scan's hot path for it."""
 
     def __init__(self, root: str):
         self.root = root

@@ -28,8 +28,9 @@ import tempfile
 def graftlint_tripwire() -> dict:
     """Run the graftlint CLI (--json) over the package, the --ir
     manifest audit, the --flow concurrency/invariance audit, the
-    --mem footprint audit, the --merge shard-merge/resume audit AND
-    the --proto commit-point crash audit, failing the bench on any
+    --mem footprint audit, the --merge shard-merge/resume audit,
+    the --proto commit-point crash audit AND the --race deterministic
+    interleaving audit, failing the bench on any
     non-allowlisted finding, stale baseline entry, trace error, a
     distributed family whose collective payload drifted off the
     scaling.py analytic model, a streamed fold kernel whose output
@@ -37,9 +38,10 @@ def graftlint_tripwire() -> dict:
     peak RSS left the memory model's tolerance band, a fold state
     whose shard merge / checkpoint resume drifted a byte, or a
     shared-filesystem commit site whose kill-injected recovery was
-    not byte-identical — hazard/traffic/determinism/footprint/
-    merge-algebra/protocol regressions surface here every round, not
-    at the next 100M-row run. The
+    not byte-identical, or a cross-process interleave site with a
+    losable schedule — hazard/traffic/determinism/footprint/
+    merge-algebra/protocol/race regressions surface here every round,
+    not at the next 100M-row run. The
     round's memory manifest (the job server's admission oracle) is
     re-derived and written next to the STREAM_SCALE_*.json records."""
     import os
@@ -137,6 +139,26 @@ def graftlint_tripwire() -> dict:
         raise RuntimeError(
             f"commit-point audit regression: {len(pa)} commit sites "
             f"audited, failed={uncommitted}")
+    # race leg (graftlint-race): every registered interleave site,
+    # two real actor subprocesses stepped through the sched_point
+    # schedule space (exhaustive-to-depth + seeded), must hold
+    # exactly-one-winner / conservation / solo byte-identity under
+    # EVERY schedule — the cross-process contract the crash audit
+    # can't see, >= 8 sites every round, per-site schedule counts
+    # recorded so a silently shrunken schedule space is visible
+    race_rep = run(["--race"], "--race")
+    ra = race_rep["race_audit"]
+    losable = [r["site"] for r in ra if not r["interleaving_validated"]]
+    if losable or len(ra) < 8:
+        raise RuntimeError(
+            f"interleaving audit regression: {len(ra)} interleave "
+            f"sites audited, failed={losable}")
+    race_schedules = {r["site"]: sum(r["schedules"].values())
+                      for r in ra}
+    if min(race_schedules.values()) < 8:
+        raise RuntimeError(
+            f"interleaving audit regression: schedule space shrank "
+            f"below 8 per site: {race_schedules}")
     # span-coverage leg (avenir-trace): every registered stream entry,
     # run under a captured recorder, must emit the mandatory span set
     # (read/parse/fold/finish) — an instrumentation point lost in a
@@ -177,6 +199,10 @@ def graftlint_tripwire() -> dict:
             "proto_findings": 0,
             "proto_allowlisted": proto_rep["suppressed"],
             "commit_points_validated": len(pa),
+            "race_findings": 0,
+            "race_allowlisted": race_rep["suppressed"],
+            "interleave_sites_validated": len(ra),
+            "race_schedules_per_site": race_schedules,
             "span_coverage_validated": len(cov),
             "memory_manifest": "MEMORY_MANIFEST.json"}
 

@@ -7,7 +7,12 @@
     python tools/graftlint.py --mem [--json]    # footprint rules + audit
     python tools/graftlint.py --merge [--json]  # merge algebra + audit
     python tools/graftlint.py --proto [--json]  # protocol + crash audit
-    python tools/graftlint.py --all [--json]    # all six tiers, worst-of
+    python tools/graftlint.py --race [--json]   # race rules + interleavings
+    python tools/graftlint.py --all [--json]    # all seven tiers, worst-of
+    python tools/graftlint.py --all --parallel  # same, tiers as subprocesses
+
+A failing --race schedule prints a replayable trace; replay it with
+``python tools/graftlint.py --race --schedule <site>:<digits>``.
 
 Same entry point as the `graftlint` console script. Exit codes: 0 clean,
 1 findings/stale/parse errors, 2 usage-or-trace errors. See
