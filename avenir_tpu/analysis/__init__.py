@@ -43,9 +43,16 @@ Entry points:
     subprocesses through every registered interleave site's
     ``sched_point`` schedule space and proves exactly-one-winner /
     conservation / solo byte-identity per schedule, every failure a
-    replayable ``--schedule`` trace (``graftlint --all`` runs all
-    seven tiers with one worst-of exit; ``--all --parallel`` fans
-    them out as subprocesses);
+    replayable ``--schedule`` trace;
+  - ``avenir_tpu.analysis.keys.run_keys`` — the keys layer
+    (``graftlint --keys``): cache-key completeness rules + the
+    stale-serve perturbation auditor, which seeds every registered
+    key site's cache, moves each registered input dimension one at a
+    time, and proves view-affecting changes move the key with served
+    bytes equal to a cold recompute, view-neutral changes warm-hit
+    byte-identically, and version-skewed manifests refuse-and-go-cold
+    (``graftlint --all`` runs all eight tiers with one worst-of exit;
+    ``--all --parallel`` fans them out as subprocesses);
   - ``graftlint_baseline.txt`` — the allowlist: accepted findings keyed
     by ``path::rule::scope`` with a one-line justification each, shared
     by both modes.

@@ -1,7 +1,7 @@
 """graftlint CLI: `graftlint <paths>` (console script) or
 `python tools/graftlint.py <paths>`.
 
-Seven modes sharing one report/baseline/exit contract, plus ``--all``:
+Eight modes sharing one report/baseline/exit contract, plus ``--all``:
 
 - AST (default): lint source paths with the rules.py catalog.
 - IR (``--ir``, no paths): trace the kernel manifest
@@ -32,7 +32,16 @@ Seven modes sharing one report/baseline/exit contract, plus ``--all``:
   conservation / solo byte-identity per schedule. A failing schedule
   prints a replayable trace; ``--schedule <site>:<digits>`` replays
   exactly that interleaving.
-- All (``--all``): the seven tiers in ONE process — combined JSON
+- Keys (``--keys``, paths optional — defaults to the cache-key
+  surface): the cache-key completeness rules (analysis/keys.py) plus
+  the stale-serve perturbation auditor that seeds every registered
+  key site's cache cold, perturbs each registered input dimension one
+  at a time, and proves view-affecting changes move the key with
+  served bytes equal to a cold recompute, view-neutral changes keep
+  the key and warm-hit byte-identically, and version-skewed manifests
+  refuse-and-go-cold. A stale serve surfaces as ``keys-stale-serve``
+  and is never allowlistable.
+- All (``--all``): the eight tiers in ONE process — combined JSON
   under a ``modes`` key (each tier's report carries its ``wall_s``)
   and a single worst-of exit code (one command for CI and the bench
   tripwire's local reproduction). ``--all --parallel`` fans the tiers
@@ -46,14 +55,16 @@ Exit-code contract (stable — bench_scaling.py and CI tripwire on it):
   2  usage-or-trace-error — bad flags/baseline format/unreadable input,
      a manifest entry that failed to trace/lower (--ir), a stream
      kernel that failed to run (--flow / --mem / --merge), a crash
-     child / commit-site registry failure (--proto), or an actor pool
-     / scheduler / interleave-site registry failure (--race)
+     child / commit-site registry failure (--proto), an actor pool
+     / scheduler / interleave-site registry failure (--race), or a
+     perturbation driver / key-site registry failure (--keys)
 ``--all`` exits with the WORST code any tier produced.
 
 `--json` prints one machine-readable object in every single-tier mode
 (same schema: `payload_audit` is empty outside --ir, `invariance_audit`
 outside --flow, `footprint_audit` outside --mem, `merge_audit` outside
---merge, `proto_audit` outside --proto, `race_audit` outside --race);
+--merge, `proto_audit` outside --proto, `race_audit` outside --race,
+`key_audit` outside --keys);
 ``--all --json`` prints ``{"modes": {<tier>: <report>},
 "clean": bool}`` with every tier's report under its name.
 """
@@ -70,8 +81,8 @@ from avenir_tpu.analysis.engine import (default_baseline_path, load_baseline,
                                         run_paths)
 from avenir_tpu.analysis.rules import ALL_RULES, rule_ids
 
-#: the seven analysis tiers, in audit-cost order (cheapest first)
-TIERS = ("ast", "ir", "flow", "mem", "merge", "proto", "race")
+#: the eight analysis tiers, in audit-cost order (cheapest first)
+TIERS = ("ast", "ir", "flow", "mem", "merge", "proto", "race", "keys")
 
 
 def build_parser() -> argparse.ArgumentParser:
@@ -120,12 +131,22 @@ def build_parser() -> argparse.ArgumentParser:
                         "site's schedule space and proves exactly-one-"
                         "winner / conservation / solo byte-identity per "
                         "schedule")
+    p.add_argument("--keys", action="store_true",
+                   help="cache-key completeness analysis: the keys-* "
+                        "rules over the paths (default: the cache-key "
+                        "surface) + the stale-serve perturbation audit "
+                        "that moves every registered input dimension of "
+                        "every registered key site one at a time and "
+                        "proves affecting changes move the key with "
+                        "warm-served bytes equal to a cold recompute, "
+                        "neutral changes warm-hit byte-identically, and "
+                        "version-skewed manifests refuse-and-go-cold")
     p.add_argument("--schedule", default=None, metavar="SITE:DIGITS",
                    help="with --race: replay exactly one interleaving "
                         "trace (as printed by a failing schedule), e.g. "
                         "ledger.claim:01101")
     p.add_argument("--all", action="store_true", dest="all_tiers",
-                   help="run all seven tiers in one process: combined "
+                   help="run all eight tiers in one process: combined "
                         "JSON (modes keyed by tier) and a single "
                         "worst-of exit code")
     p.add_argument("--parallel", action="store_true",
@@ -144,7 +165,8 @@ def build_parser() -> argparse.ArgumentParser:
                         f"(or the ir-* ids with --ir, the flow-* ids with "
                         f"--flow, the mem-* ids with --mem, the merge-* ids "
                         f"with --merge, the proto-* ids with --proto, the "
-                        f"race-* ids with --race; --all accepts ids from "
+                        f"race-* ids with --race, the keys-* ids with "
+                        f"--keys; --all accepts ids from "
                         f"any tier and skips tiers with none selected)")
     p.add_argument("--no-md", action="store_true",
                    help="skip ```python fences in .md files")
@@ -242,6 +264,13 @@ def _print_report(report, is_ir: bool) -> None:
         tail += (f", interleaving audit {ok}/"
                  f"{len(report.race_audit)} sites validated over "
                  f"{n_sched} schedules")
+    if report.key_audit:
+        ok = sum(1 for a in report.key_audit if a["key_validated"])
+        n_pert = sum(sum(a["perturbations"].values())
+                     for a in report.key_audit)
+        tail += (f", key-perturbation audit {ok}/"
+                 f"{len(report.key_audit)} sites validated over "
+                 f"{n_pert} perturbations")
     print(f"graftlint: {len(report.scanned)} {unit}, "
           f"{len(report.findings)} finding(s), "
           f"{len(report.suppressed)} allowlisted, "
@@ -266,13 +295,14 @@ def _tier_rule_ids() -> dict:
     from avenir_tpu.analysis.ir import ir_rule_ids
     from avenir_tpu.analysis.mem import mem_rule_ids
     from avenir_tpu.analysis.merge import merge_rule_ids
+    from avenir_tpu.analysis.keys import keys_rule_ids
     from avenir_tpu.analysis.proto import proto_rule_ids
     from avenir_tpu.analysis.race import race_rule_ids
 
     return {"ast": rule_ids(), "ir": ir_rule_ids(),
             "flow": flow_rule_ids(), "mem": mem_rule_ids(),
             "merge": merge_rule_ids(), "proto": proto_rule_ids(),
-            "race": race_rule_ids()}
+            "race": race_rule_ids(), "keys": keys_rule_ids()}
 
 
 def _run_all_parallel(args, wanted: Optional[List[str]]) -> int:
@@ -363,7 +393,7 @@ def _run_all_parallel(args, wanted: Optional[List[str]]) -> int:
 
 
 def _run_all(args, baseline, wanted: Optional[List[str]]) -> int:
-    """The ``--all`` mode: seven tiers, one process, worst-of exit.
+    """The ``--all`` mode: eight tiers, one process, worst-of exit.
 
     A ``--rules`` subset skips every tier it names no rules of (its
     audit included only when the tier's audit pseudo-rule is named), so
@@ -385,6 +415,8 @@ def _run_all(args, baseline, wanted: Optional[List[str]]) -> int:
                                            MergeAuditError, run_merge)
     from avenir_tpu.analysis.proto import (ALL_PROTO_RULES, PROTO_AUDIT_RULE,
                                            ProtoAuditError, run_proto)
+    from avenir_tpu.analysis.keys import (ALL_KEYS_RULES, KEYS_AUDIT_RULE,
+                                          KeysAuditError, run_keys)
     from avenir_tpu.analysis.race import (ALL_RACE_RULES, RACE_AUDIT_RULE,
                                           RaceAuditError, run_race)
 
@@ -436,6 +468,11 @@ def _run_all(args, baseline, wanted: Optional[List[str]]) -> int:
                           baseline=baseline, root=root, include_md=md,
                           audit=want_audit(RACE_AUDIT_RULE)),
          lambda: bool(pick(ALL_RACE_RULES)) or want_audit(RACE_AUDIT_RULE)),
+        ("keys", KeysAuditError, "key-perturbation audit error",
+         lambda: run_keys(paths=paths, rules=pick(ALL_KEYS_RULES),
+                          baseline=baseline, root=root, include_md=md,
+                          audit=want_audit(KEYS_AUDIT_RULE)),
+         lambda: bool(pick(ALL_KEYS_RULES)) or want_audit(KEYS_AUDIT_RULE)),
     ]
     for name, err_cls, err_label, run, active in runs:
         if wanted is not None and not active():
@@ -477,12 +514,12 @@ def main(argv: Optional[List[str]] = None) -> int:
     parser = build_parser()
     args = parser.parse_args(argv)
     tier_flags = sum(1 for m in (args.ir, args.flow, args.mem, args.merge,
-                                 args.proto, args.race)
+                                 args.proto, args.race, args.keys)
                      if m)
     if tier_flags > 1 or (args.all_tiers and tier_flags):
-        print("graftlint: --ir, --flow, --mem, --merge, --proto and "
-              "--race are separate analysis tiers; run them as separate "
-              "invocations (or use --all for every tier at once)",
+        print("graftlint: --ir, --flow, --mem, --merge, --proto, --race "
+              "and --keys are separate analysis tiers; run them as "
+              "separate invocations (or use --all for every tier at once)",
               file=sys.stderr)
         return 2
     if args.ir and args.paths:
@@ -500,8 +537,8 @@ def main(argv: Optional[List[str]] = None) -> int:
         return 2
     if not args.all_tiers and not tier_flags and not args.paths:
         print("graftlint: pass paths to lint, or --ir / --flow / --mem / "
-              "--merge / --proto / --race for the manifest audits (or "
-              "--all for every tier)", file=sys.stderr)
+              "--merge / --proto / --race / --keys for the manifest "
+              "audits (or --all for every tier)", file=sys.stderr)
         return 2
 
     if args.ir:
@@ -548,6 +585,15 @@ def main(argv: Optional[List[str]] = None) -> int:
                                               RaceAuditError,
                                               race_rule_ids, run_race)
         known = race_rule_ids()
+    elif args.keys:
+        # the perturbation audit runs real jobs over seeded roots: pin
+        # the CPU platform the way every other audit consumer does
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        from avenir_tpu.analysis.keys import (ALL_KEYS_RULES,
+                                              KEYS_AUDIT_RULE,
+                                              KeysAuditError,
+                                              keys_rule_ids, run_keys)
+        known = keys_rule_ids()
     elif args.all_tiers:
         known = [rid for ids in _tier_rule_ids().values() for rid in ids]
     else:
@@ -665,6 +711,22 @@ def main(argv: Optional[List[str]] = None) -> int:
                               schedule=schedule)
         except RaceAuditError as e:
             print(f"graftlint: interleaving audit error: {e}",
+                  file=sys.stderr)
+            return 2
+        except OSError as e:
+            print(f"graftlint: cannot read input: {e}", file=sys.stderr)
+            return 2
+    elif args.keys:
+        keys_rules = ([r() for r in ALL_KEYS_RULES] if wanted is None
+                      else [r() for r in ALL_KEYS_RULES
+                            if r.rule_id in wanted])
+        audit = wanted is None or KEYS_AUDIT_RULE in wanted
+        try:
+            report = run_keys(paths=args.paths or None, rules=keys_rules,
+                              baseline=baseline, root=_report_root(args),
+                              include_md=not args.no_md, audit=audit)
+        except KeysAuditError as e:
+            print(f"graftlint: key-perturbation audit error: {e}",
                   file=sys.stderr)
             return 2
         except OSError as e:

@@ -83,7 +83,9 @@ class Report:
     its kill-injection crash/recovery byte-identity verdict.
     `race_audit` is filled only by race runs (analysis/race.py): one
     entry per registered interleave site with its schedule-exploration
-    verdict. Other modes leave them empty — the keys are always
+    verdict. `key_audit` is filled only by keys runs
+    (analysis/keys.py): one entry per registered key site with its
+    perturbation verdict. Other modes leave them empty — the keys are always
     present in the JSON so downstream tripwires can parse one
     schema."""
 
@@ -98,6 +100,7 @@ class Report:
     merge_audit: List[dict] = field(default_factory=list)
     proto_audit: List[dict] = field(default_factory=list)
     race_audit: List[dict] = field(default_factory=list)
+    key_audit: List[dict] = field(default_factory=list)
 
     def counts(self) -> Dict[str, int]:
         out: Dict[str, int] = {}
@@ -123,6 +126,7 @@ class Report:
             "merge_audit": self.merge_audit,
             "proto_audit": self.proto_audit,
             "race_audit": self.race_audit,
+            "key_audit": self.key_audit,
             "clean": self.clean,
         }
 

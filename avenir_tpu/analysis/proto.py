@@ -700,6 +700,11 @@ def _run_ledger_dup(root: str) -> None:
     led.commit(3, 1, b"block-3-dup")  # rejected: records the dup marker
 
 
+def _run_ledger_format(root: str) -> None:
+    from avenir_tpu.dist.ledger import BlockLedger
+    BlockLedger(root)      # construction stamps states/FORMAT.json
+
+
 def _run_plan_manifest(root: str) -> None:
     from avenir_tpu.dist.plan import write_json_atomic
     write_json_atomic({"procs": 1, "factor": 1, "blocks": []},
@@ -765,6 +770,8 @@ COMMIT_SITES: List[CommitSite] = [
                _run_ledger_commit),
     CommitSite("ledger.dup", "avenir_tpu/dist/ledger.py",
                _run_ledger_dup),
+    CommitSite("ledger.format", "avenir_tpu/dist/ledger.py",
+               _run_ledger_format),
     CommitSite("plan.manifest", "avenir_tpu/dist/plan.py",
                _run_plan_manifest),
     CommitSite("lease.write", "avenir_tpu/net/fault.py",

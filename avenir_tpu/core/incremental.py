@@ -164,6 +164,11 @@ class CheckpointStore:
     (graftlint --race, checkpoint.save site)."""
 
     MANIFEST = "MANIFEST.json"
+    #: manifest layout version; a manifest stamped with a DIFFERENT
+    #: version refuses to load (cold start) — old readers must never
+    #: silently parse a newer layout. A MISSING stamp is a
+    #: pre-versioning checkpoint and still loads.
+    FORMAT_VERSION = 1
 
     def __init__(self, state_dir: str):
         self.dir = state_dir
@@ -183,6 +188,7 @@ class CheckpointStore:
         carry = f"carry_{token}.npz"
         meta = dict(meta, carry_file=carry, carry_bytes=len(blob),
                     carry_hash=block_hash(blob))
+        meta.setdefault("format_version", self.FORMAT_VERSION)
         sched_point("checkpoint.save")
         self._write_atomic(os.path.join(self.dir, carry), blob)
         # the manifest replace IS the commit point — the carry above is
@@ -217,6 +223,9 @@ class CheckpointStore:
             with open(os.path.join(self.dir, str(meta["carry_file"])),
                       "rb") as fh:
                 blob = fh.read()
+            if meta.get("format_version",
+                        self.FORMAT_VERSION) != self.FORMAT_VERSION:
+                return None           # version skew: refuse, go cold
             if len(blob) != int(meta["carry_bytes"]) \
                     or block_hash(blob) != meta["carry_hash"]:
                 return None

@@ -50,6 +50,18 @@ from typing import Dict, List, Optional
 from avenir_tpu.core.atomic import (AFTER_RENAME, BEFORE_RENAME,
                                     crash_point, publish_json,
                                     sched_point, sweep_stale_tmps)
+from avenir_tpu.core.keys import key_site
+
+#: ledger record/state layout version. Stamped into every claim and dup
+#: record and into a per-states-dir ``states/FORMAT.json`` marker; a
+#: marker stamped with a DIFFERENT version makes :meth:`BlockLedger.
+#: load_state` / :meth:`BlockLedger.committed` refuse (go cold) — old
+#: readers must never silently merge a newer state layout. A MISSING
+#: marker is a pre-versioning ledger and still serves.
+FORMAT_VERSION = 1
+
+#: per-states-dir layout-version marker file name
+STATES_FORMAT = "FORMAT.json"
 
 
 class BlockLedger:
@@ -67,6 +79,13 @@ class BlockLedger:
         self.dups_dir = os.path.join(self.root, "dups")
         for d in (self.claims_dir, self.states_dir, self.dups_dir):
             os.makedirs(d, exist_ok=True)
+        # stamp the states-dir layout version once, first writer wins
+        # (deterministic bytes, so racing stampers publish identical
+        # content; an existing marker — any version — is left alone)
+        marker = os.path.join(self.states_dir, STATES_FORMAT)
+        if not os.path.exists(marker):
+            publish_json({"format_version": FORMAT_VERSION}, marker,
+                         site="ledger.format")
         # startup GC: tmp files a hard-killed worker left behind (the
         # age gate keeps a concurrent writer's live tmp safe)
         sweep_stale_tmps(self.root)
@@ -95,7 +114,8 @@ class BlockLedger:
         tmp = os.path.join(self.claims_dir,
                            f".tmp.b{block_id}.{uuid.uuid4().hex}")
         with open(tmp, "w") as fh:
-            json.dump({"block": block_id, "worker": worker,
+            json.dump({"format_version": FORMAT_VERSION,
+                       "block": block_id, "worker": worker,
                        "claimed_at": time.time(), "mirror": mirror}, fh)
         crash_point("ledger.claim", BEFORE_RENAME)
         try:
@@ -221,15 +241,48 @@ class BlockLedger:
         concurrent losers never race one file, atomic so the
         coordinator's count never reads a torn marker."""
         path = os.path.join(self.dups_dir, f"b{block_id}.w{worker}.json")
-        publish_json({"block": block_id, "worker": worker,
+        publish_json({"format_version": FORMAT_VERSION,
+                      "block": block_id, "worker": worker,
                       "rejected_at": time.time()}, path,
                      site="ledger.dup")
 
+    def _states_format_ok(self) -> bool:
+        """Whether the states dir's layout-version marker matches this
+        reader. A missing or torn marker is a pre-versioning ledger and
+        still serves; a PRESENT marker with a different version makes
+        every state read refuse — merging a newer layout as if it were
+        this one is the silent-wrong-answer case the stamp exists for."""
+        try:
+            with open(os.path.join(self.states_dir, STATES_FORMAT)) as fh:
+                marker = json.load(fh)
+        except (OSError, ValueError):
+            return True
+        if not isinstance(marker, dict):
+            return True
+        return marker.get("format_version",
+                          FORMAT_VERSION) == FORMAT_VERSION
+
     def load_state(self, block_id: int) -> bytes:
+        """The winning commit's serialized fold state. The committed
+        identity is first-commit-wins per (namespace, block id):
+        whichever worker linked ``states/b<id>.npz`` first is the state
+        every reader serves — content validity is the link's atomicity
+        plus the version marker, never mtime.
+
+        key-covered: all — the path IS the key (ns + block id).
+        """
+        key_site("ledger.committed")
+        if not self._states_format_ok():
+            raise ValueError(
+                f"ledger states dir {self.states_dir!r}: layout version "
+                f"mismatch (reader expects {FORMAT_VERSION}) — refusing "
+                f"to serve; start a fresh ledger root")
         with open(self.state_path(block_id), "rb") as fh:
             return fh.read()
 
     def committed(self) -> List[int]:
+        if not self._states_format_ok():
+            return []      # version skew: nothing servable, go cold
         try:
             names = os.listdir(self.states_dir)
         except OSError:

@@ -460,6 +460,16 @@ class EncodedBlockCache:
 
     # ------------------------------------------------------------- write
     def _current_fingerprint(self):
+        """Cheap stat identity of the source set — the begin/commit
+        torn-write GATE only (a scan that mutated its own sources can
+        never commit); REPLAY validity is the per-block content
+        re-proof (``_content_coverage``), never this stat tuple.
+
+        key-covered: all — replay identity is the content fingerprints.
+        """
+        from avenir_tpu.core.keys import key_site
+
+        key_site("cache.fingerprint")
         out = []
         for p in self.sources:
             try:

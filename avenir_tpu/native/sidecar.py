@@ -143,19 +143,27 @@ def schema_digest(schema) -> str:
 
 def _config_digest(kind: str, delim: str, block_bytes: int,
                    extra: str) -> str:
-    blob = json.dumps([FORMAT, kind, delim, int(block_bytes), extra],
-                      sort_keys=True).encode()
-    return hashlib.sha1(blob).hexdigest()
+    from avenir_tpu.core.keys import sidecar_config_digest
+
+    return sidecar_config_digest(FORMAT, kind, delim, block_bytes, extra)
 
 
 def dataset_dir(opts: dict, path: str, schema, delim: str,
                 block_bytes: int) -> str:
+    """key-covered: all — the digest is the whole dataset parse view."""
+    from avenir_tpu.core.keys import key_site
+
+    key_site("sidecar.dataset")
     return _dir_for(opts, path, _config_digest(
         "dataset", delim, block_bytes, schema_digest(schema)))
 
 
 def bytes_dir(opts: dict, path: str, delim: str, skip: int,
               block_bytes: int) -> str:
+    """key-covered: all — the digest is the whole bytes parse view."""
+    from avenir_tpu.core.keys import key_site
+
+    key_site("sidecar.bytes")
     return _dir_for(opts, path, _config_digest(
         "bytes", delim, block_bytes, str(int(skip))))
 
@@ -184,6 +192,11 @@ def _load_manifest(dirpath: str) -> Optional[dict]:
         return None
     if not isinstance(man, dict) or man.get("format") != FORMAT \
             or not isinstance(man.get("blocks"), list):
+        return None
+    if man.get("format_version", FORMAT) != FORMAT:
+        # version-skewed manifest: refuse to serve, go cold (a MISSING
+        # stamp is a pre-versioning sidecar and still serves — the
+        # "format" gate above already pins its layout)
         return None
     return man
 
@@ -492,7 +505,7 @@ def byte_blocks(opts: Optional[dict], path: str, delim: str, skip: int,
 
 def _base_manifest(kind: str, path: str, block_bytes: int,
                    kp: dict) -> dict:
-    man = {"format": FORMAT, "kind": kind,
+    man = {"format": FORMAT, "format_version": FORMAT, "kind": kind,
            "block_bytes": int(block_bytes), "delim": kp["delim"],
            "source": os.path.abspath(path)}
     if kind == "dataset":

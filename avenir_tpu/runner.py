@@ -1252,14 +1252,12 @@ def _incremental_state_dir(cfg: JobConfig, canonical: str,
     directory next to the first input — deterministic per (job, input
     set), so a rerun of the same job over the same corpus finds its own
     state and two jobs over one corpus never collide."""
-    import hashlib
+    from avenir_tpu.core import keys as _keys
 
     explicit = cfg.get("stream.incremental.state.dir")
     if explicit:
         return explicit
-    digest = hashlib.blake2b(
-        "\0".join([canonical] + [os.path.abspath(p) for p in inputs])
-        .encode(), digest_size=8).hexdigest()
+    digest = _keys.state_digest(canonical, inputs)
     base = os.path.dirname(os.path.abspath(inputs[0]))
     return os.path.join(base, ".avenir_incremental",
                         f"{canonical}_{digest}")
@@ -1267,38 +1265,13 @@ def _incremental_state_dir(cfg: JobConfig, canonical: str,
 
 def _conf_digest(cfg: JobConfig) -> str:
     """Content digest of the configuration a checkpoint's carry was
-    folded under: every prefixed property (minus the state-dir key,
-    which only names WHERE the checkpoint lives) plus the schema file's
-    bytes when one is configured. A restored carry must have parsed its
-    prefix under the same view of the corpus the delta will be parsed
-    under — any conf or schema-content change invalidates the
-    checkpoint. Deliberately conservative: a changed block size or
-    checkpoint interval also re-scans cold (folds are proven
-    chunk-invariant, but a rare cold refresh is cheaper than reasoning
-    about which keys are view-affecting as the conf surface grows)."""
-    import hashlib
+    folded under — the canonical recipe lives in
+    :func:`avenir_tpu.core.keys.conf_digest` (view-neutral keys are
+    declared in ``core.keys.VIEW_NEUTRAL_KEYS``, verified by
+    ``graftlint --keys``); this name survives for its importers."""
+    from avenir_tpu.core import keys as _keys
 
-    h = hashlib.sha1()
-    for k in sorted(cfg.props):
-        # skipped keys only name WHERE driver state lives / whether the
-        # tuner records — never how bytes are parsed or folded. The
-        # autotune control keys must be digest-neutral so a job server
-        # injecting its profile dir (or an operator flipping recording
-        # on) does not invalidate every checkpoint; the knob keys the
-        # tuner OVERLAYS (block size etc.) are ordinary prefixed props
-        # and stay in the digest, which is what re-scans cold exactly
-        # when a knob value actually changes.
-        if "incremental.state.dir" in k or "stream.autotune" in k:
-            continue
-        h.update(f"{k}={cfg.props[k]}\n".encode())
-    schema_path = cfg.get("feature.schema.file.path")
-    if schema_path:
-        try:
-            with open(schema_path, "rb") as fh:
-                h.update(fh.read())
-        except OSError:
-            h.update(b"<unreadable schema>")
-    return h.hexdigest()
+    return _keys.conf_digest(cfg)
 
 
 class _IncrementalPlan:
@@ -1372,6 +1345,7 @@ def _prepare_incremental(canonical: str, cfg: JobConfig, inputs: List[str],
         # under a different view than the restored prefix — is a cold
         # scan
         usable = (meta.get("format") == 1
+                  and meta.get("format_version", 1) == 1
                   and meta.get("job") == canonical
                   and meta.get("conf_digest") == conf_digest
                   and old_inputs == plan.abs_inputs[:len(old_inputs)])
@@ -1438,7 +1412,8 @@ def _plan_checkpoint(plan: _IncrementalPlan, complete: bool) -> None:
     t0 = _obs.now()
     plan.seq += 1
     blob = plan.ops.serialize_state(plan.fold)
-    meta = {"format": 1, "job": plan.canonical, "seq": plan.seq,
+    meta = {"format": 1, "format_version": 1,
+            "job": plan.canonical, "seq": plan.seq,
             "conf_digest": plan.conf_digest,
             "inputs": plan.abs_inputs, "block_bytes": plan.block,
             "watermarks": list(plan.watermarks),

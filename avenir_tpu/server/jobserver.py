@@ -195,6 +195,7 @@ def compat_key(request: JobRequest) -> Optional[tuple]:
     canonical, _prefix, cfg = _scoped(request.job, request.conf)
     if canonical not in stream_fold_names():
         return None
+    from avenir_tpu.core.keys import compat_tuple
     from avenir_tpu.runner import stream_fold_ops
 
     ops = stream_fold_ops(canonical)
@@ -203,22 +204,23 @@ def compat_key(request: JobRequest) -> Optional[tuple]:
         schema = cfg.get("feature.schema.file.path")
         if not schema:
             return None               # will fail at run; never batch it
-    return (request.mode,
-            tuple(os.path.abspath(p) for p in request.inputs),
-            ops.kind,
-            round(cfg.get_float("stream.block.size.mb", 64.0), 6),
-            cfg.field_delim_regex,
-            schema)
+    return compat_tuple(request.mode, request.inputs, ops.kind,
+                        cfg.get_float("stream.block.size.mb", 64.0),
+                        cfg.field_delim_regex, schema)
 
 
 def _exec_key(request: JobRequest) -> tuple:
     """Identical-execution key: requests agreeing on it produce (by
     determinism of the runner paths) byte-identical artifacts, so the
-    server runs ONE and copies the files per requester."""
-    from avenir_tpu.runner import _conf_digest
+    server runs ONE and copies the files per requester.
 
+    key-covered: all — conf_digest folds every non-neutral property.
+    """
+    from avenir_tpu.core.keys import conf_digest, key_site
+
+    key_site("exec.coalesce")
     canonical, _prefix, cfg = _scoped(request.job, request.conf)
-    return (request.mode, canonical, _conf_digest(cfg),
+    return (request.mode, canonical, conf_digest(cfg),
             tuple(os.path.abspath(p) for p in request.inputs))
 
 
@@ -384,13 +386,19 @@ class WarmStore:
         serves any mining request over the corpus. The trans-id ordinal
         IS included: the source bakes it in, and an apriori request
         emitting trans ids from a different column must miss, not
-        silently serve ids read from the pinned source's column."""
-        return (canonical,
-                tuple(os.path.abspath(p) for p in inputs),
-                cfg.field_delim_regex,
-                cfg.get_int("skip.field.count", 1),
-                cfg.get("infreq.item.marker"),
-                cfg.get_int("tans.id.ord", 0))
+        silently serve ids read from the pinned source's column.
+
+        key-covered: fia.support.threshold fia.item.set.length
+        fia.max.item.set.length stream.block.size.mb — mining
+        parameters shape pass 2 only, and the block size shapes the
+        scan's tiling, never the parsed rows a warm source replays."""
+        from avenir_tpu.core.keys import source_tuple
+
+        return source_tuple(canonical, inputs,
+                            cfg.field_delim_regex,
+                            cfg.get_int("skip.field.count", 1),
+                            cfg.get("infreq.item.marker"),
+                            cfg.get_int("tans.id.ord", 0))
 
     def lookup(self, key: tuple):
         """EXCLUSIVE checkout of the pinned, still-content-valid source
@@ -455,11 +463,9 @@ class WarmStore:
         dir is marked IN USE until :meth:`release_dir`, so concurrent
         budget enforcement can never rmtree a dir another worker is
         actively checkpointing into."""
-        import hashlib
+        from avenir_tpu.core.keys import state_digest
 
-        digest = hashlib.blake2b(
-            "\0".join([canonical] + [os.path.abspath(p) for p in inputs])
-            .encode(), digest_size=8).hexdigest()
+        digest = state_digest(canonical, inputs)
         path = os.path.join(self.state_root, f"{canonical}_{digest}")
         with self._lock:
             self._dir_inuse[path] = self._dir_inuse.get(path, 0) + 1
@@ -1316,10 +1322,15 @@ class JobServer:
         """(key, path, dirpath) for every input sidecar a streamed batch
         could touch, resolved from each request's own config — the dir
         name bakes in schema/delimiter/block size, so two jobs over the
-        same file with different parse configs pin distinct entries."""
+        same file with different parse configs pin distinct entries.
+
+        key-covered: all — the dir basename is the sidecar view digest.
+        """
+        from avenir_tpu.core.keys import key_site
         from avenir_tpu.native import sidecar as sc
         from avenir_tpu.runner import _schema, stream_fold_ops
 
+        key_site("warm.sidecar.pin")
         out = []
         seen = set()
         for req in reqs:

@@ -23,13 +23,20 @@ Everything else about a profile is advisory and tolerated loosely.
 
 from __future__ import annotations
 
-import hashlib
 import json
 import os
 from typing import Dict, List, Optional, Sequence
 
 from avenir_tpu.core.atomic import publish_json, sweep_stale_tmps
+from avenir_tpu.core.keys import corpus_digest  # noqa: F401 — canonical
+#                          recipe moved to core.keys; re-exported for
+#                          this module's historical importers
 from avenir_tpu.tune.knobs import validate_knobs
+
+#: profile-file layout version; a profile stamped with a DIFFERENT
+#: version refuses to load (cold start) — old readers must never
+#: silently parse a newer layout
+FORMAT_VERSION = 1
 
 #: newest run-signal records a profile retains
 MAX_RUNS = 16
@@ -41,16 +48,6 @@ FOLD_COST_BLEND = 0.5
 #: default store directory name (next to the first input, like the
 #: incremental driver's .avenir_incremental)
 DEFAULT_DIR_NAME = ".avenir_tune"
-
-
-def corpus_digest(inputs: Sequence[str]) -> str:
-    """Stable identity of an input set: blake2b over the absolute paths
-    (the incremental state-dir recipe). Content-independent on purpose:
-    a profile is supposed to FOLLOW a corpus through appends — the
-    signals it holds age out of the window naturally."""
-    return hashlib.blake2b(
-        "\0".join(os.path.abspath(p) for p in inputs).encode(),
-        digest_size=8).hexdigest()
 
 
 def resolve_dir(cfg, inputs: Sequence[str]) -> str:
@@ -65,7 +62,8 @@ def resolve_dir(cfg, inputs: Sequence[str]) -> str:
 
 
 def _fresh(job: str, digest: str) -> Dict:
-    return {"format": 1, "job": job, "corpus_digest": digest,
+    return {"format": 1, "format_version": FORMAT_VERSION,
+            "job": job, "corpus_digest": digest,
             "knobs": {}, "reasons": [], "runs": [], "residuals": [],
             "fold_cost_ms": None}
 
@@ -103,6 +101,11 @@ class ProfileStore:
         except (OSError, ValueError):
             return None
         if not isinstance(prof, dict):
+            return None
+        if prof.get("format_version", FORMAT_VERSION) != FORMAT_VERSION:
+            # version-skewed profile: refuse to serve, go cold (a
+            # MISSING stamp is a pre-versioning profile and still
+            # loads — upgrading never invalidates on-disk state)
             return None
         prof["knobs"] = validate_knobs(dict(prof.get("knobs") or {}),
                                        source=path)

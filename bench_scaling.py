@@ -29,19 +29,21 @@ def graftlint_tripwire() -> dict:
     """Run the graftlint CLI (--json) over the package, the --ir
     manifest audit, the --flow concurrency/invariance audit, the
     --mem footprint audit, the --merge shard-merge/resume audit,
-    the --proto commit-point crash audit AND the --race deterministic
-    interleaving audit, failing the bench on any
+    the --proto commit-point crash audit, the --race deterministic
+    interleaving audit AND the --keys stale-serve perturbation
+    audit, failing the bench on any
     non-allowlisted finding, stale baseline entry, trace error, a
     distributed family whose collective payload drifted off the
     scaling.py analytic model, a streamed fold kernel whose output
     bytes moved with the chunk layout, a streamed job whose measured
     peak RSS left the memory model's tolerance band, a fold state
-    whose shard merge / checkpoint resume drifted a byte, or a
+    whose shard merge / checkpoint resume drifted a byte, a
     shared-filesystem commit site whose kill-injected recovery was
-    not byte-identical, or a cross-process interleave site with a
-    losable schedule — hazard/traffic/determinism/footprint/
-    merge-algebra/protocol/race regressions surface here every round,
-    not at the next 100M-row run. The
+    not byte-identical, a cross-process interleave site with a
+    losable schedule, or a cache key that stopped covering its view —
+    hazard/traffic/determinism/footprint/
+    merge-algebra/protocol/race/key regressions surface here every
+    round, not at the next 100M-row run. The
     round's memory manifest (the job server's admission oracle) is
     re-derived and written next to the STREAM_SCALE_*.json records."""
     import os
@@ -159,6 +161,26 @@ def graftlint_tripwire() -> dict:
         raise RuntimeError(
             f"interleaving audit regression: schedule space shrank "
             f"below 8 per site: {race_schedules}")
+    # keys leg (graftlint-keys): every registered cache-key site,
+    # each registered input dimension perturbed one at a time over a
+    # warm cache, must hold the key's contract — affecting moves the
+    # key with warm serve == cold recompute, neutral warm-hits
+    # byte-identically, a foreign format_version stamp goes cold —
+    # >= 10 sites every round, per-site perturbation counts recorded
+    # so a silently shrunken dimension set is visible
+    keys_rep = run(["--keys"], "--keys")
+    ka = keys_rep["key_audit"]
+    stale = [r["site"] for r in ka if not r["key_validated"]]
+    if stale or len(ka) < 10:
+        raise RuntimeError(
+            f"key-perturbation audit regression: {len(ka)} key sites "
+            f"audited, failed={stale}")
+    key_perturbations = {r["site"]: sum(r["perturbations"].values())
+                         for r in ka}
+    if min(key_perturbations.values()) < 2:
+        raise RuntimeError(
+            f"key-perturbation audit regression: dimension set shrank "
+            f"below 2 per site: {key_perturbations}")
     # span-coverage leg (avenir-trace): every registered stream entry,
     # run under a captured recorder, must emit the mandatory span set
     # (read/parse/fold/finish) — an instrumentation point lost in a
@@ -203,6 +225,10 @@ def graftlint_tripwire() -> dict:
             "race_allowlisted": race_rep["suppressed"],
             "interleave_sites_validated": len(ra),
             "race_schedules_per_site": race_schedules,
+            "keys_findings": 0,
+            "keys_allowlisted": keys_rep["suppressed"],
+            "key_sites_validated": len(ka),
+            "key_perturbations_per_site": key_perturbations,
             "span_coverage_validated": len(cov),
             "memory_manifest": "MEMORY_MANIFEST.json"}
 

@@ -1,0 +1,445 @@
+"""graftlint --keys: rules, key sites, the perturbation auditor.
+
+Four layers, mirroring the other tier test suites:
+
+- the GATE: the real cache surface is keys-clean and every registered
+  key site validates under one-dimension-at-a-time perturbation;
+- the REGISTRY: key_site annotations and KEY_SITES agree in both
+  directions, and a mismatch in either direction fails loudly;
+- the RULES: one bad/good fixture pair per static rule;
+- the AUDITOR: a deliberately under-keyed fixture cache FAILS with
+  both halves of the verdict (key blind to the dimension + stale
+  serve against the cold recompute), and the resulting
+  ``keys-stale-serve`` finding can never be allowlisted.
+
+Plus the byte-compatibility pins: the unified core.keys recipes must
+be byte-identical to the hand-maintained recipes they replaced, so an
+upgrade cannot invalidate a single on-disk cache.
+"""
+
+import hashlib
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from avenir_tpu.analysis import load_baseline
+from avenir_tpu.analysis.engine import BaselineEntry, run_paths
+from avenir_tpu.analysis.keys import (ALL_KEYS_RULES, KEY_SITES,
+                                      KEYS_AUDIT_RULE, DigestDriftRule,
+                                      KeyPerturb, KeySite,
+                                      KeysAuditError, MtimeValidityRule,
+                                      OverdigestedNeutralRule,
+                                      UndigestedInputRule,
+                                      UnversionedFormatRule, _memo_serve,
+                                      audit_keys, check_key_registry,
+                                      key_annotations, keys_rule_ids,
+                                      run_keys)
+from avenir_tpu.core.keys import (compat_tuple, corpus_digest,
+                                  is_view_neutral, sidecar_config_digest,
+                                  source_tuple, state_digest)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ------------------------------------------------------------------- gate
+def test_keys_gate_clean_and_all_sites_validated():
+    report = run_keys(baseline=load_baseline(), root=REPO)
+    assert not report.errors, [f.render() for f in report.errors]
+    assert not report.findings, "\n" + "\n".join(
+        f.render() for f in report.findings)
+    assert not report.stale, [e.key for e in report.stale]
+    audit = report.key_audit
+    # the N/N acceptance floor: every registered site, >= 10 of them
+    assert len(audit) == len(KEY_SITES) >= 10
+    bad = [a["site"] for a in audit if not a["key_validated"]]
+    assert not bad, (bad, audit)
+    for row in audit:
+        # real perturbations actually ran, and the row is anchored at
+        # the site's key_site annotation in the code
+        assert sum(row["perturbations"].values()) >= 2, row
+        assert row["failing_perturbation"] is None, row
+        assert row["path"].endswith(".py") and row["line"] > 1, row
+
+
+def test_key_registry_and_code_annotations_agree():
+    refs = key_annotations(REPO)
+    assert set(refs) == {site.name for site in KEY_SITES}
+    assert check_key_registry(REPO) == refs
+
+
+def test_registry_fails_on_dangling_site_entry(monkeypatch):
+    from avenir_tpu.analysis import keys as keys_mod
+
+    ghost = KeySite("ghost.site", "nowhere.py",
+                    lambda root: None, lambda root: [],
+                    lambda root: [])
+    monkeypatch.setattr(keys_mod, "KEY_SITES",
+                        list(KEY_SITES) + [ghost])
+    with pytest.raises(KeysAuditError, match="ghost.site"):
+        check_key_registry(REPO)
+
+
+def test_registry_fails_on_unregistered_annotation(monkeypatch):
+    from avenir_tpu.analysis import keys as keys_mod
+
+    # dropping the ledger.committed entry leaves its key_site
+    # annotation in dist/ledger.py orphaned — the cross-check must
+    # refuse (an unperturbed key site is an unproven key)
+    pruned = [s for s in KEY_SITES if s.name != "ledger.committed"]
+    monkeypatch.setattr(keys_mod, "KEY_SITES", pruned)
+    with pytest.raises(KeysAuditError, match="ledger.committed"):
+        check_key_registry(REPO)
+
+
+# ------------------------------------------------- fixture corpus helpers
+def _lint(tmp_path, source, rule_cls, name="snippet.py"):
+    p = tmp_path / name
+    p.write_text(source)
+    report = run_paths([str(p)], rules=[rule_cls()], baseline=[],
+                       root=str(tmp_path))
+    assert not report.errors, [f.render() for f in report.errors]
+    return report.findings
+
+
+_UNDIG_BAD = """
+def cache_key(cfg):
+    return cfg.get("field.delim.in", ",")
+
+
+def serve(cfg, store, path):
+    key = cache_key(cfg)
+    skip = cfg.get_int("skip.field.count", 1)   # not in the key
+    if key in store:
+        return store[key]
+    store[key] = parse(path, key, skip)
+    return store[key]
+"""
+
+_UNDIG_GOOD = """
+def cache_key(cfg):
+    return (cfg.get("field.delim.in", ","),
+            cfg.get_int("skip.field.count", 1))
+
+
+def serve(cfg, store, path):
+    key = cache_key(cfg)
+    skip = cfg.get_int("skip.field.count", 1)
+    if key in store:
+        return store[key]
+    store[key] = parse(path, key, skip)
+    return store[key]
+"""
+
+
+def test_undigested_input_fires_on_bad(tmp_path):
+    findings = _lint(tmp_path, _UNDIG_BAD, UndigestedInputRule)
+    assert {f.rule for f in findings} == {"keys-undigested-input"}
+    assert len(findings) == 1
+    assert "skip.field.count" in findings[0].message
+
+
+def test_undigested_input_silent_when_key_folds_it(tmp_path):
+    assert _lint(tmp_path, _UNDIG_GOOD, UndigestedInputRule) == []
+
+
+_OVER_BAD = """
+import hashlib
+
+
+def conf_key(cfg):
+    h = hashlib.sha1()
+    for k in ("field.delim.in", "stream.autotune.dir"):
+        h.update(str(cfg.get(k, "")).encode())
+    return h.hexdigest()
+"""
+
+_OVER_GOOD = """
+import hashlib
+
+
+def conf_key(cfg):
+    h = hashlib.sha1()
+    for k in sorted(cfg.props):
+        if "stream.autotune" in k:
+            continue                   # the sanctioned skip guard
+        h.update(f"{k}={cfg.props[k]}".encode())
+    return h.hexdigest()
+"""
+
+
+def test_overdigested_neutral_fires_on_bad(tmp_path):
+    findings = _lint(tmp_path, _OVER_BAD, OverdigestedNeutralRule)
+    assert {f.rule for f in findings} == {"keys-overdigested-neutral"}
+    assert "stream.autotune.dir" in findings[0].message
+
+
+def test_overdigested_neutral_silent_on_skip_guard(tmp_path):
+    assert _lint(tmp_path, _OVER_GOOD, OverdigestedNeutralRule) == []
+
+
+_MTIME_BAD = """
+import os
+
+
+def cache_valid(path, stamp):
+    return os.path.getmtime(path) == stamp
+"""
+
+_MTIME_GOOD = """
+import os
+import time
+
+
+def cache_age_s(path):
+    return time.time() - os.path.getmtime(path)   # a duration, not validity
+"""
+
+
+def test_mtime_validity_fires_on_bad(tmp_path):
+    findings = _lint(tmp_path, _MTIME_BAD, MtimeValidityRule)
+    assert {f.rule for f in findings} == {"keys-mtime-validity"}
+
+
+def test_mtime_validity_silent_on_age_arithmetic(tmp_path):
+    assert _lint(tmp_path, _MTIME_GOOD, MtimeValidityRule) == []
+
+
+_FMT_BAD = """
+import json
+
+
+def write_manifest(path, blocks, digest):
+    man = {"blocks": blocks, "digest": digest, "delim": ","}
+    with open(path, "w") as fh:
+        json.dump(man, fh)
+"""
+
+_FMT_GOOD = """
+import json
+
+
+def write_manifest(path, blocks, digest):
+    man = {"format_version": 1, "blocks": blocks, "digest": digest,
+           "delim": ","}
+    with open(path, "w") as fh:
+        json.dump(man, fh)
+"""
+
+
+def test_unversioned_format_fires_on_bad(tmp_path):
+    findings = _lint(tmp_path, _FMT_BAD, UnversionedFormatRule)
+    assert {f.rule for f in findings} == {"keys-unversioned-format"}
+    # the dump-sink and builder-name branches dedup to ONE finding
+    assert len(findings) == 1
+
+
+def test_unversioned_format_silent_when_stamped(tmp_path):
+    assert _lint(tmp_path, _FMT_GOOD, UnversionedFormatRule) == []
+
+
+_DRIFT_BAD = """
+import hashlib
+import os
+
+
+def source_key(corpus):
+    return hashlib.sha1(os.path.abspath(corpus).encode()).hexdigest()
+
+
+def pin_key(corpus, delim):
+    return (corpus, delim)
+"""
+
+_DRIFT_GOOD = '''
+import hashlib
+import os
+
+
+def source_key(corpus):
+    """normalization: abspath — paths fold as ``os.path.abspath``."""
+    return hashlib.sha1(os.path.abspath(corpus).encode()).hexdigest()
+
+
+def pin_key(corpus, delim):
+    """normalization: bare — the caller pre-normalizes."""
+    return (corpus, delim)
+'''
+
+
+def test_digest_drift_fires_on_bad(tmp_path):
+    findings = _lint(tmp_path, _DRIFT_BAD, DigestDriftRule)
+    assert {f.rule for f in findings} == {"keys-digest-drift"}
+    assert "corpus" in findings[0].message
+
+
+def test_digest_drift_silent_on_declared_normalization(tmp_path):
+    assert _lint(tmp_path, _DRIFT_GOOD, DigestDriftRule) == []
+
+
+def test_every_keys_rule_has_corpus_coverage():
+    covered = {"keys-undigested-input", "keys-overdigested-neutral",
+               "keys-mtime-validity", "keys-unversioned-format",
+               "keys-digest-drift"}
+    assert {r.rule_id for r in ALL_KEYS_RULES} == covered
+    assert set(keys_rule_ids()) == covered | {KEYS_AUDIT_RULE}
+
+
+# --------------------------------------- the deliberately under-keyed site
+def _fix_conf(root):
+    with open(os.path.join(root, "conf.json"), encoding="utf-8") as fh:
+        return json.load(fh)
+
+
+def _fix_seed(root):
+    # rows whose comma-counts and semicolon-counts DIFFER, so a
+    # delimiter change moves the served bytes
+    with open(os.path.join(root, "corpus.csv"), "w",
+              encoding="utf-8") as fh:
+        fh.write("a,b,c;d\ne,f;g;h\n")
+    with open(os.path.join(root, "conf.json"), "w",
+              encoding="utf-8") as fh:
+        json.dump({"delim": ","}, fh)
+
+
+def _fix_key(root):
+    # the BUG under test: the delimiter is a registered dimension the
+    # key never folds
+    with open(os.path.join(root, "corpus.csv"), "rb") as fh:
+        return [hashlib.sha1(fh.read()).hexdigest()]
+
+
+def _fix_serve(root):
+    delim = _fix_conf(root)["delim"]
+
+    def compute():
+        with open(os.path.join(root, "corpus.csv"),
+                  encoding="utf-8") as fh:
+            return [line.count(delim)
+                    for line in fh.read().splitlines()]
+    return _memo_serve(root, "memo.json", _fix_key(root), compute)
+
+
+def _fix_set_delim(root):
+    conf = _fix_conf(root)
+    conf["delim"] = ";"
+    with open(os.path.join(root, "conf.json"), "w",
+              encoding="utf-8") as fh:
+        json.dump(conf, fh)
+
+
+_BAD_KEY_SITE = KeySite(
+    name="fixture.underkeyed", path="fixture.py",
+    seed=_fix_seed, key=_fix_key, serve=_fix_serve,
+    perturbs=(KeyPerturb("conf:delim", "affecting", _fix_set_delim),))
+
+
+def test_auditor_fails_an_underkeyed_cache():
+    rows, findings = audit_keys(sites=[_BAD_KEY_SITE])
+    assert len(rows) == 1 and rows[0]["site"] == "fixture.underkeyed"
+    assert rows[0]["key_validated"] is False
+    assert rows[0]["failing_perturbation"] \
+        == "fixture.underkeyed:conf:delim"
+    assert len(findings) == 1 and findings[0].rule == KEYS_AUDIT_RULE
+    # the verdict is CONCRETE: the key is blind to the dimension AND
+    # the warm cache replayed yesterday's bytes
+    assert "left the key unchanged" in findings[0].message
+    assert "stale serve" in findings[0].message
+
+
+def test_stale_serve_findings_are_never_baselinable(tmp_path):
+    clean = tmp_path / "clean.py"
+    clean.write_text("x = 1\n")
+    report = run_keys(
+        paths=[str(clean)],
+        baseline=[BaselineEntry(
+            f"fixture.py::{KEYS_AUDIT_RULE}::fixture.underkeyed",
+            "trying to allowlist a stale serve", 1)],
+        root=str(tmp_path), sites=[_BAD_KEY_SITE])
+    # the allowlist entry is ignored: the audit finding still fails
+    assert [f.rule for f in report.findings] == [KEYS_AUDIT_RULE]
+    assert not report.suppressed
+
+
+def test_keys_findings_roundtrip_through_baseline(tmp_path):
+    p = tmp_path / "mod.py"
+    p.write_text(_MTIME_BAD)
+    key = "mod.py::keys-mtime-validity::cache_valid"
+    report = run_keys(paths=[str(p)], baseline=[
+        BaselineEntry(key, "fixture", 1)], root=str(tmp_path),
+        audit=False)
+    assert not report.findings and len(report.suppressed) == 1
+
+    p.write_text(_MTIME_GOOD)
+    report = run_keys(paths=[str(p)], baseline=[
+        BaselineEntry(key, "fixture", 1)], root=str(tmp_path),
+        audit=False)
+    assert [e.key for e in report.stale] == [key]
+
+
+# ------------------------------------------- byte-compatibility pins
+def test_digest_recipes_are_byte_identical_to_their_predecessors():
+    # the unified core.keys recipes replaced six hand-maintained ones;
+    # these pins are the upgrade contract: NOT ONE on-disk cache may
+    # invalidate when the recipe moves home
+    assert sidecar_config_digest(1, "bytes", ",", 2048, ("skip", 2)) \
+        == "d83fe01ef93cb869bb0ca79f9dbbadc7ee340bc0"
+    assert state_digest("frequentItemsApriori", ["/a/x.csv"]) \
+        == "3904f7371db9aa5d"
+    assert corpus_digest(["/a/x.csv"]) == "c6baf3fb1fb84e70"
+    assert compat_tuple("stream", ["/a/x.csv"], "bytes", 0.5, ",",
+                        None) \
+        == ("stream", ("/a/x.csv",), "bytes", 0.5, ",", None)
+    assert source_tuple("frequentItemsApriori", ["/a/x.csv"], ",", 1,
+                        None, 0) \
+        == ("frequentItemsApriori", ("/a/x.csv",), ",", 1, None, 0)
+
+
+def test_view_neutral_registry_matches_historical_semantics():
+    assert is_view_neutral("stream.autotune.dir")
+    assert is_view_neutral("stream.autotune.record")
+    assert is_view_neutral("stream.incremental.state.dir")
+    assert not is_view_neutral("stream.block.size.mb")
+    assert not is_view_neutral("field.delim.in")
+
+
+# -------------------------------------------------------------------- CLI
+def _cli(args, cwd=REPO, env=None):
+    e = dict(os.environ)
+    if env:
+        e.update(env)
+    return subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "graftlint.py")]
+        + args, capture_output=True, text=True, cwd=cwd, timeout=600,
+        env=e)
+
+
+def test_cli_keys_exit_code_contract_and_schema(tmp_path):
+    # bad fixture + rule subset (audit skipped -> fast): findings = 1
+    (tmp_path / "bad.py").write_text(_MTIME_BAD)
+    proc = _cli(["--keys", "bad.py", "--rules",
+                 "keys-mtime-validity", "--no-baseline", "--json"],
+                cwd=str(tmp_path))
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    rep = json.loads(proc.stdout)
+    assert rep["counts"] == {"keys-mtime-validity": 1}
+    assert rep["key_audit"] == []             # subset skipped the audit
+    # one schema across all modes: same top-level keys as the golden
+    golden = json.load(open(os.path.join(
+        REPO, "tests", "data", "graftlint_json_golden.json")))
+    assert set(rep) == set(golden)
+    assert "key_audit" in golden
+
+    # good twin: clean = 0
+    (tmp_path / "good.py").write_text(_MTIME_GOOD)
+    proc = _cli(["--keys", "good.py", "--rules",
+                 "keys-mtime-validity", "--no-baseline"],
+                cwd=str(tmp_path))
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+    # usage errors = 2: unknown rule, mixed tiers
+    assert _cli(["--keys", "--rules", "nope"]).returncode == 2
+    assert _cli(["--keys", "--race"]).returncode == 2
+    assert _cli(["--keys", "--ir"]).returncode == 2

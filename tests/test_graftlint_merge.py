@@ -419,7 +419,7 @@ def test_cli_all_worst_of_exit_and_combined_schema(tmp_path):
     rep = json.loads(proc.stdout)
     assert set(rep) == {"modes", "clean"} and rep["clean"] is False
     assert set(rep["modes"]) == {"ast", "ir", "flow", "mem", "merge",
-                                 "proto", "race"}
+                                 "proto", "race", "keys"}
     assert rep["modes"]["ir"] == {"skipped": True}
     assert rep["modes"]["merge"]["counts"] == {"merge-missing-op": 1}
 

@@ -549,7 +549,7 @@ def test_cli_race_exit_code_contract_and_schema(tmp_path):
                 cwd=str(tmp_path)).returncode == 2
 
 
-def test_cli_all_parallel_fans_out_seven_tiers(tmp_path):
+def test_cli_all_parallel_fans_out_eight_tiers(tmp_path):
     # a cross-tier rule subset keeps the fan-out fast: only the two
     # named tiers run (as subprocesses), the rest report skipped, and
     # per-tier wall_s lands in the combined JSON
@@ -561,8 +561,8 @@ def test_cli_all_parallel_fans_out_seven_tiers(tmp_path):
     rep = json.loads(proc.stdout)
     assert set(rep) == {"modes", "clean"} and rep["clean"] is False
     assert set(rep["modes"]) == {"ast", "ir", "flow", "mem", "merge",
-                                 "proto", "race"}
-    for name in ("ir", "flow", "mem", "merge", "proto"):
+                                 "proto", "race", "keys"}
+    for name in ("ir", "flow", "mem", "merge", "proto", "keys"):
         assert rep["modes"][name] == {"skipped": True}
     assert rep["modes"]["race"]["counts"] == {"race-check-then-act": 1}
     for name in ("ast", "race"):

@@ -8,7 +8,8 @@
     python tools/graftlint.py --merge [--json]  # merge algebra + audit
     python tools/graftlint.py --proto [--json]  # protocol + crash audit
     python tools/graftlint.py --race [--json]   # race rules + interleavings
-    python tools/graftlint.py --all [--json]    # all seven tiers, worst-of
+    python tools/graftlint.py --keys [--json]   # key rules + perturbations
+    python tools/graftlint.py --all [--json]    # all eight tiers, worst-of
     python tools/graftlint.py --all --parallel  # same, tiers as subprocesses
 
 A failing --race schedule prints a replayable trace; replay it with
