@@ -1351,6 +1351,94 @@ def _led_stamp(root: str) -> None:
                        STATES_FORMAT))
 
 
+# -------------------------------------------------- score model driver
+def _score_model_path(root: str) -> str:
+    return _p(root, "mst_model.txt")
+
+
+def _score_conf(root: str) -> Dict[str, str]:
+    """The SCORING view of conf.json — the knobs model_tuple folds as
+    kind dims (the same names the batch classifier reads)."""
+    conf = _conf(root)
+    return {"field.delim": ",",
+            "class.labels": conf.get("class.labels", "T,F"),
+            "log.odds.threshold": conf.get("log.odds.threshold", "0"),
+            "skip.field.count": conf.get("skip.field.count", "2")}
+
+
+def _score_train(root: str) -> None:
+    from avenir_tpu.runner import run_job
+
+    run_job("markovStateTransitionModel", dict(_MST_CONF),
+            [_corpus_path(root)], output=_score_model_path(root))
+
+
+def _score_seed(root: str) -> None:
+    _write(_p(root, "corpus.csv"), "\n".join(_seq_rows(0, 120)) + "\n")
+    _write(_p(root, "meta.json"), json.dumps({"corpus": "corpus.csv"}))
+    _write(_p(root, "conf.json"),
+           json.dumps({"class.labels": "T,F",
+                       "log.odds.threshold": "0",
+                       "skip.field.count": "2"}, indent=1))
+    _score_train(root)
+
+
+def _score_key(root: str):
+    from avenir_tpu.server.score import model_cache_key
+
+    return list(model_cache_key("markov", _score_model_path(root),
+                                _score_conf(root)))
+
+
+def _score_rows(root: str) -> List[str]:
+    with open(_corpus_path(root), encoding="utf-8") as fh:
+        return [ln.strip() for ln in fh if ln.strip()][:3]
+
+
+def _score_serve(root: str):
+    from avenir_tpu.models.artifact import ModelFormatSkew
+    from avenir_tpu.server.score import score_once
+
+    model, conf = _score_model_path(root), _score_conf(root)
+
+    def compute():
+        try:
+            return [score_once("markov", model, row, conf)
+                    for row in _score_rows(root)]
+        except ModelFormatSkew:
+            # the documented recovery for a version-skewed artifact:
+            # REFUSE the load, go cold — retrain over the current
+            # corpus (save restamps at this build's version) and score
+            # the fresh artifact
+            _score_train(root)
+            return [score_once("markov", model, row, conf)
+                    for row in _score_rows(root)]
+
+    return _memo_serve(root, "scorecache.json", _score_key(root), compute)
+
+
+def _score_retrain(root: str) -> None:
+    # the seed walks L->M->H cyclically; these walk H->M->L, so the
+    # transition mass actually moves and the artifact BYTES change
+    # (an append that re-trains to the same matrix is not a retrain)
+    rows = [f"x{i}," + ("T" if i % 2 else "F") + ","
+            + _DELIM.join(("H", "M", "L")[(i + j) % 3]
+                          for j in range(6))
+            for i in range(30)]
+    _append_corpus_rows(root, rows)
+    _score_train(root)
+
+
+def _score_touch_model(root: str) -> None:
+    os.utime(_score_model_path(root), (946684800, 946684800))
+
+
+def _score_stamp(root: str) -> None:
+    from avenir_tpu.models.artifact import stamp_path
+
+    _stamp_manifest(stamp_path(_score_model_path(root)))
+
+
 # --------------------------------------------------------- the registry
 def _perturb(name: str, kind: str,
              apply: Callable[[str], None]) -> KeyPerturb:
@@ -1533,6 +1621,24 @@ KEY_SITES: List[KeySite] = [
             _perturb("corpus:mtime", "neutral", _touch_corpus),
         ),
         warm_proof=_enc_warm_proof),
+    # The served-model warm identity (the score plane's ModelCache):
+    # artifact CONTENT digest + stamped format version + classifier
+    # dims — a retrain or a conf change misses, an mtime touch hits,
+    # a foreign restamp refuses-and-goes-cold (retrain + restamp).
+    KeySite(
+        name="score.model",
+        path="avenir_tpu/core/keys.py",
+        seed=_score_seed,
+        key=_score_key,
+        serve=_score_serve,
+        perturbs=(
+            _perturb("model:retrain", "affecting", _score_retrain),
+            _perturb("conf:log.odds.threshold", "affecting",
+                     _set("log.odds.threshold", "5")),
+            _perturb("model:mtime", "neutral", _score_touch_model),
+            _perturb("stamp:format_version", "format", _score_stamp),
+        ),
+        warm_proof=_memo_proof("scorecache.json", _score_serve)),
     # The ledger committed-state identity: the path IS the key
     # (namespace + block id), first-commit-wins pins the bytes; the
     # committing worker's id is the registered neutral dimension.

@@ -99,7 +99,8 @@ def default_proto_paths(root: str) -> List[str]:
              os.path.join("avenir_tpu", "native", "sidecar.py"),
              os.path.join("avenir_tpu", "core", "incremental.py"),
              os.path.join("avenir_tpu", "core", "atomic.py"),
-             os.path.join("avenir_tpu", "tune", "store.py")]
+             os.path.join("avenir_tpu", "tune", "store.py"),
+             os.path.join("avenir_tpu", "server", "score.py")]
     return [p for p in (os.path.join(root, n) for n in names)
             if os.path.exists(p)]
 
@@ -751,6 +752,20 @@ def _run_profile_save(root: str) -> None:
         "audit", "deadbeef", {}, ["proto audit"])
 
 
+def _run_score_reward(root: str) -> None:
+    from avenir_tpu.server.score import append_reward
+    artifact = os.path.join(root, "bandit_stats.csv")
+    try:
+        with open(artifact, "x") as fh:     # EAFP: re-run keeps the file
+            fh.write("g1,i1,5,2.0\ng1,i2,3,4.0\n")
+    except FileExistsError:
+        pass
+    # the nonce makes the recovery (re-running the append) idempotent:
+    # an entry that already committed dedupes instead of doubling
+    append_reward(artifact, "g1", "i2", 7.0, count=1,
+                  nonce="proto-audit-reward")
+
+
 def _run_sidecar_manifest(root: str) -> None:
     from avenir_tpu.native.sidecar import FORMAT, _write_manifest
     dirpath = os.path.join(root, "sc")
@@ -788,6 +803,8 @@ COMMIT_SITES: List[CommitSite] = [
                _run_profile_save),
     CommitSite("sidecar.manifest", "avenir_tpu/native/sidecar.py",
                _run_sidecar_manifest),
+    CommitSite("score.reward", "avenir_tpu/server/score.py",
+               _run_score_reward),
 ]
 
 

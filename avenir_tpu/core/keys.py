@@ -189,3 +189,34 @@ def source_tuple(canonical: str, inputs: Sequence[str], delim: str,
             int(skip),
             marker,
             int(tid_ord))
+
+
+def model_tuple(kind: str, path: str, artifact_digest: str,
+                schema_digest: str, format_version: int,
+                dims: Sequence) -> tuple:
+    """Warm identity of a SERVED model (the score plane's model cache,
+    server/score.py): the scoreable family, the artifact path and its
+    CONTENT digest (a retrained artifact under the same path is a
+    different model — the cache must miss, never serve the old fit),
+    the schema digest shaping feature encoding ('' for families that
+    parse without one), the artifact's stamped ``format_version`` (0
+    when unstamped — a foreign restamp must miss, not hit a warm entry
+    loaded under the old layout), and the kind dims: the loader/
+    classifier config that shapes the in-memory object (delimiter,
+    class labels, threshold, bandit journal digest, ...). Request-time
+    parameters (the row, bandit round/algorithm) deliberately EXCLUDED
+    — one warm model serves any request over the artifact.
+
+    normalization: abspath — the artifact path folds as
+    ``os.path.abspath``; dims fold as a tuple of strings.
+    key-covered: score.batch.window.ms score.batch.max
+    score.cache.budget.mb — dispatch shaping and cache budget knobs
+    change HOW a model is served, never WHAT it computes.
+    """
+    key_site("score.model")
+    return (kind,
+            os.path.abspath(path),
+            artifact_digest,
+            schema_digest,
+            int(format_version),
+            tuple(str(d) for d in dims))

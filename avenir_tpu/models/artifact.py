@@ -1,0 +1,119 @@
+"""Format stamps for served model artifacts (PR 19's manifest contract
+extended to models).
+
+A trained artifact (NB distribution file, fisher boundary table, markov
+transition matrix, bandit group stats) is a delimited text file whose
+bytes the batch jobs own. Serving those artifacts from a long-lived
+process adds a failure mode batch never had: a *newer writer* with a
+*newer layout* can replace the file under a warm server, and the server
+would happily parse tomorrow's format with today's parser. Cache
+manifests solved this with an embedded ``format_version``; model
+artifacts cannot embed one without breaking every existing reader
+(``MarkovStateTransitionModel.load`` treats line 0 as the states line),
+so the stamp rides in an atomic *sidecar*: ``<artifact>.stamp.json``
+holding the format version and a content digest.
+
+Contract (mirrors the cache-manifest rules):
+
+- **unstamped loads** — a pre-existing artifact with no sidecar is a
+  legacy artifact; loaders accept it unverified (the batch jobs' own
+  trust model).
+- **stamped-and-current loads verified** — the digest is recomputed at
+  load; a mismatch means the artifact changed under its stamp (torn
+  replace, partial copy) and the load REFUSES.
+- **stamped-but-foreign refuses** — a ``format_version`` this build
+  does not speak raises :class:`ModelFormatSkew`; the caller goes cold
+  (retrain / re-fetch), never parses blind.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+from typing import Optional
+
+from avenir_tpu.core.atomic import publish_json
+
+#: layout generation of the delimited model artifacts this build writes
+MODEL_FORMAT_VERSION = 1
+
+_STAMP_SUFFIX = ".stamp.json"
+
+
+class ModelFormatSkew(RuntimeError):
+    """A model artifact's stamp names a format this build does not
+    speak (or its digest no longer matches the bytes): refuse the load
+    and go cold rather than parse a foreign layout."""
+
+
+def stamp_path(path: str) -> str:
+    return path + _STAMP_SUFFIX
+
+
+def file_digest(path: str) -> str:
+    """Content digest of one artifact file (sha1, hex)."""
+    h = hashlib.sha1()
+    with open(path, "rb") as fh:
+        for chunk in iter(lambda: fh.read(1 << 20), b""):
+            h.update(chunk)
+    return h.hexdigest()
+
+
+def write_stamp(path: str) -> str:
+    """Publish the sidecar stamp for an artifact that was just written.
+    Atomic (tmp + rename), so a reader never sees a torn stamp."""
+    return publish_json({"format_version": MODEL_FORMAT_VERSION,
+                         "digest": file_digest(path)}, stamp_path(path))
+
+
+def read_stamp(path: str) -> Optional[dict]:
+    """The artifact's stamp document, or None when unstamped (legacy).
+    An unreadable/unparseable stamp is skew, not absence — a present
+    sidecar that cannot be trusted must not be shrugged off."""
+    try:
+        with open(stamp_path(path)) as fh:
+            return json.load(fh)
+    except FileNotFoundError:
+        return None
+    except (OSError, ValueError) as exc:
+        raise ModelFormatSkew(
+            f"unreadable stamp beside {path}: {exc}") from exc
+
+
+def stamp_version(path: str) -> int:
+    """The stamped format version, 0 for unstamped legacy artifacts —
+    a cache-key dimension (a restamp to a foreign version must miss)."""
+    stamp = read_stamp(path)
+    return int(stamp.get("format_version", 0)) if stamp else 0
+
+
+def verify_stamp(path: str) -> Optional[dict]:
+    """Digest-verified load gate. Returns the stamp (None when
+    unstamped); raises :class:`ModelFormatSkew` when the stamp is
+    present but names a foreign format or no longer matches the
+    artifact bytes."""
+    stamp = read_stamp(path)
+    if stamp is None:
+        return None
+    version = stamp.get("format_version")
+    if version != MODEL_FORMAT_VERSION:
+        raise ModelFormatSkew(
+            f"{path}: stamped format_version={version!r}, this build "
+            f"speaks {MODEL_FORMAT_VERSION} — refusing to parse a "
+            f"foreign layout (retrain or upgrade)")
+    digest = file_digest(path)
+    if stamp.get("digest") != digest:
+        raise ModelFormatSkew(
+            f"{path}: artifact digest {digest[:12]} does not match its "
+            f"stamp {str(stamp.get('digest'))[:12]} — artifact changed "
+            f"under its stamp")
+    return stamp
+
+
+def rm_stamp(path: str) -> None:
+    """Drop the sidecar (used when an artifact is removed)."""
+    try:
+        os.remove(stamp_path(path))
+    except FileNotFoundError:
+        pass

@@ -113,14 +113,46 @@ class FisherDiscriminant:
     def predict(self, ds: Dataset, ordinal: int) -> np.ndarray:
         """Classify by the single-feature boundary: class 1 iff the value is
         on class 1's mean side of the boundary."""
-        x = ds.column(ordinal).astype(np.float64)
+        return self.predict_values(ordinal,
+                                   ds.column(ordinal).astype(np.float64))
+
+    def predict_values(self, ordinal: int, x: np.ndarray) -> np.ndarray:
+        """Vectorized entry point over raw float64 values — the math
+        :meth:`predict` applies to a Dataset column, shared with the
+        online scoring path so batch and per-request classifications
+        can never drift (each comparison is per-row, so the result is
+        invariant to batch composition by construction)."""
+        x = np.asarray(x, np.float64)
         b = self.boundaries[ordinal]
         m0, m1 = self.means[ordinal]
         side = x >= b if m1 >= m0 else x < b
         return side.astype(np.int32)
 
-    def save(self, path: str, delim: str = ",") -> None:
+    def save(self, path: str, delim: str = ",", stamp: bool = True) -> None:
+        """``stamp`` publishes the format/digest sidecar the serving
+        path verifies at load (models/artifact.py)."""
         with open(path, "w") as fh:
             for ordn, b in self.boundaries.items():
                 m0, m1 = self.means[ordn]
                 fh.write(f"{ordn}{delim}{b:.6f}{delim}{m0:.6f}{delim}{m1:.6f}\n")
+        if stamp:
+            from avenir_tpu.models.artifact import write_stamp
+            write_stamp(path)
+
+    @classmethod
+    def load(cls, path: str, delim: str = ",") -> "FisherDiscriminant":
+        """Read a saved boundary table back into a servable
+        discriminant (digest-verified when a stamp sidecar exists; the
+        train-side moments are not persisted, so a loaded model only
+        predicts)."""
+        from avenir_tpu.models.artifact import verify_stamp
+        verify_stamp(path)
+        fd = cls()
+        with open(path) as fh:
+            for ln in fh:
+                if not ln.strip():
+                    continue
+                ordn, b, m0, m1 = ln.rstrip("\n").split(delim)[:4]
+                fd.boundaries[int(ordn)] = float(b)
+                fd.means[int(ordn)] = (float(m0), float(m1))
+        return fd

@@ -229,13 +229,15 @@ class MarkovStateTransitionModel:
 
     # ------------------------------------------------------------- file IO
     def save(self, path: str, delim: str = ",",
-             marker: str = "classLabel") -> None:
+             marker: str = "classLabel", stamp: bool = True) -> None:
         """Reference text format: states line, then (per class) matrix rows,
         class sections marked 'classLabel:<v>'. The per-entity Spark
         variant (spark/sequence/MarkovStateTransitionModel.scala:34, one
         matrix per entity key) writes the same shape with 'entity:<key>'
         section markers — the adaptation of its (Record key, matrix)
-        saveAsTextFile pairs to the Hadoop job's single-file format."""
+        saveAsTextFile pairs to the Hadoop job's single-file format.
+        ``stamp`` publishes the format/digest sidecar the serving path
+        verifies at load (models/artifact.py)."""
         with open(path, "w") as fh:
             fh.write(delim.join(self.states) + "\n")
             if self.class_labels:
@@ -246,10 +248,15 @@ class MarkovStateTransitionModel:
             else:
                 for row in self.matrix():
                     fh.write(delim.join(str(int(v)) for v in row) + "\n")
+        if stamp:
+            from avenir_tpu.models.artifact import write_stamp
+            write_stamp(path)
 
     @classmethod
     def load(cls, path: str, delim: str = ",", scale: int = 1000
              ) -> "MarkovStateTransitionModel":
+        from avenir_tpu.models.artifact import verify_stamp
+        verify_stamp(path)
         with open(path) as fh:
             lines = [ln.rstrip("\n") for ln in fh if ln.strip()]
         states = lines[0].split(delim)
@@ -290,13 +297,24 @@ class MarkovModelClassifier:
         )
 
     def predict(self, seqs: Sequence[Sequence[str]]) -> Tuple[np.ndarray, np.ndarray]:
-        """Returns (class strings, log-odds scores)."""
+        """Returns (class strings, log-odds scores).
+
+        The per-row score accumulates transition log odds STRICTLY in
+        sequence order (column-wise f32 host reduction). A tree-shaped
+        ``sum`` over the padded axis regroups the addends whenever the
+        batch's pad width changes, so the same sequence could score
+        differently alone vs batched — the online scoring path
+        (server/score.py) coalesces arbitrary request mixes into one
+        vectorized call and demultiplexes, which is only sound because
+        this reduction is invariant to batch composition and padding."""
         padded, _ = encode_sequences(seqs, self.model.states)
-        padded = jnp.asarray(padded)
         prev, nxt = padded[:, :-1], padded[:, 1:]
         valid = (prev >= 0) & (nxt >= 0)
-        lo = self.log_odds[jnp.maximum(prev, 0), jnp.maximum(nxt, 0)]
-        score = np.asarray(jnp.sum(jnp.where(valid, lo, 0.0), axis=1))
+        lo_np = np.asarray(self.log_odds)
+        lo = lo_np[np.maximum(prev, 0), np.maximum(nxt, 0)]
+        score = np.zeros(len(seqs), np.float32)
+        for t in range(lo.shape[1]):
+            score = np.where(valid[:, t], score + lo[:, t], score)
         pred = np.where(score > self.threshold, self.pos_class, self.neg_class)
         return pred, score
 

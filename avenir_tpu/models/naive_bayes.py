@@ -268,12 +268,19 @@ class NaiveBayesModel:
             )
         return "\n".join(out) + "\n"
 
-    def save(self, path: str, delim: str = ",") -> None:
+    def save(self, path: str, delim: str = ",", stamp: bool = True) -> None:
+        """``stamp`` publishes the format/digest sidecar the serving
+        path verifies at load (models/artifact.py)."""
         with open(path, "w") as fh:
             fh.write(self.to_csv(delim))
+        if stamp:
+            from avenir_tpu.models.artifact import write_stamp
+            write_stamp(path)
 
     @classmethod
     def load(cls, path: str, schema: FeatureSchema, delim: str = ",") -> "NaiveBayesModel":
+        from avenir_tpu.models.artifact import verify_stamp
+        verify_stamp(path)
         # the model file is self-describing (the reference's BayesianModel
         # is built from the file alone, BayesianPredictor.java:332-340):
         # class values and categorical feature bins it mentions extend any

@@ -92,6 +92,109 @@ def affinity_key(request) -> Tuple:
             tuple(os.path.abspath(p) for p in request.inputs))
 
 
+def score_affinity_key(kind: str, model: str) -> Tuple:
+    """The QUERY path's sticky key: the model identity. A host that
+    scored an artifact holds it loaded in its ModelCache (and its
+    jitted predict compiled), so repeat scores are cheapest exactly
+    there — the same warmth argument ``affinity_key`` makes for
+    corpora, at model granularity. Request row / round / conf may vary
+    without moving the model off its warm host (they are excluded from
+    ``core.keys.model_tuple`` for the same reason)."""
+    return ("score", kind, os.path.abspath(model))
+
+
+class ScoreFront:
+    """Model-affinity fan-out for ``POST /score`` across listener
+    URLs: every score places through an :class:`AffinityRouter` keyed
+    by :func:`score_affinity_key`, so one artifact's queries pin to
+    one host's warm ModelCache while distinct models spread across the
+    fleet. One persistent HTTP/1.1 connection per (thread, host) —
+    the keep-alive socket is what keeps per-score transport cost below
+    the score itself."""
+
+    def __init__(self, urls: Sequence[str],
+                 budgets: Optional[Sequence[int]] = None):
+        if not urls:
+            raise ValueError("score front needs at least one listener")
+        self.urls = [u.rstrip("/") for u in urls]
+        self.router = AffinityRouter(
+            list(budgets) if budgets else [1 << 30] * len(self.urls))
+        self._local = threading.local()
+
+    def _conn(self, host: int, fresh: bool = False):
+        import http.client
+        from urllib.parse import urlsplit as _split
+        conns = getattr(self._local, "conns", None)
+        if conns is None:
+            conns = self._local.conns = {}
+        conn = conns.get(host)
+        if fresh and conn is not None:
+            conn.close()
+            conn = None
+        if conn is None:
+            conn = conns[host] = http.client.HTTPConnection(
+                _split(self.urls[host]).netloc, timeout=120)
+        return conn
+
+    @staticmethod
+    def _decode(resp) -> Dict:
+        """The response body as a dict; a torn/non-JSON body (a host
+        dying mid-write) decodes to {} so the status check below turns
+        it into a FleetError instead of a raw traceback."""
+        try:
+            payload = json.loads(resp.read())
+        except (OSError, ValueError):
+            return {}
+        return payload if isinstance(payload, dict) else {}
+
+    def score(self, kind: str, model: str, row: str,
+              conf: Optional[Dict[str, str]] = None,
+              action: str = "score", req_id: str = "",
+              timeout: float = 30.0) -> Dict:
+        """Route one score (or reward append) to the model's warm
+        host; returns the decoded response body. Raises FleetError on
+        a non-200 answer (the body's error text attached)."""
+        import http.client
+        body = json.dumps({"kind": kind, "model": model, "row": row,
+                           "conf": conf or {}, "action": action,
+                           "req_id": req_id}).encode()
+        placement = self.router.place(score_affinity_key(kind, model),
+                                      priced_bytes=len(body))
+        if placement is None:
+            raise FleetError("no score host has budget headroom")
+        try:
+            target = f"/score?timeout={timeout}"
+            headers = {"Content-Type": "application/json"}
+            conn = self._conn(placement.host)
+            try:
+                conn.request("POST", target, body, headers)
+                resp = conn.getresponse()
+                payload = self._decode(resp)
+            except (OSError, http.client.HTTPException):
+                # the host may have idle-closed the persistent socket;
+                # one fresh-connection retry, then the error is real
+                conn = self._conn(placement.host, fresh=True)
+                conn.request("POST", target, body, headers)
+                resp = conn.getresponse()
+                payload = self._decode(resp)
+            if resp.status != 200:
+                raise FleetError(
+                    f"score host {placement.host} answered "
+                    f"{resp.status}: {payload.get('error')}")
+            return payload
+        finally:
+            self.router.release(placement)
+
+    def snapshot(self) -> Dict:
+        return self.router.snapshot()
+
+    def close(self) -> None:
+        conns = getattr(self._local, "conns", None) or {}
+        for conn in conns.values():
+            conn.close()
+        conns.clear()
+
+
 class FleetError(RuntimeError):
     """A fleet host died or refused to start."""
 

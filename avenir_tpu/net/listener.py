@@ -56,8 +56,15 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Dict, Optional, Tuple
 from urllib.parse import parse_qs, urlsplit
 
+from avenir_tpu.models.artifact import ModelFormatSkew
 from avenir_tpu.server.jobserver import JobServer, ServerClosed, Ticket
+from avenir_tpu.server.score import (ScoreError, ScoreTimeout,
+                                     score_request_from_json)
 from avenir_tpu.server.spool import request_from_json, result_to_json
+
+#: default blocking wait for one /score (override with ?timeout=; a
+#: coalesced score answers in ms — this bound only catches wedges)
+_SCORE_WAIT_S = 30.0
 
 #: reaper poll bound — how long a finished request's priced bytes can
 #: linger before the edge releases them
@@ -402,10 +409,58 @@ class _Handler(BaseHTTPRequestHandler):
             return None
         return min(timeout, listener.policy.wait_timeout_s)
 
+    def _handle_score(self) -> None:
+        """``POST /score`` — the query path. Persistent HTTP/1.1
+        connections matter here the way they never did for /submit:
+        a coalesced score answers in single-digit ms, so per-request
+        TCP setup would dominate; ``_reply`` always sends
+        Content-Length, which is what keeps the socket reusable.
+        Scores bypass the priced-bytes edge (a row costs no scan) but
+        respect the drain gate like every submission."""
+        listener: NetListener = self.server.listener
+        try:
+            length = int(self.headers.get("Content-Length") or 0)
+            req = score_request_from_json(
+                json.loads(self.rfile.read(length)))
+        except (ValueError, KeyError, TypeError) as exc:
+            self._reply(400, {"ok": False,
+                              "error": f"{type(exc).__name__}: {exc}"})
+            return
+        if listener.draining or listener.server.draining:
+            self._reply(503, {"ok": False, "status": "draining"})
+            return
+        timeout = self._query_timeout(self._query(), _SCORE_WAIT_S)
+        if timeout is None:
+            return
+        plane = listener.server.score_plane()
+        try:
+            if req.action == "reward":
+                ack = plane.reward(req)
+                self._reply(200, {"ok": True, "req_id": req.req_id,
+                                  **ack})
+                return
+            result = plane.score(req, timeout=timeout)
+        except ModelFormatSkew as exc:
+            # refuse-and-go-cold: a foreign/torn artifact stamp is the
+            # operator's problem, never parsed blind
+            self._reply(409, {"ok": False, "error": str(exc)})
+            return
+        except ScoreTimeout as exc:
+            self._reply(504, {"ok": False, "error": str(exc)})
+            return
+        except (ScoreError, OSError, KeyError, ValueError) as exc:
+            self._reply(400, {"ok": False,
+                              "error": f"{type(exc).__name__}: {exc}"})
+            return
+        self._reply(200, {"ok": True, **result.to_json()})
+
     # --------------------------------------------------------------- routes
     def do_POST(self) -> None:              # noqa: N802 — stdlib name
         listener: NetListener = self.server.listener
         path = urlsplit(self.path).path
+        if path == "/score":
+            self._handle_score()
+            return
         if path != "/submit":
             self._reply(404, {"error": f"no such route {path}"})
             return

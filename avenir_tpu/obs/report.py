@@ -71,7 +71,8 @@ def merge_snapshots(snaps: List[Dict]) -> Dict:
 
     out: Dict = {"hosts": len(snaps), "ts_unix": 0.0, "uptime_s": 0.0,
                  "queues": {}, "inflight": {}, "warm": {}, "stats": {},
-                 "hists": {}, "hists_raw": {}, "draining": False,
+                 "hists": {}, "hists_raw": {}, "score": None,
+                 "draining": False,
                  "trace": {"spans": 0, "dropped_spans": 0,
                            "enabled": False}}
     merged: Dict[str, LatencyHistogram] = {}
@@ -94,6 +95,19 @@ def merge_snapshots(snaps: List[Dict]) -> Dict:
         for name, raw in (snap.get("hists_raw") or {}).items():
             merged.setdefault(name, LatencyHistogram()).merge(
                 LatencyHistogram.from_dict(raw))
+        # score-plane roll-up: counters and per-model dispatch counts
+        # are additive across hosts (the per-model latency hists
+        # already merge above through hists_raw)
+        score = snap.get("score")
+        if score:
+            if out["score"] is None:
+                out["score"] = {"stats": {}, "per_model_predicts": {},
+                                "cache": {}}
+            for section in ("stats", "per_model_predicts", "cache"):
+                for key, val in (score.get(section) or {}).items():
+                    if isinstance(val, (int, float)):
+                        bucket = out["score"][section]
+                        bucket[key] = bucket.get(key, 0) + val
     out["hists"] = {name: h.summary() for name, h in merged.items()}
     out["hists_raw"] = {name: h.to_dict() for name, h in merged.items()}
     return out

@@ -666,6 +666,9 @@ class JobServer:
         self.metrics_path = metrics_path
         self.metrics_interval_s = float(metrics_interval_s)
         self._metrics_written_at = 0.0
+        # the online scoring half (server/score.py), built on first use:
+        # query traffic shares the process, not the batch queues
+        self._score_plane = None
 
     # ------------------------------------------------------------ public
     def __enter__(self) -> "JobServer":
@@ -777,6 +780,11 @@ class JobServer:
         for ticket in leftovers:
             ticket._complete(error=ServerClosed(
                 "server shut down before the request was served"))
+        # the score plane drains before the final snapshot so its last
+        # window's latencies make it into metrics.json
+        plane, self._score_plane = self._score_plane, None
+        if plane is not None:
+            plane.close()
         # final snapshot: a short --once spool session must still leave
         # a fresh metrics.json behind even when no interval tick fired
         try:
@@ -808,6 +816,18 @@ class JobServer:
                             for name, h in self._hists.items()}
         out.update({f"warm_{k}": v for k, v in self.warm.stats().items()})
         return out
+
+    # ----------------------------------------------------- score plane
+    def score_plane(self, **kwargs):
+        """The online scoring half (server/score.py), lazily built so
+        job-only servers never pay its dispatcher thread. kwargs
+        (budget_bytes / window_ms / batch_max) only apply to the
+        first, constructing call; shutdown() drains and joins it."""
+        with self._lock:
+            if self._score_plane is None:
+                from avenir_tpu.server.score import ScorePlane
+                self._score_plane = ScorePlane(**kwargs)
+            return self._score_plane
 
     # ------------------------------------------------------- edge hooks
     def price(self, requests: Sequence[JobRequest]) -> int:
@@ -878,6 +898,15 @@ class JobServer:
                 h = _obs.hist(name)       # a merged copy, race-free
                 if h is not None:
                     raw[name] = h.to_dict()
+        # score-plane per-model hists join BOTH forms, so the fleet
+        # roll-up (obs.report.merge_snapshots) folds per-host score
+        # latency distributions exactly, same as the batch hists
+        plane = self._score_plane
+        score = None
+        if plane is not None:
+            hists.update(plane.hist_summaries())
+            raw.update(plane.hists_raw())
+            score = plane.snapshot()
         return {"ts_unix": time.time(),
                 "uptime_s": round(time.perf_counter() - self._started_at,
                                   3),
@@ -887,6 +916,7 @@ class JobServer:
                 "stats": stats,
                 "hists": hists,
                 "hists_raw": raw,
+                "score": score,
                 "draining": self._draining,
                 "trace": {"spans": len(_obs.recorder()),
                           "dropped_spans": _obs.recorder().dropped,

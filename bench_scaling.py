@@ -1927,6 +1927,229 @@ def shard_tripwire(rows: int = 10_000_000, floor: float = 1.5,
         shutil.rmtree(d, ignore_errors=True)
 
 
+def score_tripwire(queries: int = 512, floor: float = 3.0,
+                   p99_ceiling_ms: float = 250.0,
+                   min_hit_rate: float = 0.9,
+                   fleet_scores_per_model: int = 40) -> dict:
+    """Online-scoring perf tripwire for avenir-score: the SAME query
+    stream answered two ways must show the coalescer's win without
+    changing a single byte of any answer.
+
+    **Coalescing leg** — `queries` markov scores fired from 32
+    concurrent client threads into one ScorePlane (2ms window) must
+    beat the same `queries` rows scored sequentially through
+    ``score_once`` (the cold solo reference: load, predict one row,
+    drop the model) by `floor`x in scores/sec. The plane's wins are
+    exactly the PR's claims: ONE warm model load (model_loads == 1),
+    windows folding many requests into one vectorized predict
+    (predict_calls strictly under the request count), and every
+    demuxed row BIT-IDENTICAL to its solo twin. The per-model
+    end-to-end histogram's p99 must sit under `p99_ceiling_ms` — the
+    coalescing window is a latency *budget*, never an unbounded queue.
+
+    **Fleet leg** — two in-process JobServer+NetListener hosts behind
+    a ScoreFront, two distinct models queried over real HTTP/1.1
+    keep-alive sockets: the router must pin each model to one warm
+    host (affinity hit rate ≥ `min_hit_rate`; with one miss per model
+    the expected rate is (n-1)/n), every wire answer must byte-match
+    its solo twin, and the fleet-merged snapshot must carry BOTH
+    models' end-to-end histograms plus the additive score stats
+    (merge_snapshots folding the per-host score sections is what the
+    fleet report reads — a merge that drops a model's histogram would
+    silently halve the fleet's p99 evidence)."""
+    import math
+    import os
+    import shutil
+    import threading
+    import time
+
+    from avenir_tpu.runner import run_job
+    from avenir_tpu.server.score import ScorePlane, ScoreRequest, \
+        score_once
+
+    # a 24-state alphabet: the solo reference's cost is the per-score
+    # model RELOAD (2 × 24×24 transition matrices), which is exactly
+    # what the warm cache amortizes — a 3-state toy parses so fast the
+    # comparison would measure thread scheduling, not the cache
+    states = tuple(f"s{i:02d}" for i in range(24))
+    mst_conf = {"mst.model.states": ",".join(states),
+                "mst.class.label.field.ord": "1",
+                "mst.skip.field.count": "2",
+                "mst.class.labels": "T,F"}
+    score_conf = {"field.delim": ",", "class.labels": "T,F",
+                  "log.odds.threshold": "0", "skip.field.count": "2"}
+
+    def seq_rows(start: int, n: int) -> list:
+        return [f"c{i}," + ("T" if i % 2 else "F") + ","
+                + ",".join(states[(i + j) % len(states)]
+                           for j in range(6))
+                for i in range(start, start + n)]
+
+    d = tempfile.mkdtemp(prefix="avenir_score_tripwire_")
+    try:
+        models = []
+        for m, start in enumerate((0, 7)):
+            corpus = os.path.join(d, f"train_{m}.csv")
+            with open(corpus, "w") as fh:
+                fh.write("\n".join(seq_rows(start, 600)) + "\n")
+            model = os.path.join(d, f"model_{m}.txt")
+            run_job("markovStateTransitionModel", dict(mst_conf),
+                    [corpus], model)
+            models.append(model)
+        model = models[0]
+        rows = [seq_rows(i * 3, 6)[0] for i in range(queries)]
+
+        # warm both sides' one-time costs off the clock (jit/imports)
+        score_once("markov", model, rows[0], score_conf)
+
+        t0 = time.perf_counter()
+        solo = [score_once("markov", model, r, score_conf)
+                for r in rows]
+        t_solo = time.perf_counter() - t0
+
+        plane = ScorePlane(window_ms=2.0, batch_max=64)
+        try:
+            plane.score(ScoreRequest("markov", model, rows[0],
+                                     dict(score_conf)))
+            warm_predicts = plane.predict_calls(model)
+            out = [None] * queries
+            # enough concurrent clients that each 2ms window coalesces
+            # a real batch — at 8 the sequential window waits per
+            # thread dominate and the comparison measures the window,
+            # not the coalescing
+            n_threads = 32
+
+            def client(t: int) -> None:
+                for i in range(t, queries, n_threads):
+                    out[i] = plane.score(ScoreRequest(
+                        "markov", model, rows[i], dict(score_conf)),
+                        timeout=60.0).row
+
+            t0 = time.perf_counter()
+            threads = [threading.Thread(target=client, args=(t,))
+                       for t in range(n_threads)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            t_plane = time.perf_counter() - t0
+            predicts = plane.predict_calls(model) - warm_predicts
+            stats = plane.snapshot()["stats"]
+            name = os.path.splitext(os.path.basename(model))[0]
+            p99 = plane.hist_summaries()[
+                f"score_{name}_total_ms"]["p99"]
+        finally:
+            plane.close()
+        for i, (a, b) in enumerate(zip(solo, out)):
+            if a != b:
+                raise RuntimeError(
+                    f"coalesced row {i} differs from its solo twin "
+                    f"({b!r} vs {a!r}) — demux broke bit-identity")
+        if stats["model_loads"] != 1:
+            raise RuntimeError(
+                f"plane loaded the model {stats['model_loads']} times "
+                f"for one artifact — the warm cache is not holding")
+        if predicts >= queries:
+            raise RuntimeError(
+                f"{predicts} vectorized dispatches for {queries} "
+                f"requests — the window never coalesced anything")
+        speedup = t_solo / max(t_plane, 1e-9)
+        if speedup < floor:
+            raise RuntimeError(
+                f"coalesced scoring only {speedup:.2f}x the solo "
+                f"reference (floor {floor}x; solo {t_solo:.2f}s, "
+                f"plane {t_plane:.2f}s) — the warm-cache/coalescing "
+                f"win regressed")
+        if p99 > p99_ceiling_ms:
+            raise RuntimeError(
+                f"score p99 {p99:.1f}ms past the {p99_ceiling_ms}ms "
+                f"ceiling — the window is queuing, not coalescing")
+
+        # ---- fleet leg: 2 hosts, 2 models, real keep-alive sockets
+        from avenir_tpu.net.fleet import ScoreFront
+        from avenir_tpu.net.listener import NetListener
+        from avenir_tpu.obs.report import merge_snapshots
+        from avenir_tpu.server import JobServer
+
+        fleet_rows = rows[:fleet_scores_per_model]
+        solo_by_model = {m: [score_once("markov", m, r, score_conf)
+                             for r in fleet_rows] for m in models}
+        servers = [JobServer(workers=1,
+                             state_root=os.path.join(d, f"h{i}"))
+                   .start() for i in range(2)]
+        listeners = [NetListener(s, port=0).start() for s in servers]
+        try:
+            front = ScoreFront([f"http://127.0.0.1:{lis.port}"
+                                for lis in listeners])
+            wire = {m: [None] * len(fleet_rows) for m in models}
+
+            def fleet_client(m: str) -> None:
+                for i, r in enumerate(fleet_rows):
+                    wire[m][i] = front.score(
+                        "markov", m, r, conf=dict(score_conf),
+                        timeout=60.0)["row"]
+
+            fthreads = [threading.Thread(target=fleet_client,
+                                         args=(m,)) for m in models]
+            for t in fthreads:
+                t.start()
+            for t in fthreads:
+                t.join()
+            hit_rate = front.router.affinity_hit_rate()
+            front.close()
+            snap = merge_snapshots([s.metrics_snapshot()
+                                    for s in servers])
+        finally:
+            for lis in listeners:
+                lis.stop()
+            for srv in servers:
+                srv.shutdown()
+        for m in models:
+            for i, (a, b) in enumerate(zip(solo_by_model[m],
+                                           wire[m])):
+                if a != b:
+                    raise RuntimeError(
+                        f"fleet-served row {i} of {m} differs from "
+                        f"its solo twin ({b!r} vs {a!r})")
+        if hit_rate < min_hit_rate:
+            raise RuntimeError(
+                f"score affinity hit rate {hit_rate:.2f} under the "
+                f"{min_hit_rate} floor — repeat queries of one model "
+                f"are not returning to its warm host")
+        total = 2 * len(fleet_rows)
+        fleet_stats = (snap.get("score") or {}).get("stats", {})
+        if int(fleet_stats.get("scores", 0)) != total:
+            raise RuntimeError(
+                f"merged snapshot counts "
+                f"{fleet_stats.get('scores')} scores, {total} were "
+                f"served — merge_snapshots dropped a host's score "
+                f"section")
+        missing = [m for m in models
+                   if "score_" + os.path.splitext(os.path.basename(
+                       m))[0].replace(".", "_") + "_total_ms"
+                   not in (snap.get("hists_raw") or {})]
+        if missing:
+            raise RuntimeError(
+                f"merged snapshot is missing per-model score "
+                f"histograms for {missing}")
+        return {"queries": queries, "floor": floor,
+                "speedup": round(speedup, 2),
+                "scores_per_s_solo": round(queries / t_solo, 1),
+                "scores_per_s_coalesced": round(
+                    queries / max(t_plane, 1e-9), 1),
+                "vectorized_dispatches": int(predicts),
+                "dispatch_bound": int(math.ceil(queries / 64)),
+                "model_loads": int(stats["model_loads"]),
+                "p99_total_ms": round(p99, 3),
+                "p99_ceiling_ms": p99_ceiling_ms,
+                "fleet_scores": total,
+                "fleet_affinity_hit_rate": round(hit_rate, 3),
+                "fleet_hists_per_model": True,
+                "rows_byte_identical": True}
+    finally:
+        shutil.rmtree(d, ignore_errors=True)
+
+
 def main(n_devices: int = 8, quick: bool = False):
     from __graft_entry__ import _bootstrap_devices
 
@@ -2014,6 +2237,15 @@ def main(n_devices: int = 8, quick: bool = False):
     line["sidecar_tripwire"] = (
         sidecar_tripwire(100_000, floor=1.2) if quick
         else sidecar_tripwire())
+    # quick mode fires fewer queries, so the fixed window/thread costs
+    # weigh more and the scores/sec floor relaxes; the real >=3x gate
+    # runs the full 512-query stream every full round — the
+    # deterministic legs (bit-identity, one model load, coalesced
+    # dispatch count, affinity routing, merged histograms) assert at
+    # both scales
+    line["score_tripwire"] = (
+        score_tripwire(160, floor=1.3) if quick
+        else score_tripwire())
     line["graftlint"] = graftlint_tripwire()
     print(json.dumps(line))
 

@@ -14,6 +14,14 @@ Arms:
   for tests and tier-1).
 - ``solo``    — a 1-host fleet: one ``serve --spool`` subprocess.
 - ``fleet``   — an N-host fleet behind the affinity router.
+- ``query``   — QUERY-shaped traffic (PR 20): mixed ``POST /score``
+  + job submits against ``--hosts`` in-process listener pairs, score
+  routing by MODEL affinity through :class:`net.fleet.ScoreFront`.
+  Model popularity is Zipf (hot models stay warm on their pinned
+  host), arrivals are the same open-loop Poisson clock, and
+  ``--score-fraction`` of arrivals are scores. Reports score p50/p99
+  (folded from the servers' merged per-model ``score_*_total_ms``
+  histograms) NEXT TO jobs/min — the queries-are-jobs-too view.
 
 Per arm it prints ONE JSON line: offered vs served jobs/min, p50/p99
 queue wait and p99 chunk latency (the PR 10 histograms, read from the
@@ -50,6 +58,10 @@ MST_CONF = {"mst.model.states": "L,M,H",
             "mst.class.label.field.ord": "1",
             "mst.skip.field.count": "2",
             "mst.class.labels": "T,F"}
+
+#: the scoring view of the same classifier (server/score.py conf keys)
+MARKOV_SCORE_CONF = {"field.delim": ",", "class.labels": "T,F",
+                     "log.odds.threshold": "0", "skip.field.count": "2"}
 
 
 def write_corpus(path: str, rows: int, seed: int) -> None:
@@ -223,6 +235,147 @@ def _sleep_until(t0, arrival):
         time.sleep(delay)
 
 
+# ------------------------------------------------------------ query arm
+def train_models(corpora, work):
+    """One markov classifier per corpus — the model POPULATION the
+    Zipf popularity draw runs over."""
+    from avenir_tpu.runner import run_job
+
+    models = []
+    for i, corpus in enumerate(corpora):
+        path = os.path.join(work, f"model_{i:03d}.txt")
+        run_job("markovStateTransitionModel", dict(MST_CONF), [corpus],
+                path)
+        models.append(path)
+    return models
+
+
+def plan_query_load(args, corpora, models, out_dir):
+    """The mixed schedule: (arrival_s, ("score", model, row)) or
+    (arrival_s, ("job", request_obj)) — model popularity Zipf(s),
+    arrivals Poisson, ``--score-fraction`` of arrivals are scores.
+    Fixed by the seed before any arm runs (the plan_load contract)."""
+    rng = np.random.default_rng(args.seed + 2)
+    ranks = np.arange(1, len(models) + 1, dtype=float)
+    pmf = ranks ** -args.zipf_s
+    pmf /= pmf.sum()
+    arrivals = np.cumsum(rng.exponential(1.0 / args.rate,
+                                         size=args.requests))
+    rows_by_model = []
+    for corpus in corpora:
+        with open(corpus) as fh:
+            rows_by_model.append([ln.rstrip("\n") for ln in fh][:512])
+    load = []
+    for i in range(args.requests):
+        if rng.random() < args.score_fraction:
+            m = int(rng.choice(len(models), p=pmf))
+            row = rows_by_model[m][int(rng.integers(
+                len(rows_by_model[m])))]
+            load.append((float(arrivals[i]), ("score", models[m], row)))
+        else:
+            corpus = corpora[int(rng.choice(len(corpora), p=pmf))]
+            load.append((float(arrivals[i]), ("job", {
+                "job": "markovStateTransitionModel", "conf": MST_CONF,
+                "inputs": [corpus],
+                "output": os.path.join(out_dir, f"qout_{i:05d}.txt"),
+                "tenant": f"t{int(rng.integers(args.tenants)):04d}",
+            })))
+    return load
+
+
+def _score_hist(snap):
+    """Fold every per-model ``score_*_total_ms`` raw histogram into ONE
+    end-to-end score-latency distribution (the exact-merge algebra —
+    client-side we only fold, never recompute)."""
+    from avenir_tpu.obs.histogram import LatencyHistogram
+
+    h = LatencyHistogram()
+    for name, raw in (snap.get("hists_raw") or {}).items():
+        if name.startswith("score_") and name.endswith("_total_ms"):
+            h.merge(LatencyHistogram.from_dict(raw))
+    return h.summary()
+
+
+def run_query(args, qload, hosts):
+    from avenir_tpu.net.fleet import FleetError, ScoreFront
+    from avenir_tpu.net.listener import NetListener
+    from avenir_tpu.obs.report import merge_snapshots
+    from avenir_tpu.server import JobServer
+    from avenir_tpu.server.spool import request_from_json
+
+    import tempfile as _tf
+    import threading
+
+    roots = [_tf.mkdtemp(prefix=f"query_load_h{i}_")
+             for i in range(hosts)]
+    servers = [JobServer(workers=args.workers, state_root=r).start()
+               for r in roots]
+    listeners = [NetListener(s, port=0).start() for s in servers]
+    score_errors = 0
+    err_lock = threading.Lock()
+    tickets, threads = [], []
+    try:
+        front = ScoreFront([f"http://127.0.0.1:{lis.port}"
+                            for lis in listeners])
+
+        def one_score(model, row):
+            nonlocal score_errors
+            try:
+                front.score("markov", model, row,
+                            conf=dict(MARKOV_SCORE_CONF))
+            except (FleetError, OSError):
+                with err_lock:
+                    score_errors += 1
+
+        t0 = time.perf_counter()
+        for arrival, item in qload:
+            _sleep_until(t0, arrival)
+            if item[0] == "score":
+                # open loop: the arrival never waits for the answer
+                t = threading.Thread(target=one_score,
+                                     args=(item[1], item[2]))
+                t.start()
+                threads.append(t)
+            else:
+                srv = servers[len(tickets) % hosts]
+                tickets.append(srv.submit(request_from_json(item[1])))
+        for t in threads:
+            t.join(args.drain_timeout)
+        for srv in servers:
+            srv.drain(timeout=args.drain_timeout)
+        wall = time.perf_counter() - t0
+        served = sum(1 for t in tickets if _ok(t))
+        snap = merge_snapshots([s.metrics_snapshot() for s in servers])
+        hit_rate = front.router.affinity_hit_rate()
+        front.close()
+    finally:
+        for lis in listeners:
+            lis.stop()
+        for srv in servers:
+            srv.shutdown()
+    scores = sum(1 for _a, item in qload if item[0] == "score")
+    jobs = len(qload) - scores
+    sh = _score_hist(snap)
+    score_section = snap.get("score") or {}
+    stats = score_section.get("stats", {})
+    row = {"arm": "query", "hosts": hosts, "scores": scores,
+           "jobs": jobs, "served_jobs": served,
+           "score_errors": score_errors,
+           "lost_requests": (jobs - len(tickets))
+           + (scores - int(sh.get("count", 0)) - score_errors),
+           "wall_s": round(wall, 2),
+           "jobs_per_min": round(served / (wall / 60.0), 2),
+           "scores_per_s": round(
+               int(sh.get("count", 0)) / max(wall, 1e-9), 2),
+           "score_p50_ms": round(sh.get("p50", 0.0), 3),
+           "score_p99_ms": round(sh.get("p99", 0.0), 3),
+           "score_predict_calls": int(stats.get("predict_calls", 0)),
+           "score_model_loads": int(stats.get("model_loads", 0)),
+           "score_affinity_hit_rate": round(hit_rate, 3)}
+    row.update(_hist_stats(snap.get("hists", {}), "queue_wait_ms"))
+    return row
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         description="open-loop Zipf/Poisson load against the job-server "
@@ -240,7 +393,10 @@ def main(argv=None) -> int:
     ap.add_argument("--workers", type=int, default=1)
     ap.add_argument("--budget-mb", type=float, default=3072.0)
     ap.add_argument("--arms", default="inproc,fleet",
-                    help="comma list of inproc,solo,fleet")
+                    help="comma list of inproc,solo,fleet,query")
+    ap.add_argument("--score-fraction", type=float, default=0.8,
+                    help="query arm: fraction of arrivals that are "
+                         "scores (rest are job submits)")
     ap.add_argument("--seed", type=int, default=7)
     ap.add_argument("--drain-timeout", type=float, default=1800.0)
     args = ap.parse_args(argv)
@@ -268,6 +424,10 @@ def main(argv=None) -> int:
             row = run_fleet(args, load, hosts=1)
         elif arm == "fleet":
             row = run_fleet(args, load, hosts=args.hosts)
+        elif arm == "query":
+            models = train_models(corpora, work)
+            qload = plan_query_load(args, corpora, models, out_dir)
+            row = run_query(args, qload, hosts=args.hosts)
         else:
             print(f"unknown arm {arm!r}", file=sys.stderr)
             return 2
