@@ -57,6 +57,7 @@ import subprocess
 import sys
 import threading
 import time
+import uuid
 from typing import Dict, List, Optional, Sequence, Tuple
 
 from avenir_tpu.core.atomic import publish_json
@@ -120,6 +121,11 @@ class ScoreFront:
         self.router = AffinityRouter(
             list(budgets) if budgets else [1 << 30] * len(self.urls))
         self._local = threading.local()
+        # every connection ever handed out, across ALL threads —
+        # close() runs on one thread but must reach the keep-alive
+        # sockets the other scoring threads opened
+        self._conns_lock = threading.Lock()
+        self._all_conns: List = []
 
     def _conn(self, host: int, fresh: bool = False):
         import http.client
@@ -130,10 +136,15 @@ class ScoreFront:
         conn = conns.get(host)
         if fresh and conn is not None:
             conn.close()
+            with self._conns_lock:
+                if conn in self._all_conns:
+                    self._all_conns.remove(conn)
             conn = None
         if conn is None:
             conn = conns[host] = http.client.HTTPConnection(
                 _split(self.urls[host]).netloc, timeout=120)
+            with self._conns_lock:
+                self._all_conns.append(conn)
         return conn
 
     @staticmethod
@@ -155,6 +166,14 @@ class ScoreFront:
         host; returns the decoded response body. Raises FleetError on
         a non-200 answer (the body's error text attached)."""
         import http.client
+        if action == "reward" and not req_id:
+            # a reward append is only retry-safe when the journal can
+            # nonce-dedupe it: the fresh-connection retry below can
+            # land after the host already committed the first send, so
+            # an empty req_id would double-apply the observation. Mint
+            # one; both sends carry the same body, so the second
+            # dedupes server-side.
+            req_id = uuid.uuid4().hex
         body = json.dumps({"kind": kind, "model": model, "row": row,
                            "conf": conf or {}, "action": action,
                            "req_id": req_id}).encode()
@@ -189,10 +208,16 @@ class ScoreFront:
         return self.router.snapshot()
 
     def close(self) -> None:
-        conns = getattr(self._local, "conns", None) or {}
-        for conn in conns.values():
-            conn.close()
-        conns.clear()
+        with self._conns_lock:
+            conns, self._all_conns = self._all_conns, []
+        for conn in conns:
+            try:
+                conn.close()
+            except OSError:
+                pass
+        local = getattr(self._local, "conns", None)
+        if local:
+            local.clear()
 
 
 class FleetError(RuntimeError):

@@ -152,8 +152,14 @@ def score_request_from_json(obj: Dict) -> ScoreRequest:
     if not model:
         raise ValueError("score request needs a model artifact path")
     row = obj.get("row", "")
-    if not isinstance(row, str) or not row:
+    if not isinstance(row, str) or not row.strip():
         raise ValueError("score request needs a non-empty row string")
+    if "\n" in row or "\r" in row:
+        # one request, one row: an embedded newline would parse into
+        # extra dataset rows and shift every later slot's positional
+        # demux — cross-request leakage, so it is rejected at the edge
+        raise ValueError("score row must be a single line "
+                         "(embedded newlines break window framing)")
     conf = obj.get("conf", {}) or {}
     if not isinstance(conf, dict):
         raise ValueError("conf must be an object of string knobs")
@@ -170,15 +176,28 @@ def reward_journal_path(artifact: str) -> str:
     return artifact + ".rewards.json"
 
 
-def load_reward_journal(artifact: str) -> List[Dict]:
+def load_reward_journal(artifact: str, strict: bool = False) -> List[Dict]:
     """The journal's entries in append order ([] when absent). A
-    journal stamped with a foreign format refuses like a model does."""
+    journal stamped with a foreign format refuses like a model does.
+
+    ``strict`` is the WRITER's mode (read_stamp's skew-not-absence
+    rule): a present-but-unparseable journal raises instead of reading
+    as [], because the append path republishes whatever this returns —
+    shrugging there would overwrite all prior reward history with a
+    journal containing only the new entry."""
+    path = reward_journal_path(artifact)
     try:
-        with open(reward_journal_path(artifact)) as fh:
+        with open(path) as fh:
             obj = json.load(fh)
-    except (OSError, ValueError):
-        # absent — or torn by a racing delete/external truncation,
-        # which every protocol reader treats as absent, never a crash
+    except FileNotFoundError:
+        return []
+    except (OSError, ValueError) as exc:
+        if strict:
+            raise ModelFormatSkew(
+                f"unreadable reward journal {path}: {exc} — refusing "
+                "to publish over history that cannot be read") from exc
+        # torn by a racing delete/external truncation, which every
+        # protocol READER treats as absent, never a crash
         return []
     if obj.get("format_version") != REWARD_JOURNAL_VERSION:
         raise ModelFormatSkew(
@@ -200,7 +219,7 @@ def append_reward(artifact: str, group: str, item: str, reward: float,
     just re-run the append — idempotent. single-writer: callers
     serialize through the owning plane's journal lock.
     """
-    entries = load_reward_journal(artifact)
+    entries = load_reward_journal(artifact, strict=True)
     if nonce is not None:
         for e in entries:
             if e.get("nonce") == nonce:
@@ -290,6 +309,14 @@ class _BayesScorer:
         from avenir_tpu.core.dataset import Dataset
         ds = Dataset.from_csv("\n".join(rows) + "\n", self.schema,
                               delim=self.delim, keep_raw=True)
+        if len(ds.raw_rows) != len(rows):
+            # a blank row vanishes (Dataset skips it) and an embedded
+            # newline splits in two — either way positional demux
+            # would hand later slots the wrong answers, so refuse
+            raise ScoreError(
+                f"bayes window framing: {len(rows)} request rows "
+                f"parsed into {len(ds.raw_rows)} dataset rows "
+                "(blank or multi-line row in the batch)")
         codes, post = self.pred.predict(ds)
         out = []
         for raw, c, row_post in zip(ds.raw_rows, codes, post):
@@ -703,6 +730,25 @@ class ScorePlane:
                 self._dispatch(window)
 
     def _dispatch(self, window: _Window) -> None:
+        """Serve one window, demuxing ANY failure to its waiters. The
+        wrapper is the dispatcher thread's survival guarantee: a bug
+        anywhere in the dispatch path must become a per-slot error —
+        an escaped exception would kill the sole ``score-dispatch``
+        thread, leaving these waiters hung and every later score on
+        the plane timing out."""
+        try:
+            self._dispatch_window(window)
+        except BaseException as exc:
+            undone = [s for s in window.slots if not s.done.is_set()]
+            try:
+                with self._cv:
+                    self.stats["errors"] += len(undone)
+            finally:
+                for slot in undone:
+                    slot.error = exc
+                    slot.done.set()
+
+    def _dispatch_window(self, window: _Window) -> None:
         kind, model, _ = window.gkey
         conf = window.slots[0].request.conf
         rows = [s.request.row for s in window.slots]
@@ -725,6 +771,11 @@ class ScorePlane:
                 results = entry.scorer.predict_rows(rows, conf)
             else:
                 results = entry.scorer.predict_rows(rows)
+            if len(results) != len(window.slots):
+                raise ScoreError(
+                    f"{kind} predict returned {len(results)} rows for "
+                    f"a window of {len(window.slots)} — refusing the "
+                    "positional demux (misaligned answers)")
             predict_ms = (time.monotonic() - t_pred) * 1000.0
         except BaseException as exc:   # demuxed to every waiter
             error = exc

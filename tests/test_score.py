@@ -26,7 +26,8 @@ from avenir_tpu.server.score import (ModelCache, ScoreError, ScorePlane,
                                      ScoreRequest, _ModelEntry,
                                      append_reward, fold_rewards,
                                      load_reward_journal, model_cache_key,
-                                     score_once)
+                                     reward_journal_path, score_once,
+                                     score_request_from_json)
 
 MST_CONF = {"mst.model.states": "L,M,H",
             "mst.class.label.field.ord": "1",
@@ -146,9 +147,19 @@ def test_bayes_score_matches_batch_predictor(tmp_path):
         got = [res.row for res in _plane_scores(
             plane, [ScoreRequest("bayes", model, r, dict(conf))
                     for r in rows])]
+        assert got == batch         # unstamped artifact loads AND matches
+        # a row Dataset.from_csv would silently drop (blank) or split
+        # (embedded newline) ERRORS instead of shifting the demux ...
+        for bad in ("   ", rows[0] + "\n" + rows[1]):
+            with pytest.raises(ScoreError):
+                plane.score(ScoreRequest("bayes", model, bad,
+                                         dict(conf)), timeout=30.0)
+        # ... and the dispatcher survives it: the next score still serves
+        again = plane.score(ScoreRequest("bayes", model, rows[0],
+                                         dict(conf)), timeout=30.0)
+        assert again.row == batch[0]
     finally:
         plane.close()
-    assert got == batch             # unstamped artifact loads AND matches
 
 
 def test_discriminant_score_matches_batch_predict(tmp_path):
@@ -248,6 +259,52 @@ def test_concurrent_scores_coalesce_into_bounded_dispatches(tmp_path):
     assert snap["stats"]["window_rows"] == len(rows)
     # one load served every window (warm cache, not per-request parse)
     assert snap["stats"]["model_loads"] == 1
+
+
+def test_short_predict_demuxes_error_and_dispatcher_survives(tmp_path,
+                                                             monkeypatch):
+    """A predict that returns fewer rows than the window has slots is a
+    demuxed per-slot ScoreError — never an escaped IndexError that
+    kills the sole dispatcher thread and wedges the plane for good."""
+    import avenir_tpu.server.score as score_mod
+
+    model = str(tmp_path / "fake_model.txt")
+    open(model, "w").write("anything\n")
+
+    class _FlakyScorer:
+        short = True
+        nbytes = 64
+
+        def __init__(self, model_path, conf):
+            pass
+
+        def predict_rows(self, rows):
+            if _FlakyScorer.short:
+                return list(rows)[:-1]          # one row vanishes
+            return [r + ",ok" for r in rows]
+
+    monkeypatch.setitem(score_mod._SCORERS, "markov", _FlakyScorer)
+    plane = ScorePlane(window_ms=0.0)
+    try:
+        with pytest.raises(ScoreError, match="demux"):
+            plane.score(ScoreRequest("markov", model, "a,b", {}),
+                        timeout=30.0)
+        # the error was counted, the thread lived, the plane still serves
+        assert plane.snapshot()["stats"]["errors"] == 1
+        _FlakyScorer.short = False
+        res = plane.score(ScoreRequest("markov", model, "a,b", {}),
+                          timeout=30.0)
+        assert res.row == "a,b,ok"
+    finally:
+        plane.close()                  # a wedged dispatcher would raise
+
+
+def test_score_request_rejects_blank_and_multiline_rows():
+    base = {"kind": "markov", "model": "m.txt"}
+    assert score_request_from_json({**base, "row": "a,b"}).row == "a,b"
+    for bad in ("", "   \t", "a,b\nc,d", "a,b\rc,d"):
+        with pytest.raises(ValueError):
+            score_request_from_json({**base, "row": bad})
 
 
 # ----------------------------------------------------- warm model cache
@@ -351,6 +408,20 @@ def test_reward_journal_append_fold_and_nonce(tmp_path):
         fold_rewards(data, [{"group": "gX", "item": "i", "reward": 1.0}])
 
 
+def test_append_refuses_to_publish_over_corrupt_journal(tmp_path):
+    stats = _bandit_stats(tmp_path)
+    append_reward(stats, "g1", "itemB", 9.0, nonce="n1")
+    with open(reward_journal_path(stats), "w") as fh:
+        fh.write("{torn")
+    # READERS treat unparseable as absent (racing delete/truncation)...
+    assert load_reward_journal(stats) == []
+    # ...but the WRITER's read-extend-publish must not overwrite reward
+    # history it cannot read with a journal of only the new entry
+    with pytest.raises(ModelFormatSkew):
+        append_reward(stats, "g2", "itemA", 1.0)
+    assert open(reward_journal_path(stats)).read() == "{torn"
+
+
 def test_reward_append_shifts_next_bandit_pull(tmp_path):
     stats = _bandit_stats(tmp_path)
     conf = dict(BANDIT_SCORE_CONF, **{"batch.size": "1"})
@@ -413,6 +484,41 @@ def test_post_score_keepalive_two_requests_one_socket(tmp_path):
             resp.read()
             assert conn.sock is socks[0]
             conn.close()
+    finally:
+        srv.shutdown()
+
+
+def test_score_front_mints_reward_nonce_and_closes_all_threads(tmp_path):
+    from avenir_tpu.net.fleet import ScoreFront
+    from avenir_tpu.net.listener import NetListener
+    from avenir_tpu.server import JobServer
+
+    stats = _bandit_stats(tmp_path)
+    srv = JobServer(state_root=str(tmp_path / "srv"), workers=1)
+    try:
+        with NetListener(srv, port=0) as lis:
+            front = ScoreFront([f"http://127.0.0.1:{lis.port}"])
+            # reward with NO req_id: the front mints a nonce, so its
+            # fresh-connection retry can never double-apply the append
+            ack = front.score("bandit", stats, "g1,itemB,9.0,2",
+                              conf=dict(BANDIT_SCORE_CONF),
+                              action="reward")
+            assert ack["applied"] is True
+            entries = load_reward_journal(stats)
+            assert len(entries) == 1 and entries[0]["nonce"]
+            # a keep-alive socket opened by ANOTHER thread is closed
+            # by close() too, not leaked until process exit
+            t = threading.Thread(
+                target=front.score,
+                args=("bandit", stats, "g1"),
+                kwargs={"conf": dict(BANDIT_SCORE_CONF)})
+            t.start()
+            t.join()
+            conns = list(front._all_conns)
+            assert len(conns) == 2            # one per (thread, host)
+            front.close()
+            assert front._all_conns == []
+            assert all(c.sock is None for c in conns)
     finally:
         srv.shutdown()
 
